@@ -1,0 +1,12 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any `import jax` (pytest imports conftest first). Multi-chip
+sharding tests run on these virtual devices; the driver separately validates
+the multi-chip path via __graft_entry__.dryrun_multichip.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,140 @@
+"""BLS12-381 reference math: curve laws, pairing bilinearity, threshold."""
+import pytest
+
+from tpubft.crypto import bls12381 as bls
+
+
+def test_generators_on_curve():
+    assert bls.g1_is_on_curve(bls.G1_GEN)
+    assert bls.g2_is_on_curve(bls.G2_GEN)
+
+
+def test_group_order():
+    assert bls.g1_mul(bls.G1_GEN, bls.R) is None
+    assert bls.g2_mul(bls.G2_GEN, bls.R) is None
+
+
+def test_g1_group_laws():
+    a = bls.g1_mul(bls.G1_GEN, 7)
+    b = bls.g1_mul(bls.G1_GEN, 11)
+    assert bls.g1_add(a, b) == bls.g1_mul(bls.G1_GEN, 18)
+    assert bls.g1_add(a, bls.g1_neg(a)) is None
+    assert bls.g1_add(a, None) == a
+
+
+def test_fp2_field_laws():
+    a, b = (3, 5), (7, 11)
+    assert bls.fp2_mul(a, b) == bls.fp2_mul(b, a)
+    assert bls.fp2_mul(a, bls.fp2_inv(a)) == bls.FP2_ONE
+    assert bls.fp2_sqr(a) == bls.fp2_mul(a, a)
+    s = bls.fp2_sqrt(bls.fp2_sqr(a))
+    assert s in (a, bls.fp2_neg(a))
+
+
+def test_fp12_field_laws():
+    x = ((( 2, 3), (5, 7), (11, 13)), ((17, 19), (23, 29), (31, 37)))
+    assert bls.fp12_mul(x, bls.fp12_inv(x)) == bls.FP12_ONE
+    assert bls.fp12_pow(x, 5) == bls.fp12_mul(
+        x, bls.fp12_mul(x, bls.fp12_mul(x, bls.fp12_mul(x, x))))
+
+
+@pytest.mark.slow
+def test_pairing_bilinearity():
+    e_ab = bls.pairing(bls.g1_mul(bls.G1_GEN, 6), bls.G2_GEN)
+    e_a_b = bls.pairing(bls.g1_mul(bls.G1_GEN, 2), bls.g2_mul(bls.G2_GEN, 3))
+    e_b_a = bls.pairing(bls.g1_mul(bls.G1_GEN, 3), bls.g2_mul(bls.G2_GEN, 2))
+    assert e_ab == e_a_b == e_b_a
+    e = bls.pairing(bls.G1_GEN, bls.G2_GEN)
+    assert bls.fp12_pow(e, 6) == e_ab
+    # non-degenerate
+    assert e != bls.FP12_ONE
+
+
+def test_hash_to_g1_in_subgroup():
+    h = bls.hash_to_g1(b"message")
+    assert bls.g1_is_on_curve(h)
+    assert bls.g1_mul_nonorder(h, bls.R) is None  # correct subgroup
+    assert bls.hash_to_g1(b"message") == h        # deterministic
+    assert bls.hash_to_g1(b"other") != h
+
+
+def test_compress_roundtrip():
+    for k in (1, 2, 0xDEADBEEF):
+        p1 = bls.g1_mul(bls.G1_GEN, k)
+        assert bls.g1_decompress(bls.g1_compress(p1)) == p1
+        p2 = bls.g2_mul(bls.G2_GEN, k)
+        assert bls.g2_decompress(bls.g2_compress(p2)) == p2
+    assert bls.g1_decompress(bls.g1_compress(None)) is None
+    assert bls.g2_decompress(bls.g2_compress(None)) is None
+
+
+@pytest.mark.slow
+def test_bls_sign_verify():
+    sk, pk = bls.keygen(seed=b"k1")
+    sig = bls.sign(sk, b"hello")
+    assert bls.verify(pk, b"hello", sig)
+    assert not bls.verify(pk, b"world", sig)
+    sk2, pk2 = bls.keygen(seed=b"k2")
+    assert not bls.verify(pk2, b"hello", sig)
+
+
+@pytest.mark.slow
+def test_threshold_combine_matches_master():
+    k, n = 3, 5
+    master_pk, share_pks, shares = bls.threshold_keygen(k, n, seed=b"t")
+    msg = b"commit-digest"
+    sig_shares = {i + 1: bls.sign(shares[i], msg) for i in range(n)}
+    # any k-subset combines to a signature valid under the master pk
+    for ids in ([1, 2, 3], [2, 4, 5], [1, 3, 5]):
+        combined = bls.combine_shares(ids, [sig_shares[i] for i in ids])
+        assert bls.verify(master_pk, msg, combined)
+    # k-1 shares must NOT combine to a valid signature
+    bad = bls.combine_shares([1, 2], [sig_shares[1], sig_shares[2]])
+    assert not bls.verify(master_pk, msg, bad)
+
+
+def test_lagrange_reconstructs_secret():
+    k, n = 3, 7
+    _, _, shares = bls.threshold_keygen(k, n, seed=b"l")
+    ids = [2, 5, 6]
+    coeffs = bls.lagrange_coeffs_at_zero(ids)
+    secret = sum(c * shares[i - 1] for c, i in zip(coeffs, ids)) % bls.R
+    ids2 = [1, 3, 4]
+    coeffs2 = bls.lagrange_coeffs_at_zero(ids2)
+    secret2 = sum(c * shares[i - 1] for c, i in zip(coeffs2, ids2)) % bls.R
+    assert secret == secret2
+
+
+def test_decompress_rejects_noncanonical_infinity():
+    with pytest.raises(ValueError):
+        bls.g1_decompress(bytes([0xC0]) + b"\x01" + b"\x00" * 46)
+    with pytest.raises(ValueError):
+        bls.g1_decompress(bytes([0xE0]) + b"\x00" * 47)
+    with pytest.raises(ValueError):
+        bls.g2_decompress(bytes([0xC0]) + b"\x01" + b"\x00" * 94)
+
+
+def test_decompress_rejects_non_subgroup_point():
+    # find an on-curve x whose point is NOT in the order-R subgroup
+    x = 1
+    while True:
+        rhs = (x * x * x + bls.B1) % bls.P
+        y = bls.fp_sqrt(rhs)
+        if y is not None and bls.g1_mul_nonorder((x, y), bls.R) is not None:
+            break
+        x += 1
+    enc = bytearray((x).to_bytes(48, "big"))
+    enc[0] |= 0x80
+    if y > (bls.P - 1) // 2:
+        enc[0] |= 0x20
+    with pytest.raises(ValueError):
+        bls.g1_decompress(bytes(enc))
+
+
+def test_share_pk_bounds():
+    from tpubft.crypto.systems import BlsThresholdVerifier
+    v = BlsThresholdVerifier(2, 3, None, [None, None, None])
+    for bad in (0, -1, 4, 9999):
+        with pytest.raises(ValueError):
+            v.share_pk(bad)
+        assert not v.verify_share(bad, b"d", b"s")

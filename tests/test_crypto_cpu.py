@@ -1,0 +1,78 @@
+"""CPU crypto backends: golden vectors + interface behavior."""
+import hashlib
+
+import pytest
+
+from tpubft.crypto import cpu
+from tpubft.crypto.digest import calc_combination, digest, digest_of_parts
+
+
+def test_sha256_digest():
+    assert digest(b"abc").hex() == (
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad")
+
+
+def test_digest_of_parts_injective():
+    assert digest_of_parts(b"ab", b"c") != digest_of_parts(b"a", b"bc")
+    assert digest_of_parts(b"ab", b"c") == digest_of_parts(b"ab", b"c")
+
+
+def test_calc_combination_binds_slot():
+    d = digest(b"block")
+    assert calc_combination(d, 1, 5) != calc_combination(d, 1, 6)
+    assert calc_combination(d, 1, 5) != calc_combination(d, 2, 5)
+
+
+# RFC 8032 test vector 1: empty message
+RFC8032_SK = bytes.fromhex(
+    "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60")
+RFC8032_PK = bytes.fromhex(
+    "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a")
+RFC8032_SIG = bytes.fromhex(
+    "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+    "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b")
+
+
+def test_ed25519_rfc8032_vector1():
+    signer = cpu.Ed25519Signer(RFC8032_SK)
+    assert signer.public_bytes() == RFC8032_PK
+    assert signer.sign(b"") == RFC8032_SIG
+    v = cpu.Ed25519Verifier(RFC8032_PK)
+    assert v.verify(b"", RFC8032_SIG)
+    assert not v.verify(b"x", RFC8032_SIG)
+    assert not v.verify(b"", RFC8032_SIG[:-1] + b"\x00")
+
+
+def test_ed25519_roundtrip_deterministic_seed():
+    s1 = cpu.Ed25519Signer.generate(seed=b"r0")
+    s2 = cpu.Ed25519Signer.generate(seed=b"r0")
+    assert s1.public_bytes() == s2.public_bytes()
+    sig = s1.sign(b"hello")
+    assert cpu.Ed25519Verifier(s1.public_bytes()).verify(b"hello", sig)
+
+
+@pytest.mark.parametrize("curve", ["secp256k1", "secp256r1"])
+def test_ecdsa_roundtrip(curve):
+    s = cpu.EcdsaSigner.generate(curve, seed=b"k")
+    v = cpu.EcdsaVerifier(s.public_bytes(), curve)
+    sig = s.sign(b"msg")
+    assert len(sig) == 64
+    assert v.verify(b"msg", sig)
+    assert not v.verify(b"other", sig)
+    bad = bytes([sig[0] ^ 1]) + sig[1:]
+    assert not v.verify(b"msg", bad)
+
+
+def test_scheme_factory():
+    for scheme in ["ed25519", "ecdsa-secp256k1", "ecdsa-p256"]:
+        s = cpu.make_signer(scheme, seed=b"s")
+        v = cpu.make_verifier(scheme, s.public_bytes())
+        assert v.verify(b"data", s.sign(b"data"))
+
+
+def test_verify_batch_default():
+    s = cpu.make_signer("ed25519", seed=b"b")
+    v = cpu.make_verifier("ed25519", s.public_bytes())
+    items = [(bytes([i]), s.sign(bytes([i]))) for i in range(4)]
+    items.append((b"bad", items[0][1]))
+    assert v.verify_batch(items) == [True] * 4 + [False]

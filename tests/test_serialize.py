@@ -1,0 +1,124 @@
+"""Canonical serialization codec tests."""
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import pytest
+
+from tpubft.utils.serialize import (SerializeError, decode_msg, encode_msg,
+                                    read_uvarint, write_uvarint)
+
+
+@dataclass
+class Inner:
+    SPEC = [("a", "u32"), ("b", "bytes")]
+    a: int
+    b: bytes
+
+
+@dataclass
+class Outer:
+    SPEC = [
+        ("x", "u64"),
+        ("flag", "bool"),
+        ("name", "str"),
+        ("items", ("list", "u16")),
+        ("digest", ("fixed", "u8", 4)),
+        ("table", ("map", "str", "u32")),
+        ("maybe", ("opt", "bytes")),
+        ("inner", ("msg", Inner)),
+    ]
+    x: int
+    flag: bool
+    name: str
+    items: List[int]
+    digest: List[int]
+    table: Dict[str, int]
+    maybe: Optional[bytes]
+    inner: Inner
+
+
+def make():
+    return Outer(x=2**63, flag=True, name="héllo", items=[1, 65535],
+                 digest=[1, 2, 3, 4], table={"b": 2, "a": 1},
+                 maybe=None, inner=Inner(a=7, b=b"\x00\xff"))
+
+
+def test_roundtrip():
+    m = make()
+    assert decode_msg(encode_msg(m), Outer) == m
+
+
+def test_canonical_map_order():
+    m1 = make()
+    m2 = make()
+    m2.table = {"a": 1, "b": 2}  # different insertion order
+    assert encode_msg(m1) == encode_msg(m2)
+
+
+def test_optional_present():
+    m = make()
+    m.maybe = b"xyz"
+    assert decode_msg(encode_msg(m), Outer).maybe == b"xyz"
+
+
+def test_trailing_bytes_rejected():
+    with pytest.raises(SerializeError):
+        decode_msg(encode_msg(make()) + b"\x00", Outer)
+
+
+def test_truncation_rejected():
+    data = encode_msg(make())
+    with pytest.raises(SerializeError):
+        decode_msg(data[:-1], Outer)
+
+
+def test_uvarint_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**32, 2**60]:
+        buf = bytearray()
+        write_uvarint(buf, v)
+        out, off = read_uvarint(memoryview(bytes(buf)), 0)
+        assert (out, off) == (v, len(buf))
+
+
+def test_fixed_length_enforced():
+    m = make()
+    m.digest = [1, 2, 3]
+    with pytest.raises(SerializeError):
+        encode_msg(m)
+
+
+def test_config():
+    from tpubft.utils.config import ReplicaConfig
+    c = ReplicaConfig(f_val=1, c_val=0)
+    assert c.n_val == 4 and c.slow_path_quorum == 3 and c.optimistic_fast_quorum == 4
+    c2 = ReplicaConfig.from_json(c.to_json())
+    assert c2 == c
+    c3 = ReplicaConfig(f_val=2, c_val=1)
+    assert c3.n_val == 9 and c3.fast_path_threshold_quorum == 8
+
+
+def test_i64_range_checked():
+    from dataclasses import dataclass
+
+    @dataclass
+    class M:
+        SPEC = [("v", "i64")]
+        v: int
+
+    assert decode_msg(encode_msg(M(v=-5)), M).v == -5
+    assert decode_msg(encode_msg(M(v=2**63 - 1)), M).v == 2**63 - 1
+    with pytest.raises(SerializeError):
+        encode_msg(M(v=2**63))
+    with pytest.raises(SerializeError):
+        encode_msg(M(v=-(2**63) - 1))
+
+
+def test_uvarint_rejects_overlong():
+    with pytest.raises(SerializeError):
+        read_uvarint(memoryview(b"\x80\x00"), 0)  # non-minimal zero
+    with pytest.raises(SerializeError):
+        read_uvarint(memoryview(b"\xff" * 9 + b"\x7f"), 0)  # > 64 bits
+    # canonical max u64 still decodes
+    buf = bytearray()
+    write_uvarint(buf, 2**64 - 1)
+    assert read_uvarint(memoryview(bytes(buf)), 0)[0] == 2**64 - 1

@@ -1,0 +1,88 @@
+"""Cryptosystem plugin layer: multisig-ed25519 and threshold-bls backends."""
+import pytest
+
+from tpubft.crypto.interfaces import Cryptosystem
+
+
+def test_multisig_ed25519_accumulate_and_verify():
+    cs = Cryptosystem("multisig-ed25519", threshold=3, num_signers=4, seed=b"s1")
+    digest = b"d" * 32
+    signers = [cs.create_threshold_signer(i) for i in range(1, 5)]
+    verifier = cs.create_threshold_verifier()
+    acc = verifier.new_accumulator(with_share_verification=False)
+    acc.set_expected_digest(digest)
+    for s in signers[:3]:
+        acc.add(s.signer_id, s.sign_share(digest))
+    assert acc.has_threshold()
+    full = acc.get_full_signed_data()
+    assert verifier.verify(digest, full)
+    assert not verifier.verify(b"x" * 32, full)
+
+
+def test_multisig_bad_share_identification():
+    cs = Cryptosystem("multisig-ed25519", threshold=2, num_signers=3, seed=b"s2")
+    digest = b"e" * 32
+    verifier = cs.create_threshold_verifier()
+    acc = verifier.new_accumulator(with_share_verification=False)
+    acc.set_expected_digest(digest)
+    s1 = cs.create_threshold_signer(1)
+    acc.add(1, s1.sign_share(digest))
+    acc.add(2, b"\x00" * 64)  # garbage share
+    assert acc.identify_bad_shares() == [2]
+    # with share verification on, the garbage share is rejected at add()
+    acc2 = verifier.new_accumulator(with_share_verification=True)
+    acc2.set_expected_digest(digest)
+    assert acc2.add(2, b"\x00" * 64) == 0
+    assert acc2.add(1, s1.sign_share(digest)) == 1
+
+
+def test_multisig_quorum_thresholds_nfc():
+    # the three commit-path quorums from CryptoManager.hpp:109-111 (f=1,c=0,n=4)
+    cs = Cryptosystem("multisig-ed25519", threshold=3, num_signers=4, seed=b"s3")
+    v_slow = cs.create_threshold_verifier(threshold=3)    # 2f+c+1
+    v_all = cs.create_threshold_verifier(threshold=4)     # n (optimistic fast)
+    digest = b"f" * 32
+    shares = [(i, cs.create_threshold_signer(i).sign_share(digest))
+              for i in range(1, 5)]
+    acc = v_slow.new_accumulator(False)
+    acc.set_expected_digest(digest)
+    for i, s in shares[:3]:
+        acc.add(i, s)
+    assert acc.has_threshold()
+    accf = v_all.new_accumulator(False)
+    accf.set_expected_digest(digest)
+    for i, s in shares[:3]:
+        accf.add(i, s)
+    assert not accf.has_threshold()
+    accf.add(4, shares[3][1])
+    assert accf.has_threshold()
+
+
+@pytest.mark.slow
+def test_threshold_bls_accumulate_and_verify():
+    cs = Cryptosystem("threshold-bls", threshold=2, num_signers=4, seed=b"b1")
+    digest = b"g" * 32
+    verifier = cs.create_threshold_verifier()
+    acc = verifier.new_accumulator(with_share_verification=False)
+    acc.set_expected_digest(digest)
+    for i in (2, 4):
+        acc.add(i, cs.create_threshold_signer(i).sign_share(digest))
+    assert acc.has_threshold()
+    full = acc.get_full_signed_data()
+    assert verifier.verify(digest, full)
+    assert not verifier.verify(b"x" * 32, full)
+
+
+@pytest.mark.slow
+def test_threshold_bls_bad_share_identification():
+    cs = Cryptosystem("threshold-bls", threshold=2, num_signers=3, seed=b"b2")
+    digest = b"h" * 32
+    verifier = cs.create_threshold_verifier()
+    acc = verifier.new_accumulator(with_share_verification=False)
+    acc.set_expected_digest(digest)
+    acc.add(1, cs.create_threshold_signer(1).sign_share(digest))
+    # share signed over the WRONG digest: valid point, invalid share
+    acc.add(2, cs.create_threshold_signer(2).sign_share(b"wrong" * 6 + b"xx"))
+    combined = acc.get_full_signed_data()
+    assert not verifier.verify(digest, combined)
+    assert acc.identify_bad_shares() == [2]

@@ -1,0 +1,26 @@
+"""tpubft — a TPU-native Byzantine fault tolerant SMR framework.
+
+A from-scratch rebuild of the capabilities of Concord-BFT (reference:
+/root/reference, vmware/concord-bft) designed TPU-first: the consensus
+control plane is host code, while the cryptographic data plane (signature
+verification, BLS threshold-share accumulation, multi-scalar multiplication,
+pairing checks, digest trees) runs as batched, vmapped JAX/XLA/Pallas
+kernels behind the same plugin boundaries the reference uses
+(SigManager, IThresholdSigner/Verifier/Accumulator, Cryptosystem).
+
+Layer map (mirrors SURVEY.md §1):
+  tpubft.utils       — foundation: config registry, metrics, serialization (L1/L2)
+  tpubft.crypto      — crypto interfaces + CPU reference backends (L4)
+  tpubft.ops         — JAX/TPU kernels: bignum limb engine, ed25519, ecdsa,
+                       BLS12-381 towers/pairing/MSM (L4 data plane)
+  tpubft.parallel    — device mesh / shard_map sharding of crypto batches
+  tpubft.comm        — ICommunication + UDP/loopback transports (L3)
+  tpubft.consensus   — SBFT engine: messages, replica, collectors, view change (L5)
+  tpubft.storage     — IDBClient abstraction + memory/file backends
+  tpubft.kvbc        — categorized key-value blockchain + sparse merkle tree (L6)
+  tpubft.statetransfer — block/state synchronisation for lagging replicas
+  tpubft.client      — BFT client with quorum matching (L7)
+  tpubft.models      — replicated state machines (counter, KV) used by apps/tests
+"""
+
+__version__ = "0.1.0"

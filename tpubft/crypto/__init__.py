@@ -1,0 +1,11 @@
+"""Cryptography layer (reference: threshsign/ + util crypto — SURVEY.md §2.2/2.3).
+
+Interfaces mirror the reference plugin boundary (IThresholdSigner/Verifier/
+Accumulator, ISigner/IVerifier, Cryptosystem) so consensus code is backend-
+agnostic; backends are "cpu" (OpenSSL via `cryptography` + pure-python BLS
+reference math) and "tpu" (batched JAX kernels in tpubft.ops).
+"""
+from tpubft.crypto.interfaces import (  # noqa: F401
+    ISigner, IVerifier, IThresholdSigner, IThresholdVerifier,
+    IThresholdAccumulator, Cryptosystem,
+)

@@ -1,0 +1,158 @@
+"""CPU signer/verifier backends (OpenSSL via the `cryptography` package).
+
+Rebuild of the reference's crypto_utils (Crypto++ RSA/ECDSA signers —
+/root/reference/util/include/crypto_utils.hpp:41-100) plus the EdDSA path.
+These are the "cpu" crypto backend and the golden reference the TPU kernels
+are tested against. All signatures use fixed-length raw encodings so wire
+messages have static layouts (TPU batches need fixed shapes).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, ed25519
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed, decode_dss_signature, encode_dss_signature)
+
+from tpubft.crypto.interfaces import ISigner, IVerifier
+
+ED25519_SIG_LEN = 64
+ED25519_PK_LEN = 32
+ECDSA_SIG_LEN = 64  # raw r||s, 32B each
+
+
+# ---------------- Ed25519 ----------------
+
+class Ed25519Signer(ISigner):
+    def __init__(self, private_key_bytes: bytes):
+        self._sk = ed25519.Ed25519PrivateKey.from_private_bytes(private_key_bytes)
+        self.private_bytes = private_key_bytes
+
+    @classmethod
+    def generate(cls, seed: Optional[bytes] = None) -> "Ed25519Signer":
+        if seed is not None:
+            return cls(hashlib.sha256(b"ed25519-keygen" + seed).digest())
+        sk = ed25519.Ed25519PrivateKey.generate()
+        raw = sk.private_bytes(serialization.Encoding.Raw,
+                               serialization.PrivateFormat.Raw,
+                               serialization.NoEncryption())
+        return cls(raw)
+
+    def sign(self, data: bytes) -> bytes:
+        return self._sk.sign(data)
+
+    @property
+    def signature_length(self) -> int:
+        return ED25519_SIG_LEN
+
+    def public_bytes(self) -> bytes:
+        return self._sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+
+
+class Ed25519Verifier(IVerifier):
+    def __init__(self, public_key_bytes: bytes):
+        self.public_key_bytes = public_key_bytes
+        self._pk = ed25519.Ed25519PublicKey.from_public_bytes(public_key_bytes)
+
+    def verify(self, data: bytes, sig: bytes) -> bool:
+        if len(sig) != ED25519_SIG_LEN:
+            return False
+        try:
+            self._pk.verify(sig, data)
+            return True
+        except InvalidSignature:
+            return False
+
+    @property
+    def signature_length(self) -> int:
+        return ED25519_SIG_LEN
+
+
+# ---------------- ECDSA (secp256k1 / P-256), raw r||s signatures ----------------
+
+_CURVES = {
+    "secp256k1": ec.SECP256K1(),
+    "secp256r1": ec.SECP256R1(),
+}
+
+
+class EcdsaSigner(ISigner):
+    def __init__(self, private_value: int, curve: str = "secp256k1"):
+        self.curve_name = curve
+        self._sk = ec.derive_private_key(private_value, _CURVES[curve])
+        self.private_value = private_value
+
+    @classmethod
+    def generate(cls, curve: str = "secp256k1",
+                 seed: Optional[bytes] = None) -> "EcdsaSigner":
+        if seed is not None:
+            order = {"secp256k1":
+                     0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141,
+                     "secp256r1":
+                     0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551}[curve]
+            v = int.from_bytes(hashlib.sha512(b"ecdsa-keygen" + seed).digest(), "big")
+            return cls(v % (order - 1) + 1, curve)
+        sk = ec.generate_private_key(_CURVES[curve])
+        return cls(sk.private_numbers().private_value, curve)
+
+    def sign(self, data: bytes) -> bytes:
+        der = self._sk.sign(data, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    @property
+    def signature_length(self) -> int:
+        return ECDSA_SIG_LEN
+
+    def public_bytes(self) -> bytes:
+        """Uncompressed SEC1 point (0x04 || x || y), 65 bytes."""
+        return self._sk.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint)
+
+
+class EcdsaVerifier(IVerifier):
+    def __init__(self, public_key_bytes: bytes, curve: str = "secp256k1"):
+        self.curve_name = curve
+        self.public_key_bytes = public_key_bytes
+        self._pk = ec.EllipticCurvePublicKey.from_encoded_point(
+            _CURVES[curve], public_key_bytes)
+
+    def verify(self, data: bytes, sig: bytes) -> bool:
+        if len(sig) != ECDSA_SIG_LEN:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        try:
+            self._pk.verify(encode_dss_signature(r, s), data,
+                            ec.ECDSA(hashes.SHA256()))
+            return True
+        except InvalidSignature:
+            return False
+
+    @property
+    def signature_length(self) -> int:
+        return ECDSA_SIG_LEN
+
+
+def make_signer(scheme: str, seed: Optional[bytes] = None) -> ISigner:
+    if scheme == "ed25519":
+        return Ed25519Signer.generate(seed=seed)
+    if scheme in ("ecdsa-secp256k1", "secp256k1"):
+        return EcdsaSigner.generate("secp256k1", seed=seed)
+    if scheme in ("ecdsa-secp256r1", "secp256r1", "ecdsa-p256"):
+        return EcdsaSigner.generate("secp256r1", seed=seed)
+    raise ValueError(f"unknown signature scheme {scheme}")
+
+
+def make_verifier(scheme: str, public_key_bytes: bytes) -> IVerifier:
+    if scheme == "ed25519":
+        return Ed25519Verifier(public_key_bytes)
+    if scheme in ("ecdsa-secp256k1", "secp256k1"):
+        return EcdsaVerifier(public_key_bytes, "secp256k1")
+    if scheme in ("ecdsa-secp256r1", "secp256r1", "ecdsa-p256"):
+        return EcdsaVerifier(public_key_bytes, "secp256r1")
+    raise ValueError(f"unknown signature scheme {scheme}")

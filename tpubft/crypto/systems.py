@@ -1,0 +1,262 @@
+"""Threshold cryptosystem backends.
+
+Rebuild of the reference's scheme registry + BLS backend
+(threshsign/src/ThresholdSignaturesTypes.cpp:183-200 createThresholdVerifier/
+Signer; threshsign/src/bls/relic/ BlsThresholdSigner/Verifier/Accumulator):
+
+  "multisig-ed25519" — k-of-n multisig: the combined signature is the sorted
+      list of (signer_id, ed25519_sig) pairs. Constant-time verify per share,
+      batch-friendly. Mirrors the reference's "multisig-bls" role for the
+      n-signer fast path, using the cheapest scheme on CPU.
+  "threshold-bls"    — BLS12-381 k-of-n Shamir threshold: shares are G1
+      points; accumulate = Lagrange + MSM; verify = pairing check. Mirrors
+      "threshold-bls" (BlsThresholdFactory.cpp:39).
+
+Both accumulators defer share verification (accumulate first, verify the
+combined result, and only on failure identify bad shares) — exactly the
+reference's SignaturesProcessingJob strategy
+(CollectorOfThresholdSignatures.hpp:291-407).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpubft.crypto import bls12381 as bls
+from tpubft.crypto.cpu import Ed25519Signer, Ed25519Verifier
+from tpubft.crypto.interfaces import (Cryptosystem, IThresholdAccumulator,
+                                      IThresholdFactory, IThresholdSigner,
+                                      IThresholdVerifier)
+
+
+# ---------------- multisig-ed25519 ----------------
+
+class MultisigEd25519Signer(IThresholdSigner):
+    def __init__(self, signer_id: int, seed_or_sk: bytes):
+        self._signer = Ed25519Signer(seed_or_sk)
+        self._id = signer_id
+
+    def sign_share(self, data: bytes) -> bytes:
+        return self._signer.sign(data)
+
+    @property
+    def signer_id(self) -> int:
+        return self._id
+
+
+class MultisigEd25519Accumulator(IThresholdAccumulator):
+    def __init__(self, verifier: "MultisigEd25519Verifier", share_verification: bool):
+        self._verifier = verifier
+        self._share_verification = share_verification
+        self._digest: Optional[bytes] = None
+        self._shares: Dict[int, bytes] = {}
+
+    def set_expected_digest(self, digest: bytes) -> None:
+        self._digest = digest
+
+    def add(self, share_id: int, share: bytes) -> int:
+        if self._share_verification and self._digest is not None:
+            if not self._verifier.verify_share(share_id, self._digest, share):
+                return len(self._shares)
+        self._shares[share_id] = share
+        return len(self._shares)
+
+    def has_threshold(self) -> bool:
+        return len(self._shares) >= self._verifier.threshold
+
+    def get_full_signed_data(self) -> bytes:
+        ids = sorted(self._shares)[: self._verifier.threshold]
+        out = bytearray(struct.pack("<H", len(ids)))
+        for i in ids:
+            out += struct.pack("<H", i)
+            out += self._shares[i]
+        return bytes(out)
+
+    def identify_bad_shares(self) -> List[int]:
+        assert self._digest is not None
+        return [i for i, s in self._shares.items()
+                if not self._verifier.verify_share(i, self._digest, s)]
+
+
+class MultisigEd25519Verifier(IThresholdVerifier):
+    def __init__(self, threshold: int, total: int, share_public_keys: Sequence[bytes]):
+        self._threshold = threshold
+        self._total = total
+        self._share_verifiers = [Ed25519Verifier(pk) for pk in share_public_keys]
+
+    def new_accumulator(self, with_share_verification: bool) -> MultisigEd25519Accumulator:
+        return MultisigEd25519Accumulator(self, with_share_verification)
+
+    def verify_share(self, share_id: int, data: bytes, share: bytes) -> bool:
+        if not 1 <= share_id <= self._total:
+            return False
+        return self._share_verifiers[share_id - 1].verify(data, share)
+
+    def verify(self, data: bytes, sig: bytes) -> bool:
+        try:
+            (k,) = struct.unpack_from("<H", sig, 0)
+            if k < self._threshold:
+                return False
+            off = 2
+            seen = set()
+            for _ in range(k):
+                (i,) = struct.unpack_from("<H", sig, off)
+                off += 2
+                share = sig[off:off + 64]
+                off += 64
+                if i in seen or not self.verify_share(i, data, share):
+                    return False
+                seen.add(i)
+            return off == len(sig)
+        except (struct.error, IndexError):
+            return False
+
+    @property
+    def threshold(self) -> int:
+        return self._threshold
+
+    @property
+    def total_signers(self) -> int:
+        return self._total
+
+
+class MultisigEd25519Factory(IThresholdFactory):
+    def new_signer(self, signer_id: int, secret_share: bytes) -> MultisigEd25519Signer:
+        return MultisigEd25519Signer(signer_id, secret_share)
+
+    def new_verifier(self, threshold, total, public_key, share_public_keys):
+        return MultisigEd25519Verifier(threshold, total, share_public_keys)
+
+    def keygen(self, threshold: int, total: int, seed: Optional[bytes] = None):
+        import hashlib
+        sks, pks = [], []
+        for i in range(total):
+            s = (hashlib.sha256(b"ms-ed" + seed + i.to_bytes(4, "big")).digest()
+                 if seed is not None else None)
+            signer = Ed25519Signer.generate(seed=s)
+            sks.append(signer.private_bytes)
+            pks.append(signer.public_bytes())
+        # no single master public key for multisig; use the pk list
+        return pks, pks, sks
+
+
+# ---------------- threshold-bls (BLS12-381) ----------------
+
+class BlsThresholdSigner(IThresholdSigner):
+    def __init__(self, signer_id: int, secret_share: int):
+        self._id = signer_id
+        self._sk = secret_share
+
+    def sign_share(self, data: bytes) -> bytes:
+        return bls.g1_compress(bls.sign(self._sk, data))
+
+    @property
+    def signer_id(self) -> int:
+        return self._id
+
+
+class BlsThresholdAccumulator(IThresholdAccumulator):
+    """Accumulate G1 shares; combine = Lagrange + MSM (the TPU-sharded op)."""
+
+    def __init__(self, verifier: "BlsThresholdVerifier", share_verification: bool):
+        self._verifier = verifier
+        self._share_verification = share_verification
+        self._digest: Optional[bytes] = None
+        self._shares: Dict[int, object] = {}
+
+    def set_expected_digest(self, digest: bytes) -> None:
+        self._digest = digest
+
+    def add(self, share_id: int, share: bytes) -> int:
+        if not 1 <= share_id <= self._verifier.total_signers:
+            return len(self._shares)
+        try:
+            pt = bls.g1_decompress(share)
+        except ValueError:
+            return len(self._shares)
+        if pt is None:
+            return len(self._shares)
+        if self._share_verification and self._digest is not None:
+            if not self._verifier.verify_share(share_id, self._digest, share):
+                return len(self._shares)
+        self._shares[share_id] = pt
+        return len(self._shares)
+
+    def has_threshold(self) -> bool:
+        return len(self._shares) >= self._verifier.threshold
+
+    def get_full_signed_data(self) -> bytes:
+        ids = sorted(self._shares)[: self._verifier.threshold]
+        combined = bls.combine_shares(ids, [self._shares[i] for i in ids])
+        return bls.g1_compress(combined)
+
+    def identify_bad_shares(self) -> List[int]:
+        assert self._digest is not None
+        h = bls.hash_to_g1(self._digest)
+        bad = []
+        for i, pt in self._shares.items():
+            pk = self._verifier.share_pk(i)
+            if not bls.pairing_check([(pt, bls.g2_neg(bls.G2_GEN)), (h, pk)]):
+                bad.append(i)
+        return bad
+
+
+class BlsThresholdVerifier(IThresholdVerifier):
+    def __init__(self, threshold: int, total: int, master_pk, share_pks):
+        self._threshold = threshold
+        self._total = total
+        self._master_pk = master_pk
+        self._share_pks = share_pks
+
+    def new_accumulator(self, with_share_verification: bool) -> BlsThresholdAccumulator:
+        return BlsThresholdAccumulator(self, with_share_verification)
+
+    def share_pk(self, share_id: int):
+        if not 1 <= share_id <= self._total:
+            raise ValueError(f"share id {share_id} out of range 1..{self._total}")
+        return self._share_pks[share_id - 1]
+
+    def verify_share(self, share_id: int, data: bytes, share: bytes) -> bool:
+        if not 1 <= share_id <= self._total:
+            return False
+        try:
+            pt = bls.g1_decompress(share)
+        except ValueError:
+            return False
+        return bls.verify(self.share_pk(share_id), data, pt)
+
+    def verify(self, data: bytes, sig: bytes) -> bool:
+        try:
+            pt = bls.g1_decompress(sig)
+        except ValueError:
+            return False
+        return bls.verify(self._master_pk, data, pt)
+
+    @property
+    def threshold(self) -> int:
+        return self._threshold
+
+    @property
+    def total_signers(self) -> int:
+        return self._total
+
+
+class BlsThresholdFactory(IThresholdFactory):
+    def new_signer(self, signer_id: int, secret_share: int) -> BlsThresholdSigner:
+        return BlsThresholdSigner(signer_id, secret_share)
+
+    def new_verifier(self, threshold, total, public_key, share_public_keys):
+        return BlsThresholdVerifier(threshold, total, public_key, share_public_keys)
+
+    def keygen(self, threshold: int, total: int, seed: Optional[bytes] = None):
+        master_pk, share_pks, shares = bls.threshold_keygen(threshold, total, seed=seed)
+        return master_pk, share_pks, shares
+
+
+def register_builtin(type_name: str) -> None:
+    if type_name == "multisig-ed25519":
+        Cryptosystem.register_type(type_name, MultisigEd25519Factory())
+    elif type_name in ("threshold-bls", "multisig-bls"):
+        Cryptosystem.register_type(type_name, BlsThresholdFactory())
+    else:
+        raise ValueError(f"unknown cryptosystem type {type_name}")

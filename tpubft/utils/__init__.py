@@ -1,0 +1,1 @@
+"""Foundation utilities (reference: util/ — SURVEY.md §2.8)."""

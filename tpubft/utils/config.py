@@ -1,0 +1,119 @@
+"""Replica configuration registry.
+
+TPU-native rebuild of the reference's ReplicaConfig
+(/root/reference/bftengine/include/bftengine/ReplicaConfig.hpp:28-89): a
+declarative parameter registry with defaults, descriptions, serialization,
+and derived quorum arithmetic (n = 3f + 2c + 1).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ReplicaConfig:
+    """All tunables for one replica. Field docs mirror the reference params."""
+
+    # identity / topology
+    replica_id: int = 0
+    f_val: int = 1                  # max byzantine replicas tolerated
+    c_val: int = 0                  # max slow/crashed replicas for fast path
+    num_of_client_proxies: int = 1
+    num_ro_replicas: int = 0
+    is_read_only: bool = False
+
+    # batching (RequestsBatchingLogic equivalents)
+    max_num_of_requests_in_batch: int = 100
+    max_batch_size_bytes: int = 33_554_432
+    batch_flush_period_ms: int = 7
+
+    # protocol windows/timers
+    concurrency_level: int = 1
+    view_change_timer_ms: int = 4000
+    status_report_timer_ms: int = 1000
+    checkpoint_window_size: int = 150   # seqnums between protocol checkpoints
+    work_window_size: int = 300         # in-flight seqnum window (2 checkpoints)
+    max_reply_size_bytes: int = 1_048_576
+
+    # commit paths
+    auto_primary_rotation_enabled: bool = False
+    view_change_protocol_enabled: bool = True
+    pre_execution_enabled: bool = False
+    time_service_enabled: bool = False
+
+    # crypto
+    crypto_backend: str = "cpu"         # "cpu" | "tpu"
+    replica_sig_scheme: str = "ed25519"  # per-message replica signatures
+    client_sig_scheme: str = "ed25519"
+    threshold_scheme: str = "multisig-ed25519"  # or "threshold-bls"
+    client_transaction_signing_enabled: bool = True
+
+    # crypto batch dispatch (TPU seam)
+    verify_batch_size: int = 256
+    verify_batch_flush_us: int = 200
+
+    # retransmissions
+    retransmissions_enabled: bool = True
+    retransmission_timer_ms: int = 50
+
+    # state transfer
+    max_block_chunk_bytes: int = 1 << 20
+    state_transfer_batch_blocks: int = 64
+
+    # key exchange
+    key_exchange_on_start: bool = False
+
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    # ---- derived quorum arithmetic (ReplicaConfig.hpp numReplicas etc.) ----
+    @property
+    def n_val(self) -> int:
+        return 3 * self.f_val + 2 * self.c_val + 1
+
+    @property
+    def num_replicas(self) -> int:
+        return self.n_val
+
+    @property
+    def slow_path_quorum(self) -> int:
+        """2f + c + 1 matching prepare/commit shares (PBFT-style)."""
+        return 2 * self.f_val + self.c_val + 1
+
+    @property
+    def fast_path_threshold_quorum(self) -> int:
+        """3f + c + 1 shares for FAST_WITH_THRESHOLD."""
+        return 3 * self.f_val + self.c_val + 1
+
+    @property
+    def optimistic_fast_quorum(self) -> int:
+        """all n shares for OPTIMISTIC_FAST."""
+        return self.n_val
+
+    @property
+    def checkpoint_quorum(self) -> int:
+        """f + 1 matching signed checkpoints make a stable checkpoint proof."""
+        return self.f_val + 1
+
+    def validate(self) -> None:
+        if self.replica_id >= self.n_val + self.num_ro_replicas:
+            raise ValueError(
+                f"replica_id {self.replica_id} out of range for n={self.n_val} "
+                f"(+{self.num_ro_replicas} RO)")
+        if self.f_val < 1:
+            raise ValueError("f_val must be >= 1")
+        if self.work_window_size % self.checkpoint_window_size != 0:
+            raise ValueError("work window must be a multiple of checkpoint window")
+
+    # ---- serialization ----
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "ReplicaConfig":
+        return cls(**json.loads(s))
+
+    def describe(self) -> Dict[str, str]:
+        return {f.name: str(getattr(self, f.name)) for f in dataclasses.fields(self)}

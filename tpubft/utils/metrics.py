@@ -1,0 +1,135 @@
+"""Metrics components: counters / gauges / statuses with aggregation.
+
+TPU-native rebuild of the reference's concordMetrics
+(/root/reference/util/include/Metrics.hpp): named Components own counters,
+gauges, and statuses; an Aggregator snapshots all components to JSON. A
+lightweight UDP metrics server (reference util/include/MetricsServer.hpp:46)
+serves snapshots to test harnesses (Apollo-equivalent polls it).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self, v: int = 0) -> None:
+        self.value = v
+
+    def set(self, v: int) -> None:
+        self.value = v
+
+
+class Status:
+    __slots__ = ("value",)
+
+    def __init__(self, v: str = "") -> None:
+        self.value = v
+
+    def set(self, v: str) -> None:
+        self.value = v
+
+
+class Component:
+    """A named bundle of metrics, registered with an Aggregator."""
+
+    def __init__(self, name: str, aggregator: Optional["Aggregator"] = None):
+        self.name = name
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.statuses: Dict[str, Status] = {}
+        if aggregator is not None:
+            aggregator.register(self)
+
+    def register_counter(self, name: str) -> Counter:
+        return self.counters.setdefault(name, Counter())
+
+    def register_gauge(self, name: str, v: int = 0) -> Gauge:
+        return self.gauges.setdefault(name, Gauge(v))
+
+    def register_status(self, name: str, v: str = "") -> Status:
+        return self.statuses.setdefault(name, Status(v))
+
+    def snapshot(self) -> Dict:
+        return {
+            "counters": {k: c.value for k, c in self.counters.items()},
+            "gauges": {k: g.value for k, g in self.gauges.items()},
+            "statuses": {k: s.value for k, s in self.statuses.items()},
+        }
+
+
+class Aggregator:
+    def __init__(self) -> None:
+        self._components: Dict[str, Component] = {}
+        self._lock = threading.Lock()
+
+    def register(self, c: Component) -> None:
+        with self._lock:
+            self._components[c.name] = c
+
+    def get(self, component: str, kind: str, name: str):
+        with self._lock:
+            c = self._components[component]
+        return c.snapshot()[kind][name]
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {name: c.snapshot() for name, c in self._components.items()}
+
+    def to_json(self) -> str:
+        return json.dumps({"ts": time.time(), "components": self.snapshot()})
+
+
+class UdpMetricsServer:
+    """Serves aggregator JSON snapshots over UDP — any datagram gets a reply.
+
+    Mirrors the reference's UDP metrics server that the Apollo harness polls
+    (/root/reference/util/include/MetricsServer.hpp:46, tests/apollo/util/bft_metrics.py).
+    """
+
+    def __init__(self, aggregator: Aggregator, port: int = 0, host: str = "127.0.0.1"):
+        self._agg = aggregator
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, port))
+        self._sock.settimeout(0.2)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                _, addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                self._sock.sendto(self._agg.to_json().encode(), addr)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self._sock.close()

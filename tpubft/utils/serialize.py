@@ -1,0 +1,218 @@
+"""Canonical binary serialization for wire messages and persisted state.
+
+TPU-native rebuild of the reference's CMF (Concord Message Format,
+/root/reference/messages/compiler/cmfc.py + grammar.ebnf) and the
+hand-rolled packed message headers (bftengine/src/bftengine/messages/).
+Instead of an external codegen step, messages are declared as Python
+dataclasses with a field-spec table; the codec supports CMF's type system:
+fixed-width little-endian ints, bool, bytes/string (uvarint-length-prefixed),
+lists, fixed lists, maps, optionals, oneof (by message id), and nested
+messages. Deterministic (canonical) encoding: maps are sorted by key.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, fields, is_dataclass
+from typing import Any, Dict, List, Optional, Tuple, Type, get_args, get_origin
+
+
+class SerializeError(Exception):
+    pass
+
+
+# ---------------- low-level primitives ----------------
+
+def write_uint(buf: bytearray, v: int, width: int) -> None:
+    if v < 0 or v >= 1 << (8 * width):
+        raise SerializeError(f"uint{8*width} out of range: {v}")
+    buf += v.to_bytes(width, "little")
+
+
+def read_uint(data: memoryview, off: int, width: int) -> Tuple[int, int]:
+    if off + width > len(data):
+        raise SerializeError("truncated uint")
+    return int.from_bytes(data[off:off + width], "little"), off + width
+
+
+def write_bytes(buf: bytearray, b: bytes) -> None:
+    write_uvarint(buf, len(b))
+    buf += b
+
+
+def read_bytes(data: memoryview, off: int) -> Tuple[bytes, int]:
+    n, off = read_uvarint(data, off)
+    if off + n > len(data):
+        raise SerializeError("truncated bytes")
+    return bytes(data[off:off + n]), off + n
+
+
+def write_uvarint(buf: bytearray, v: int) -> None:
+    if v < 0:
+        raise SerializeError("uvarint must be >= 0")
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            buf.append(b | 0x80)
+        else:
+            buf.append(b)
+            return
+
+
+def read_uvarint(data: memoryview, off: int) -> Tuple[int, int]:
+    """Decode a uvarint, rejecting non-minimal (overlong) encodings and
+    values >= 2^64 so every value has exactly one byte representation."""
+    shift = 0
+    result = 0
+    while True:
+        if off >= len(data) or shift > 63:
+            raise SerializeError("truncated/overlong uvarint")
+        b = data[off]
+        off += 1
+        if shift == 63 and b > 1:
+            raise SerializeError("uvarint exceeds 64 bits")
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if b == 0 and shift != 0:
+                raise SerializeError("non-minimal uvarint encoding")
+            return result, off
+        shift += 7
+
+
+# ---------------- typed field codec ----------------
+# Field specs: ("u8"|"u16"|"u32"|"u64"|"bool"|"bytes"|"str"|
+#               ("list", spec) | ("fixed", spec, n) | ("map", kspec, vspec) |
+#               ("opt", spec) | ("msg", cls))
+
+def encode_value(buf: bytearray, spec: Any, v: Any) -> None:
+    if spec == "u8":
+        write_uint(buf, v, 1)
+    elif spec == "u16":
+        write_uint(buf, v, 2)
+    elif spec == "u32":
+        write_uint(buf, v, 4)
+    elif spec == "u64":
+        write_uint(buf, v, 8)
+    elif spec == "i64":
+        if not -(1 << 63) <= v < 1 << 63:
+            raise SerializeError(f"i64 out of range: {v}")
+        write_uint(buf, v & 0xFFFFFFFFFFFFFFFF, 8)
+    elif spec == "bool":
+        buf.append(1 if v else 0)
+    elif spec == "bytes":
+        write_bytes(buf, v)
+    elif spec == "str":
+        write_bytes(buf, v.encode("utf-8"))
+    elif isinstance(spec, tuple):
+        tag = spec[0]
+        if tag == "list":
+            write_uvarint(buf, len(v))
+            for item in v:
+                encode_value(buf, spec[1], item)
+        elif tag == "fixed":
+            if len(v) != spec[2]:
+                raise SerializeError(f"fixed list length {len(v)} != {spec[2]}")
+            for item in v:
+                encode_value(buf, spec[1], item)
+        elif tag == "map":
+            write_uvarint(buf, len(v))
+            for k in sorted(v):
+                encode_value(buf, spec[1], k)
+                encode_value(buf, spec[2], v[k])
+        elif tag == "opt":
+            if v is None:
+                buf.append(0)
+            else:
+                buf.append(1)
+                encode_value(buf, spec[1], v)
+        elif tag == "msg":
+            encode_msg_into(buf, v)
+        else:
+            raise SerializeError(f"bad spec {spec}")
+    else:
+        raise SerializeError(f"bad spec {spec}")
+
+
+def decode_value(data: memoryview, off: int, spec: Any) -> Tuple[Any, int]:
+    if spec == "u8":
+        return read_uint(data, off, 1)
+    if spec == "u16":
+        return read_uint(data, off, 2)
+    if spec == "u32":
+        return read_uint(data, off, 4)
+    if spec == "u64":
+        return read_uint(data, off, 8)
+    if spec == "i64":
+        v, off = read_uint(data, off, 8)
+        return v - (1 << 64) if v >= 1 << 63 else v, off
+    if spec == "bool":
+        v, off = read_uint(data, off, 1)
+        return bool(v), off
+    if spec == "bytes":
+        return read_bytes(data, off)
+    if spec == "str":
+        b, off = read_bytes(data, off)
+        return b.decode("utf-8"), off
+    if isinstance(spec, tuple):
+        tag = spec[0]
+        if tag == "list":
+            n, off = read_uvarint(data, off)
+            out = []
+            for _ in range(n):
+                v, off = decode_value(data, off, spec[1])
+                out.append(v)
+            return out, off
+        if tag == "fixed":
+            out = []
+            for _ in range(spec[2]):
+                v, off = decode_value(data, off, spec[1])
+                out.append(v)
+            return out, off
+        if tag == "map":
+            n, off = read_uvarint(data, off)
+            out = {}
+            for _ in range(n):
+                k, off = decode_value(data, off, spec[1])
+                v, off = decode_value(data, off, spec[2])
+                out[k] = v
+            return out, off
+        if tag == "opt":
+            flag, off = read_uint(data, off, 1)
+            if not flag:
+                return None, off
+            return decode_value(data, off, spec[1])
+        if tag == "msg":
+            return decode_msg_from(data, off, spec[1])
+    raise SerializeError(f"bad spec {spec}")
+
+
+# ---------------- dataclass message codec ----------------
+# A serializable message is a dataclass with a class attr SPEC:
+#   SPEC = [("field_name", spec), ...]  in canonical field order.
+
+def encode_msg_into(buf: bytearray, msg: Any) -> None:
+    if not is_dataclass(msg):
+        raise SerializeError(f"not a message: {msg!r}")
+    for name, spec in type(msg).SPEC:
+        encode_value(buf, spec, getattr(msg, name))
+
+
+def encode_msg(msg: Any) -> bytes:
+    buf = bytearray()
+    encode_msg_into(buf, msg)
+    return bytes(buf)
+
+
+def decode_msg_from(data: memoryview, off: int, cls: Type) -> Tuple[Any, int]:
+    kwargs = {}
+    for name, spec in cls.SPEC:
+        v, off = decode_value(data, off, spec)
+        kwargs[name] = v
+    return cls(**kwargs), off
+
+
+def decode_msg(data: bytes, cls: Type) -> Any:
+    msg, off = decode_msg_from(memoryview(data), 0, cls)
+    if off != len(data):
+        raise SerializeError(f"{cls.__name__}: {len(data)-off} trailing bytes")
+    return msg
