@@ -1,8 +1,10 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any `import jax` (pytest imports conftest first). Multi-chip
-sharding tests run on these virtual devices; the driver separately validates
-the multi-chip path via __graft_entry__.dryrun_multichip.
+Runs before test modules import jax. NOTE: on this box the JAX_PLATFORMS
+env var alone makes device init hang (axon TPU plugin interaction) —
+jax.config.update('jax_platforms', 'cpu') is the reliable path, so we do
+both. Multi-chip sharding tests run on the 8 virtual CPU devices; the driver
+separately validates the real multi-chip path via __graft_entry__.
 """
 import os
 
@@ -10,3 +12,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,9 @@
+"""TPU data-plane kernels (JAX/XLA/Pallas).
+
+The rebuild of the reference's native crypto hot path (threshsign/src/bls/relic,
+util crypto_utils — SURVEY.md §2.2/2.3) as batched array programs:
+  field.py     — big-integer modular arithmetic engine (Montgomery, limb vectors)
+  ed25519.py   — batched Ed25519 verification
+  ecdsa.py     — batched ECDSA (secp256k1 / P-256) verification
+  bls12_381.py — G1 arithmetic, Lagrange coefficients, MSM, share combine
+"""
