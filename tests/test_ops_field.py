@@ -91,3 +91,40 @@ def test_canonical_negative_values(field):
     # these are Montgomery-form values; compare in the Montgomery domain
     want = (x * f.R - y * f.R) % f.p
     assert limbs_to_int(np.asarray(Z)[:, 0]) == want
+
+
+def test_mul_with_negative_value_inputs(field):
+    """Regression: REDC of negative-value inputs (sub chains) was off by one
+    when the reduced result landed in (-p, 0)."""
+    f = field
+    rng = random.Random(9)
+
+    @jax.jit
+    def kernel(X, Y, Z):
+        d = f.sub(X, Y)          # value in (-p, p)
+        return f.mul(d, Z), f.mul(d, d)
+
+    xs = [rng.randrange(f.p) for _ in range(64)]
+    ys = [rng.randrange(f.p) for _ in range(64)]
+    zs = [rng.randrange(f.p) for _ in range(64)]
+    M, S = kernel(_batch(f, xs), _batch(f, ys), _batch(f, zs))
+    for i in range(64):
+        d = (xs[i] - ys[i]) % f.p
+        assert f.to_int(np.asarray(M)[:, i]) == d * zs[i] % f.p
+        assert f.to_int(np.asarray(S)[:, i]) == d * d % f.p
+
+
+def test_norm_preserves_negative_values(field):
+    """Regression: norm() dropped the top-limb carry, corrupting elements
+    whose integer value is negative (sub results)."""
+    f = field
+
+    @jax.jit
+    def kernel(X, Y):
+        d = f.norm(f.sub(X, Y))            # negative value through norm
+        return f.mul(d, f.one((X.shape[1],)))
+
+    xs, ys = [1, 5, 0], [f.p - 1, 7, f.p - 1]
+    Z = kernel(_batch(f, xs), _batch(f, ys))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert f.to_int(np.asarray(Z)[:, i]) == (x - y) % f.p

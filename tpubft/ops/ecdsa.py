@@ -1,0 +1,129 @@
+"""Batched ECDSA verification (secp256k1 / P-256) as a JAX kernel.
+
+Rebuild of the reference's per-message ECDSA verify path
+(util/include/crypto_utils.hpp:57-73 ECDSAVerifier, Crypto++) as a batched
+kernel: host computes the hash e and the scalars u1 = e/s, u2 = r/s mod n
+(cheap modular ops on python ints); the device runs the Shamir ladder
+R' = [u1]G + [u2]Q and checks x(R') ≡ r (mod n).
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpubft.ops.field import get_field, int_to_limbs
+from tpubft.ops.weierstrass import Curve
+
+CURVES = {
+    "secp256k1": dict(
+        p=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F,
+        a=0, b=7,
+        gx=0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+        gy=0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+        n=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141),
+    "secp256r1": dict(
+        p=0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF,
+        a=-3, b=0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B,
+        gx=0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296,
+        gy=0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5,
+        n=0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def get_curve(name: str) -> Curve:
+    c = CURVES[name]
+    return Curve(get_field(c["p"]), c["a"], c["b"], c["gx"], c["gy"], c["n"])
+
+
+class PreparedEcdsaBatch(NamedTuple):
+    u1_bits: np.ndarray   # (256, B)
+    u2_bits: np.ndarray
+    qx: np.ndarray        # (NL, B) Montgomery
+    qy: np.ndarray
+    r_raw: np.ndarray     # (NL, B) tight non-Montgomery, r mod p for compare
+    r_plus_n_raw: np.ndarray  # (NL, B) r+n (or invalid sentinel) for the wrap case
+    host_valid: np.ndarray
+
+
+def _bits_msb(x: int, nbits: int = 256) -> np.ndarray:
+    return np.array([(x >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+                    dtype=np.int32)
+
+
+def prepare_batch(curve_name: str,
+                  items: Sequence[Tuple[bytes, bytes, bytes]]) -> PreparedEcdsaBatch:
+    """items: (message, raw_sig r||s 64B, pubkey SEC1-uncompressed 65B)."""
+    cv = get_curve(curve_name)
+    p, n = cv.f.p, cv.order
+    nl = cv.f.nl
+    B = len(items)
+    u1b = np.zeros((256, B), np.int32)
+    u2b = np.zeros((256, B), np.int32)
+    qx = np.zeros((nl, B), np.int32)
+    qy = np.zeros((nl, B), np.int32)
+    r_raw = np.zeros((nl, B), np.int32)
+    rpn_raw = np.zeros((nl, B), np.int32)
+    valid = np.zeros(B, bool)
+    for i, (msg, sig, pk) in enumerate(items):
+        if len(sig) != 64 or len(pk) != 65 or pk[0] != 0x04:
+            continue
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        x = int.from_bytes(pk[1:33], "big")
+        y = int.from_bytes(pk[33:], "big")
+        if not (0 < r < n and 0 < s < n and x < p and y < p):
+            continue
+        if (y * y - (x * x * x + cv.a * x + cv.b)) % p != 0:
+            continue
+        e = int.from_bytes(hashlib.sha256(msg).digest(), "big") % n
+        w = pow(s, -1, n)
+        u1 = e * w % n
+        u2 = r * w % n
+        valid[i] = True
+        u1b[:, i] = _bits_msb(u1)
+        u2b[:, i] = _bits_msb(u2)
+        qx[:, i] = cv.f.from_int(x)
+        qy[:, i] = cv.f.from_int(y)
+        r_raw[:, i] = int_to_limbs(r, nl)
+        # ECDSA accepts x(R') = r + n when r + n < p (wrap case)
+        rpn = r + n if r + n < p else p  # p is never an affine x => no match
+        rpn_raw[:, i] = int_to_limbs(rpn, nl)
+    return PreparedEcdsaBatch(u1b, u2b, qx, qy, r_raw, rpn_raw, valid)
+
+
+def make_verify_kernel(curve_name: str):
+    cv = get_curve(curve_name)
+
+    @jax.jit
+    def kernel(u1_bits, u2_bits, qx, qy, r_raw, r_plus_n_raw):
+        batch = qx.shape[1:]
+        q = cv.from_affine(qx, qy)
+        g = cv.generator(batch)
+        rp = cv.double_scalar_mul_bits(u1_bits, g, u2_bits, q)
+        x_aff, _, is_id = cv.to_affine(rp)
+        match = jnp.logical_or(jnp.all(x_aff == r_raw, axis=0),
+                               jnp.all(x_aff == r_plus_n_raw, axis=0))
+        return jnp.logical_and(match, jnp.logical_not(is_id))
+
+    return kernel
+
+
+_KERNELS = {}
+
+
+def verify_batch(curve_name: str,
+                 items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    if not items:
+        return np.zeros(0, bool)
+    if curve_name not in _KERNELS:
+        _KERNELS[curve_name] = make_verify_kernel(curve_name)
+    prep = prepare_batch(curve_name, items)
+    out = _KERNELS[curve_name](prep.u1_bits, prep.u2_bits, prep.qx, prep.qy,
+                               prep.r_raw, prep.r_plus_n_raw)
+    return np.asarray(out) & prep.host_valid

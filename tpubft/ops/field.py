@@ -116,12 +116,15 @@ class Field:
 
     def norm(self, a):
         """Two parallel carry passes: restores |limb| <= 2^11 + eps from
-        |limb| <= 2^12-ish inputs, preserving value. Not exact for huge limbs
-        (use _carry_scan for that)."""
+        |limb| <= 2^12-ish inputs, preserving value exactly. The TOP limb is
+        never split (a negative value lives in a negative top limb; masking
+        it would drop the sign carry), so the top limb absorbs carries
+        unmasked — bounded because every mul() re-canonicalizes."""
         for _ in range(2):
             lo = a & LIMB_MASK
             hi = a >> LIMB_BITS
-            a = lo + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+            a = (jnp.concatenate([lo[:-1], a[-1:]], axis=0)
+                 + jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0))
         return a
 
     def _carry_scan(self, a, out_limbs: Optional[int] = None):
@@ -149,7 +152,12 @@ class Field:
 
     # ---------- Montgomery multiplication (CIOS, lazy carries) ----------
     def mul(self, a, b):
-        """mont_mul: returns a*b*R^-1 mod p, limbs tight, value < 2p."""
+        """mont_mul: a*b*R^-1 mod p, output canonical [0, p) tight limbs.
+
+        Input contract: |limb| <= 2^12 and |integer value| <= c*p with
+        c^2 * p < R (c ~ a few hundred; add/sub chains of canonical values
+        stay far below). Values may be NEGATIVE (sub results) — REDC then
+        lands in (-p, 2p], handled by the +p offset below."""
         p_l = jnp.asarray(self.p_limbs).reshape((-1,) + (1,) * (a.ndim - 1))
         pinv = jnp.int32(self.pinv)
 
@@ -167,11 +175,13 @@ class Field:
 
         t0 = jnp.zeros_like(b)
         t, _ = jax.lax.scan(step, t0, a, unroll=4)
+        # REDC of inputs with |value| <= c*p (c^2*p < R) yields t in (-p, 2p]:
+        # sub chains make element values negative, so offset by +p before the
+        # exact carry resolution, then reduce [0, 3p) -> [0, p).
+        t = t + jnp.asarray(self.p_limbs).reshape((-1,) + (1,) * (t.ndim - 1))
         tight, carry = self._carry_scan(t)
-        # value < 2p < 2^(11*nl) since nl has a headroom limb => carry == 0
-        res = tight
-        # conditional subtract p -> canonical [0, p)
-        return self._cond_sub_p(res)
+        res = self._cond_sub_p(self._cond_sub_p(tight))
+        return res
 
     def _cond_sub_p(self, a):
         p_l = jnp.asarray(self.p_limbs).reshape((-1,) + (1,) * (a.ndim - 1))

@@ -1,0 +1,10 @@
+"""Device-mesh parallelism for the crypto data plane.
+
+The reference scales quorum collection with collector threads + threshold
+signatures (SURVEY.md §2.10); the TPU build scales the *verification batch*
+across chips: shard_map over a jax.sharding.Mesh with XLA collectives over
+ICI. This package is the distributed backend of the data plane — the
+host-side replica mesh (DCN) lives in tpubft.comm.
+"""
+from tpubft.parallel.sharding import (  # noqa: F401
+    make_mesh, sharded_msm_kernel, sharded_verify_ed25519)
