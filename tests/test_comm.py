@@ -1,0 +1,152 @@
+"""Communication-layer tests: loopback bus, UDP, TCP transports."""
+import socket
+import threading
+import time
+
+from tpubft.comm import (CommConfig, LoopbackBus, PlainTcpCommunication,
+                         PlainUdpCommunication)
+from tpubft.comm.interfaces import IReceiver
+
+
+class Collector(IReceiver):
+    def __init__(self):
+        self.msgs = []
+        self.evt = threading.Event()
+        self.lock = threading.Lock()
+
+    def on_new_message(self, sender, data):
+        with self.lock:
+            self.msgs.append((sender, data))
+        self.evt.set()
+
+    def wait_for(self, n, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self.lock:
+                if len(self.msgs) >= n:
+                    return True
+            time.sleep(0.01)
+        return False
+
+
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_loopback_send_and_broadcast():
+    bus = LoopbackBus()
+    comms = {i: bus.create(i) for i in range(4)}
+    rxs = {i: Collector() for i in range(4)}
+    for i in range(4):
+        comms[i].start(rxs[i])
+    comms[0].send(1, b"hello")
+    assert rxs[1].wait_for(1)
+    assert rxs[1].msgs == [(0, b"hello")]
+    comms[1].broadcast([0, 2, 3], b"bcast")
+    for i in (0, 2, 3):
+        assert rxs[i].wait_for(1)
+        assert rxs[i].msgs[-1] == (1, b"bcast")
+    bus.shutdown()
+
+
+def test_loopback_byzantine_hooks_drop_and_mutate():
+    bus = LoopbackBus()
+    a, b = bus.create(0), bus.create(1)
+    rx = Collector()
+    a.start(Collector())
+    b.start(rx)
+    bus.add_hook(lambda s, d, m: None if m == b"drop-me" else m)
+    bus.add_hook(lambda s, d, m: m.replace(b"x", b"y"))
+    a.send(1, b"drop-me")
+    a.send(1, b"xx-keep")
+    assert rx.wait_for(1)
+    time.sleep(0.05)
+    assert rx.msgs == [(0, b"yy-keep")]
+    bus.shutdown()
+
+
+def test_udp_roundtrip():
+    p0, p1 = free_ports(2)
+    eps = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    c0 = PlainUdpCommunication(CommConfig(self_id=0, endpoints=eps))
+    c1 = PlainUdpCommunication(CommConfig(self_id=1, endpoints=eps))
+    r0, r1 = Collector(), Collector()
+    c0.start(r0)
+    c1.start(r1)
+    try:
+        c0.send(1, b"ping")
+        assert r1.wait_for(1)
+        assert r1.msgs == [(0, b"ping")]
+        c1.send(0, b"pong" * 1000)
+        assert r0.wait_for(1)
+        assert r0.msgs == [(1, b"pong" * 1000)]
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_udp_oversize_dropped():
+    p0, p1 = free_ports(2)
+    eps = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    c0 = PlainUdpCommunication(CommConfig(self_id=0, endpoints=eps))
+    c1 = PlainUdpCommunication(CommConfig(self_id=1, endpoints=eps))
+    r1 = Collector()
+    c0.start(Collector())
+    c1.start(r1)
+    try:
+        c0.send(1, b"z" * (c0.max_message_size + 1))
+        c0.send(1, b"ok")
+        assert r1.wait_for(1)
+        assert r1.msgs == [(0, b"ok")]
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_tcp_roundtrip_and_large_message():
+    p0, p1 = free_ports(2)
+    eps = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    c0 = PlainTcpCommunication(CommConfig(self_id=0, endpoints=eps))
+    c1 = PlainTcpCommunication(CommConfig(self_id=1, endpoints=eps))
+    r0, r1 = Collector(), Collector()
+    c0.start(r0)
+    c1.start(r1)
+    try:
+        big = bytes(range(256)) * 500  # 128 KB > UDP limit
+        cfg_big = b"first"
+        c0.send(1, cfg_big)
+        assert r1.wait_for(1)
+        assert r1.msgs == [(0, b"first")]
+        # reply flows over the same accepted connection
+        c1.send(0, big[:60000])
+        assert r0.wait_for(1)
+        assert r0.msgs[0] == (1, big[:60000])
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_tcp_many_messages_in_order():
+    p0, p1 = free_ports(2)
+    eps = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    c0 = PlainTcpCommunication(CommConfig(self_id=0, endpoints=eps))
+    c1 = PlainTcpCommunication(CommConfig(self_id=1, endpoints=eps))
+    r1 = Collector()
+    c0.start(Collector())
+    c1.start(r1)
+    try:
+        for i in range(100):
+            c0.send(1, b"m%03d" % i)
+        assert r1.wait_for(100)
+        assert [d for _, d in r1.msgs] == [b"m%03d" % i for i in range(100)]
+    finally:
+        c0.stop()
+        c1.stop()

@@ -1,0 +1,277 @@
+"""Plain TCP transport with length-prefixed framing.
+
+Rebuild of communication/src/PlainTcpCommunication.cpp: persistent
+connections, 4-byte LE length prefix per message, an id handshake on
+connect so the acceptor learns the peer's NodeNum, per-peer write queues
+drained by a writer thread (the reference's ASIO write queue), lazy
+reconnect. One connection per pair: the higher-id node dials, the lower-id
+node accepts (the reference connection manager's convention), so
+simultaneous first-sends cannot race into crossed half-open connections.
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+from tpubft.comm.interfaces import (CommConfig, ConnectionStatus,
+                                    ICommunication, IReceiver, NodeNum)
+
+_LEN = struct.Struct("<I")
+_ID = struct.Struct("<I")
+_SEND_DEADLINE_S = 3.0   # per-message connect+write budget before dropping
+_HANDSHAKE_DEADLINE_S = 2.0
+
+
+class _Peer:
+    def __init__(self, comm: "PlainTcpCommunication", node: NodeNum):
+        self.comm = comm
+        self.node = node
+        self.sock: Optional[socket.socket] = None
+        self.q: "queue.Queue[Optional[bytes]]" = queue.Queue(maxsize=4096)
+        self.lock = threading.Lock()
+        self.writer = threading.Thread(target=self._write_loop, daemon=True,
+                                       name=f"tcp-write-{self.node}")
+        self.writer.start()
+        self.reader: Optional[threading.Thread] = None
+
+    def attach(self, sock: socket.socket) -> None:
+        with self.lock:
+            if self.sock is not None:
+                # Duplicate connection (e.g. stale leg not yet detected
+                # dead): keep the existing one, refuse the newcomer.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            sock.settimeout(None)  # blocking I/O; close() unblocks threads
+            self.sock = sock
+        self.reader = threading.Thread(target=self._read_loop, daemon=True,
+                                       name=f"tcp-read-{self.node}")
+        self.reader.start()
+        self.comm._notify(self.node, ConnectionStatus.CONNECTED)
+
+    def detach(self) -> None:
+        with self.lock:
+            s, self.sock = self.sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+            self.comm._notify(self.node, ConnectionStatus.DISCONNECTED)
+
+    def enqueue(self, data: bytes) -> None:
+        try:
+            self.q.put_nowait(data)
+        except queue.Full:
+            pass  # backpressure: drop, like the reference's bounded queues
+
+    def _write_loop(self) -> None:
+        while self.comm.is_running():
+            try:
+                data = self.q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if data is None:
+                return
+            deadline = time.monotonic() + _SEND_DEADLINE_S
+            while self.comm.is_running() and time.monotonic() < deadline:
+                sock = self.sock
+                if sock is None:
+                    # the connector thread (or the peer's) re-establishes
+                    time.sleep(0.02)
+                    continue
+                try:
+                    sock.sendall(_LEN.pack(len(data)) + data)
+                except OSError:
+                    self.detach()
+                    continue
+                break
+            # deadline expired with no connection: message dropped
+
+    def _read_loop(self) -> None:
+        while self.comm.is_running():
+            sock = self.sock
+            if sock is None:
+                return
+            hdr = _recv_exact(sock, _LEN.size)
+            if hdr is None:
+                self.detach()
+                return
+            (n,) = _LEN.unpack(hdr)
+            if n > self.comm._cfg.max_message_size:
+                self.detach()
+                return
+            body = _recv_exact(sock, n)
+            if body is None:
+                self.detach()
+                return
+            self.comm._deliver(self.node, body)
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        if deadline is not None and time.monotonic() > deadline:
+            return None
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            continue
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class PlainTcpCommunication(ICommunication):
+    def __init__(self, config: CommConfig):
+        self._cfg = config
+        self._receiver: Optional[IReceiver] = None
+        self._running = False
+        self._peers: Dict[NodeNum, _Peer] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._connect_thread: Optional[threading.Thread] = None
+
+    # ---- ICommunication ----
+
+    def start(self, receiver: IReceiver) -> None:
+        if self._running:
+            return
+        self._receiver = receiver
+        self._running = True
+        host, port = self._cfg.endpoints[self._cfg.self_id]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(64)
+        srv.settimeout(0.2)
+        self._server = srv
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"tcp-accept-{self._cfg.self_id}")
+        self._accept_thread.start()
+        self._connect_thread = threading.Thread(
+            target=self._connect_loop, daemon=True,
+            name=f"tcp-connect-{self._cfg.self_id}")
+        self._connect_thread.start()
+
+    def stop(self) -> None:
+        # Graceful: give writer threads a moment to drain queued sends
+        # (the reference drains its ASIO write queues on shutdown).
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                pending = any(not p.q.empty() for p in self._peers.values())
+            if not pending:
+                break
+            time.sleep(0.02)
+        self._running = False
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+            self._accept_thread = None
+        if self._connect_thread is not None:
+            self._connect_thread.join(timeout=5)
+            self._connect_thread = None
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        with self._lock:
+            peers, self._peers = list(self._peers.values()), {}
+        for p in peers:
+            p.detach()
+
+    def is_running(self) -> bool:
+        return self._running
+
+    def send(self, dest: NodeNum, data: bytes) -> None:
+        if not self._running or dest not in self._cfg.endpoints:
+            return
+        self._peer(dest).enqueue(data)
+
+    def get_connection_status(self, node: NodeNum) -> ConnectionStatus:
+        with self._lock:
+            p = self._peers.get(node)
+        if p is None:
+            return ConnectionStatus.UNKNOWN
+        return (ConnectionStatus.CONNECTED if p.sock is not None
+                else ConnectionStatus.DISCONNECTED)
+
+    @property
+    def max_message_size(self) -> int:
+        return self._cfg.max_message_size
+
+    # ---- internals ----
+
+    def _dials(self, node: NodeNum) -> bool:
+        """This side initiates iff it has the higher id."""
+        return self._cfg.self_id > node
+
+    def _connect_loop(self) -> None:
+        """Proactively establish + maintain connections to all lower-id
+        peers (the reference maintains the full mesh from startup; the
+        lower-id side is the server)."""
+        while self._running:
+            for node in self._cfg.endpoints:
+                if not self._running:
+                    return
+                if self._dials(node) and self._peer(node).sock is None:
+                    self._dial(node)
+            time.sleep(0.25)
+
+    def _peer(self, node: NodeNum) -> _Peer:
+        with self._lock:
+            p = self._peers.get(node)
+            if p is None:
+                p = self._peers[node] = _Peer(self, node)
+        return p
+
+    def _dial(self, node: NodeNum) -> None:
+        addr = self._cfg.endpoints.get(node)
+        if addr is None:
+            return
+        try:
+            sock = socket.create_connection(addr, timeout=1.0)
+            sock.sendall(_ID.pack(self._cfg.self_id))
+        except OSError:
+            return
+        self._peer(node).attach(sock)
+
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while self._running:
+            try:
+                sock, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            sock.settimeout(0.2)
+            hdr = _recv_exact(sock, _ID.size,
+                              time.monotonic() + _HANDSHAKE_DEADLINE_S)
+            if hdr is None:
+                sock.close()
+                continue
+            (peer_id,) = _ID.unpack(hdr)
+            if peer_id not in self._cfg.endpoints or peer_id == self._cfg.self_id:
+                sock.close()  # unknown/spoofed id: refuse
+                continue
+            self._peer(peer_id).attach(sock)
+
+    def _deliver(self, sender: NodeNum, data: bytes) -> None:
+        if self._running and self._receiver is not None:
+            self._receiver.on_new_message(sender, data)
+
+    def _notify(self, node: NodeNum, status: ConnectionStatus) -> None:
+        if self._receiver is not None:
+            self._receiver.on_connection_status_changed(node, status)

