@@ -114,21 +114,29 @@ def test_udp_oversize_dropped():
 def test_tcp_roundtrip_and_large_message():
     p0, p1 = free_ports(2)
     eps = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
-    c0 = PlainTcpCommunication(CommConfig(self_id=0, endpoints=eps))
-    c1 = PlainTcpCommunication(CommConfig(self_id=1, endpoints=eps))
+    big_cap = 256 * 1024
+    c0 = PlainTcpCommunication(
+        CommConfig(self_id=0, endpoints=eps, max_message_size=big_cap))
+    c1 = PlainTcpCommunication(
+        CommConfig(self_id=1, endpoints=eps, max_message_size=big_cap))
     r0, r1 = Collector(), Collector()
     c0.start(r0)
     c1.start(r1)
     try:
-        big = bytes(range(256)) * 500  # 128 KB > UDP limit
-        cfg_big = b"first"
-        c0.send(1, cfg_big)
+        big = bytes(range(256)) * 512  # 128 KiB — far above the UDP limit
+        c0.send(1, b"first")
         assert r1.wait_for(1)
         assert r1.msgs == [(0, b"first")]
-        # reply flows over the same accepted connection
-        c1.send(0, big[:60000])
+        # reply flows over the same accepted connection, framed
+        c1.send(0, big)
         assert r0.wait_for(1)
-        assert r0.msgs[0] == (1, big[:60000])
+        assert r0.msgs[0] == (1, big)
+        # oversize beyond the configured cap is dropped without breaking
+        # the connection
+        c1.send(0, b"z" * (big_cap + 1))
+        c1.send(0, b"after-oversize")
+        assert r0.wait_for(2)
+        assert r0.msgs[1] == (1, b"after-oversize")
     finally:
         c0.stop()
         c1.stop()

@@ -1,0 +1,132 @@
+"""Wire-message round-trip + validation tests (reference: bftengine/tests
+message suites, e.g. PrePrepareMsg_test.cpp, ViewChangeMsg_test.cpp)."""
+import pytest
+
+from tpubft.consensus import messages as m
+
+
+def rt(msg):
+    """pack → unpack round trip; asserts equality and returns the copy."""
+    out = m.unpack(msg.pack())
+    assert out == msg
+    return out
+
+
+def make_request(i=0, client=7, payload=b"set x=1"):
+    return m.ClientRequestMsg(sender_id=client, req_seq_num=100 + i, flags=0,
+                              request=payload, cid=f"cid-{i}",
+                              signature=b"\x01" * 64)
+
+
+def test_client_request_roundtrip_and_digest():
+    req = rt(make_request())
+    assert req.digest() == make_request().digest()
+    assert req.digest() != make_request(payload=b"set x=2").digest()
+
+
+def test_client_request_signed_payload_excludes_signature():
+    a = make_request()
+    b = make_request()
+    b.signature = b"\x02" * 64
+    assert a.signed_payload() == b.signed_payload()
+    assert a.pack() != b.pack()
+
+
+def test_empty_write_request_rejected():
+    bad = m.ClientRequestMsg(sender_id=1, req_seq_num=1, flags=0, request=b"",
+                             cid="", signature=b"s")
+    with pytest.raises(m.MsgError):
+        m.unpack(bad.pack())
+    ro = m.ClientRequestMsg(sender_id=1, req_seq_num=1,
+                            flags=int(m.RequestFlag.READ_ONLY), request=b"",
+                            cid="", signature=b"s")
+    rt(ro)
+
+
+def test_preprepare_roundtrip_and_digest_check():
+    reqs = [make_request(i).pack() for i in range(3)]
+    pp = m.PrePrepareMsg(sender_id=0, view=1, seq_num=5,
+                         first_path=int(m.CommitPath.SLOW), time=123456,
+                         requests_digest=m.PrePrepareMsg.compute_requests_digest(reqs),
+                         requests=reqs, signature=b"sig")
+    out = rt(pp)
+    assert [r.req_seq_num for r in out.client_requests()] == [100, 101, 102]
+    # tampering with the batch must break validate()
+    pp.requests = pp.requests[:-1]
+    with pytest.raises(m.MsgError):
+        m.unpack(pp.pack())
+
+
+def test_commit_digest_depends_on_view_seq_and_pp():
+    d = m.commit_digest(1, 2, b"\xaa" * 32)
+    assert d != m.commit_digest(1, 3, b"\xaa" * 32)
+    assert d != m.commit_digest(2, 2, b"\xaa" * 32)
+    assert d != m.commit_digest(1, 2, b"\xbb" * 32)
+
+
+def test_signed_share_messages():
+    for cls in (m.PreparePartialMsg, m.PrepareFullMsg, m.CommitPartialMsg,
+                m.CommitFullMsg, m.FullCommitProofMsg):
+        msg = cls(sender_id=2, view=1, seq_num=9, digest=b"\xcd" * 32,
+                  sig=b"share-bytes")
+        rt(msg)
+    bad = m.PreparePartialMsg(sender_id=2, view=1, seq_num=9,
+                              digest=b"short", sig=b"s")
+    with pytest.raises(m.MsgError):
+        m.unpack(bad.pack())
+
+
+def test_partial_commit_proof_has_path():
+    msg = m.PartialCommitProofMsg(
+        sender_id=3, view=0, seq_num=1, digest=b"\x11" * 32, sig=b"s",
+        path=int(m.CommitPath.FAST_WITH_THRESHOLD))
+    assert rt(msg).path == 1
+    msg.path = 2  # SLOW is not a fast path
+    with pytest.raises(m.MsgError):
+        m.unpack(msg.pack())
+
+
+def test_checkpoint_ack_status_roundtrip():
+    rt(m.CheckpointMsg(sender_id=1, seq_num=150, state_digest=b"\x22" * 32,
+                       is_stable=False, signature=b"sig"))
+    rt(m.SimpleAckMsg(sender_id=1, seq_num=5, view=0,
+                      acked_msg_code=int(m.MsgCode.PrePrepare)))
+    rt(m.ReplicaStatusMsg(sender_id=2, view=3, last_stable_seq=150,
+                          last_executed_seq=162, in_view_change=False))
+    rt(m.ReqMissingDataMsg(sender_id=0, view=1, seq_num=7, missing=0b101))
+    rt(m.StateTransferMsg(sender_id=1, payload=b"\x00" * 100))
+
+
+def test_view_change_new_view_roundtrip():
+    cert = m.PreparedCertificate(seq_num=4, view=0, pp_digest=b"\x33" * 32,
+                                 combined_sig=b"combined",
+                                 pre_prepare=b"packed-pp")
+    vc = m.ViewChangeMsg(sender_id=2, new_view=1, last_stable_seq=0,
+                         prepared=[cert], signature=b"sig")
+    out = rt(vc)
+    assert out.prepared[0].seq_num == 4
+    assert vc.digest() == out.digest()
+
+    nv = m.NewViewMsg(sender_id=1, new_view=1,
+                      view_change_digests=[m.ReplicaDigest(0, b"\x44" * 32),
+                                           m.ReplicaDigest(2, b"\x55" * 32)],
+                      signature=b"sig")
+    assert rt(nv).view_change_digests[0].replica == 0
+
+
+def test_unknown_code_and_truncation_rejected():
+    with pytest.raises(m.MsgError):
+        m.unpack(b"\xff\x7f")
+    with pytest.raises(m.MsgError):
+        m.unpack(b"\x01")
+    good = make_request().pack()
+    with pytest.raises(m.MsgError):
+        m.unpack(good[:-3])
+    with pytest.raises(m.MsgError):
+        m.unpack(good + b"\x00")  # trailing garbage
+
+
+def test_all_codes_unique_and_registered():
+    assert len(m._REGISTRY) == len(set(m._REGISTRY))
+    for code, cls in m._REGISTRY.items():
+        assert int(cls.CODE) == code

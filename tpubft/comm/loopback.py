@@ -5,9 +5,11 @@ fake_comm.h (in-process ICommunication delivering to behavior callbacks) and
 of tests/simpleKVBC/TesterReplica/WrapCommunication.cpp (drop/mutate hooks
 for byzantine strategies).
 
-Delivery is performed on a single bus thread so receivers see the same
-single-threaded upcall discipline real transports provide, and so tests get
-deterministic per-message ordering per destination.
+Delivery is performed on a single bus thread, which gives tests
+deterministic per-message ordering per destination. NOTE: real transports
+do NOT guarantee serialized upcalls (TCP delivers from one reader thread
+per peer) — receivers must be thread-safe; the replica's incoming-message
+queue (the reference's IncomingMsgsStorage) provides the serialization.
 """
 from __future__ import annotations
 

@@ -39,24 +39,34 @@ class _Peer:
         self.reader: Optional[threading.Thread] = None
 
     def attach(self, sock: socket.socket) -> None:
+        # Newest connection wins: a fresh inbound leg from an authenticated
+        # peer replaces a possibly-dead stale socket (a partitioned peer
+        # leaves no FIN behind; without this, redials would be refused
+        # forever). Closing the old socket unblocks its reader, whose
+        # detach(old) is a no-op because self.sock has moved on.
+        sock.settimeout(None)  # blocking I/O; close() unblocks threads
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        except OSError:
+            pass
         with self.lock:
-            if self.sock is not None:
-                # Duplicate connection (e.g. stale leg not yet detected
-                # dead): keep the existing one, refuse the newcomer.
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                return
-            sock.settimeout(None)  # blocking I/O; close() unblocks threads
-            self.sock = sock
+            old, self.sock = self.sock, sock
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
         self.reader = threading.Thread(target=self._read_loop, daemon=True,
                                        name=f"tcp-read-{self.node}")
         self.reader.start()
         self.comm._notify(self.node, ConnectionStatus.CONNECTED)
 
-    def detach(self) -> None:
+    def detach(self, sock: Optional[socket.socket] = None) -> None:
+        """Tear down `sock` (or whatever is current). A reader/writer that
+        lost a replaced socket must not clobber the replacement."""
         with self.lock:
+            if sock is not None and self.sock is not sock:
+                return  # already replaced by a newer connection
             s, self.sock = self.sock, None
         if s is not None:
             try:
@@ -89,27 +99,27 @@ class _Peer:
                 try:
                     sock.sendall(_LEN.pack(len(data)) + data)
                 except OSError:
-                    self.detach()
+                    self.detach(sock)
                     continue
                 break
             # deadline expired with no connection: message dropped
 
     def _read_loop(self) -> None:
+        sock = self.sock
         while self.comm.is_running():
-            sock = self.sock
-            if sock is None:
-                return
+            if sock is None or self.sock is not sock:
+                return  # replaced: the new socket has its own reader
             hdr = _recv_exact(sock, _LEN.size)
             if hdr is None:
-                self.detach()
+                self.detach(sock)
                 return
             (n,) = _LEN.unpack(hdr)
             if n > self.comm._cfg.max_message_size:
-                self.detach()
+                self.detach(sock)
                 return
             body = _recv_exact(sock, n)
             if body is None:
-                self.detach()
+                self.detach(sock)
                 return
             self.comm._deliver(self.node, body)
 
@@ -197,6 +207,8 @@ class PlainTcpCommunication(ICommunication):
     def send(self, dest: NodeNum, data: bytes) -> None:
         if not self._running or dest not in self._cfg.endpoints:
             return
+        if len(data) > self._cfg.max_message_size:
+            return  # oversize: drop here instead of poisoning the connection
         self._peer(dest).enqueue(data)
 
     def get_connection_status(self, node: NodeNum) -> ConnectionStatus:

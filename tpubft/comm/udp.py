@@ -27,13 +27,12 @@ class PlainUdpCommunication(ICommunication):
         self._thread: Optional[threading.Thread] = None
         self._receiver: Optional[IReceiver] = None
         self._running = False
-        self._addr_of: Dict[NodeNum, Tuple[str, int]] = dict(config.endpoints)
 
     def start(self, receiver: IReceiver) -> None:
         if self._running:
             return
         self._receiver = receiver
-        host, port = self._addr_of[self._cfg.self_id]
+        host, port = self._cfg.endpoints[self._cfg.self_id]
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
                               self._cfg.buffer_capacity)
@@ -66,7 +65,7 @@ class PlainUdpCommunication(ICommunication):
             return
         if len(data) > self.max_message_size:
             return  # oversize datagram: dropped (reference logs + drops)
-        addr = self._addr_of.get(dest)
+        addr = self._cfg.endpoints.get(dest)
         if addr is None:
             return
         pkt = self._cfg.self_id.to_bytes(_HDR, "little") + data
@@ -76,7 +75,7 @@ class PlainUdpCommunication(ICommunication):
             pass  # best-effort, like UDP itself
 
     def get_connection_status(self, node: NodeNum) -> ConnectionStatus:
-        return (ConnectionStatus.CONNECTED if node in self._addr_of
+        return (ConnectionStatus.CONNECTED if node in self._cfg.endpoints
                 else ConnectionStatus.UNKNOWN)
 
     def _recv_loop(self) -> None:
@@ -91,7 +90,7 @@ class PlainUdpCommunication(ICommunication):
             if len(pkt) < _HDR:
                 continue
             sender = int.from_bytes(pkt[:_HDR], "little")
-            if sender not in self._addr_of or sender == self._cfg.self_id:
+            if sender not in self._cfg.endpoints or sender == self._cfg.self_id:
                 continue  # unknown/spoofed sender id: drop
             if self._receiver is not None:
                 self._receiver.on_new_message(sender, pkt[_HDR:])

@@ -1,0 +1,7 @@
+"""Consensus engine — SBFT state machine replication.
+
+Rebuild of /root/reference/bftengine/: wire messages, replica state
+machine (3 commit paths), threshold-signature collectors, view change,
+checkpointing, persistent metadata. The signature hot paths are batched
+behind the crypto plugin seams so the TPU backend can vectorize them.
+"""

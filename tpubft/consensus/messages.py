@@ -1,0 +1,476 @@
+"""Consensus wire messages.
+
+Rebuild of /root/reference/bftengine/src/bftengine/messages/ (MsgCode.hpp:24,
+MessageBase.hpp, PrePrepareMsg.hpp:33-53, SignedShareMsgs.hpp,
+FullCommitProofMsg.hpp, CheckpointMsg.hpp, ViewChangeMsg.hpp, …).
+
+Instead of hand-packed C structs, every message is a dataclass serialized
+with the canonical codec (tpubft.utils.serialize); the wire envelope is
+  u16 msg_code | body
+Signed messages carry their signature as the last field; `signed_payload()`
+is the canonical encoding of everything before it, so signing and verifying
+never disagree about byte layout.
+
+`sender_id` is part of the body (as in the reference's MessageBase header,
+MessageBase.hpp senderId) — receivers must check it against the transport's
+reported sender before trusting it.
+"""
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional, Tuple, Type
+
+from tpubft.crypto.digest import calc_combination, digest as sha256
+from tpubft.utils import serialize as ser
+
+
+class MsgCode(enum.IntEnum):
+    """Wire discriminants (reference MsgCode.hpp:24-; values are ours)."""
+    ClientRequest = 1
+    ClientReply = 2
+    PrePrepare = 3
+    StartSlowCommit = 4
+    PreparePartial = 5
+    PrepareFull = 6
+    CommitPartial = 7
+    CommitFull = 8
+    PartialCommitProof = 9
+    FullCommitProof = 10
+    Checkpoint = 11
+    SimpleAck = 12
+    ViewChange = 13
+    NewView = 14
+    ReqMissingData = 15
+    ReplicaStatus = 16
+    ReplicaAsksToLeaveView = 17
+    StateTransfer = 18
+    ReplicaRestartReady = 19
+    RestartProof = 20
+
+
+class RequestFlag(enum.IntFlag):
+    """ClientRequestMsg flags (reference ClientMsgs.hpp)."""
+    EMPTY = 0
+    READ_ONLY = 1
+    PRE_PROCESS = 2
+    HAS_PRE_PROCESSED = 4
+    KEY_EXCHANGE = 8
+    INTERNAL = 16
+    RECONFIG = 32
+    TICK = 64
+
+
+class CommitPath(enum.IntEnum):
+    """The three commit paths (reference ReplicaConfig / PrePrepareMsg
+    firstPath): OPTIMISTIC_FAST needs n sigs, FAST_WITH_THRESHOLD needs
+    3f+c+1, SLOW is two PBFT-like rounds of 2f+c+1."""
+    OPTIMISTIC_FAST = 0
+    FAST_WITH_THRESHOLD = 1
+    SLOW = 2
+
+
+_REGISTRY: Dict[int, Type["ConsensusMsg"]] = {}
+
+
+def register(cls: Type["ConsensusMsg"]) -> Type["ConsensusMsg"]:
+    assert cls.CODE not in _REGISTRY, cls
+    _REGISTRY[int(cls.CODE)] = cls
+    return cls
+
+
+class MsgError(Exception):
+    """Structurally invalid message (reference throws from validate())."""
+
+
+class ConsensusMsg:
+    """Mixin for dataclass messages; subclasses set CODE and SPEC."""
+    CODE: ClassVar[MsgCode]
+    SPEC: ClassVar[list]
+
+    def pack(self) -> bytes:
+        buf = bytearray(struct.pack("<H", int(self.CODE)))
+        ser.encode_msg_into(buf, self)
+        return bytes(buf)
+
+    def signed_payload(self) -> bytes:
+        """Canonical bytes covered by this message's signature: the msg
+        code + every field before the trailing `signature`."""
+        assert self.SPEC and self.SPEC[-1][0] == "signature", type(self)
+        buf = bytearray(struct.pack("<H", int(self.CODE)))
+        for name, spec in self.SPEC[:-1]:
+            ser.encode_value(buf, spec, getattr(self, name))
+        return bytes(buf)
+
+    def validate(self) -> None:
+        """Structural checks; raises MsgError. Signature checks live in
+        SigManager/collector paths where keys are known."""
+
+
+def unpack(data: bytes) -> ConsensusMsg:
+    if len(data) < 2:
+        raise MsgError("short message")
+    (code,) = struct.unpack_from("<H", data)
+    cls = _REGISTRY.get(code)
+    if cls is None:
+        raise MsgError(f"unknown msg code {code}")
+    try:
+        msg = ser.decode_msg(data[2:], cls)
+    except ser.SerializeError as e:
+        raise MsgError(f"{cls.__name__}: {e}") from e
+    msg.validate()
+    return msg
+
+
+# ---------------- client <-> replica ----------------
+
+@register
+@dataclass
+class ClientRequestMsg(ConsensusMsg):
+    """Reference ClientRequestMsg.hpp: client-signed command submission."""
+    CODE = MsgCode.ClientRequest
+    sender_id: int
+    req_seq_num: int
+    flags: int
+    request: bytes
+    cid: str                      # correlation id (reference spanContext/cid)
+    signature: bytes
+    SPEC = [("sender_id", "u32"), ("req_seq_num", "u64"), ("flags", "u32"),
+            ("request", "bytes"), ("cid", "str"), ("signature", "bytes")]
+
+    def digest(self) -> bytes:
+        return sha256(self.signed_payload())
+
+    def validate(self) -> None:
+        if not self.request and not self.flags & RequestFlag.READ_ONLY:
+            raise MsgError("empty write request")
+
+
+@register
+@dataclass
+class ClientReplyMsg(ConsensusMsg):
+    """Reference ClientReplyMsg.hpp: execution result returned to client."""
+    CODE = MsgCode.ClientReply
+    sender_id: int                # replying replica
+    req_seq_num: int
+    current_primary: int
+    reply: bytes
+    replica_specific_info: bytes  # RSI — differs per replica, excluded from
+                                  # quorum matching (reference rsiLength)
+    SPEC = [("sender_id", "u32"), ("req_seq_num", "u64"),
+            ("current_primary", "u32"), ("reply", "bytes"),
+            ("replica_specific_info", "bytes")]
+
+    def matching_digest(self) -> bytes:
+        """Digest over the parts that must match across replicas."""
+        return sha256(struct.pack("<Q", self.req_seq_num) + self.reply)
+
+
+# ---------------- ordering ----------------
+
+@register
+@dataclass
+class PrePrepareMsg(ConsensusMsg):
+    """Reference PrePrepareMsg.hpp:33-53: the primary's batch proposal.
+
+    `requests` holds packed ClientRequestMsgs; `requests_digest` commits to
+    them; `time` is the primary's timestamp voted on by the time service.
+    """
+    CODE = MsgCode.PrePrepare
+    sender_id: int
+    view: int
+    seq_num: int
+    first_path: int               # CommitPath the primary starts on
+    time: int                     # microseconds since epoch
+    requests_digest: bytes
+    requests: List[bytes]
+    signature: bytes
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
+            ("first_path", "u8"), ("time", "u64"),
+            ("requests_digest", "bytes"), ("requests", ("list", "bytes")),
+            ("signature", "bytes")]
+
+    @staticmethod
+    def compute_requests_digest(requests: List[bytes]) -> bytes:
+        h = bytearray()
+        for r in requests:
+            h += sha256(r)
+        return sha256(bytes(h))
+
+    def digest(self) -> bytes:
+        """Digest of the proposal identity (digestOfRequests + seq/view),
+        the value threshold signatures commit to."""
+        return sha256(struct.pack("<QQ", self.view, self.seq_num)
+                      + self.requests_digest)
+
+    def validate(self) -> None:
+        if self.first_path not in (0, 1, 2):
+            raise MsgError("bad commit path")
+        if self.requests_digest != self.compute_requests_digest(self.requests):
+            raise MsgError("requests digest mismatch")
+
+    def client_requests(self) -> List[ClientRequestMsg]:
+        out = []
+        for raw in self.requests:
+            m = unpack(raw)
+            if not isinstance(m, ClientRequestMsg):
+                raise MsgError("non-request in PrePrepare batch")
+            out.append(m)
+        return out
+
+
+def commit_digest(view: int, seq_num: int, pp_digest: bytes) -> bytes:
+    """Digest::calcCombination(ppDigest, view, seq) equivalent
+    (reference ReplicaImp.cpp:1344): the value signed by commit-path
+    threshold shares."""
+    return calc_combination(pp_digest, view, seq_num)
+
+
+@register
+@dataclass
+class StartSlowCommitMsg(ConsensusMsg):
+    """Reference StartSlowCommitMsg.hpp: primary demotes seq to slow path."""
+    CODE = MsgCode.StartSlowCommit
+    sender_id: int
+    view: int
+    seq_num: int
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64")]
+
+
+@dataclass
+class _SignedShareBase(ConsensusMsg):
+    """Reference SignedShareMsgs.hpp SignedShareBase: a threshold-signature
+    share (or combined signature) over the commit digest for (view, seq)."""
+    sender_id: int
+    view: int
+    seq_num: int
+    digest: bytes                 # commit_digest(view, seq, ppDigest)
+    sig: bytes                    # share (Partial) or combined (Full)
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
+            ("digest", "bytes"), ("sig", "bytes")]
+
+    def validate(self) -> None:
+        if len(self.digest) != 32:
+            raise MsgError("bad digest length")
+        if not self.sig:
+            raise MsgError("empty signature share")
+
+
+@register
+@dataclass
+class PreparePartialMsg(_SignedShareBase):
+    CODE = MsgCode.PreparePartial
+
+
+@register
+@dataclass
+class PrepareFullMsg(_SignedShareBase):
+    CODE = MsgCode.PrepareFull
+
+
+@register
+@dataclass
+class CommitPartialMsg(_SignedShareBase):
+    CODE = MsgCode.CommitPartial
+
+
+@register
+@dataclass
+class CommitFullMsg(_SignedShareBase):
+    CODE = MsgCode.CommitFull
+
+
+@register
+@dataclass
+class PartialCommitProofMsg(_SignedShareBase):
+    """Fast-path share (reference PartialCommitProofMsg.hpp); `path` tells
+    the collector which quorum size applies."""
+    CODE = MsgCode.PartialCommitProof
+    path: int = int(CommitPath.OPTIMISTIC_FAST)
+    SPEC = _SignedShareBase.SPEC + [("path", "u8")]
+
+    def validate(self) -> None:
+        super().validate()
+        if self.path not in (0, 1):
+            raise MsgError("bad fast path")
+
+
+@register
+@dataclass
+class FullCommitProofMsg(_SignedShareBase):
+    """Fast-path combined proof (reference FullCommitProofMsg.hpp) —
+    possession is a commit certificate."""
+    CODE = MsgCode.FullCommitProof
+
+
+# ---------------- checkpointing ----------------
+
+@register
+@dataclass
+class CheckpointMsg(ConsensusMsg):
+    """Reference CheckpointMsg.hpp: signed app-state digest at a checkpoint
+    seqnum (every checkpointWindowSize=150); f+1 matching ⇒ stable."""
+    CODE = MsgCode.Checkpoint
+    sender_id: int
+    seq_num: int
+    state_digest: bytes
+    is_stable: bool
+    signature: bytes
+    SPEC = [("sender_id", "u32"), ("seq_num", "u64"),
+            ("state_digest", "bytes"), ("is_stable", "bool"),
+            ("signature", "bytes")]
+
+
+@register
+@dataclass
+class SimpleAckMsg(ConsensusMsg):
+    """Reference SimpleAckMsg.hpp: ack for retransmittable msgs."""
+    CODE = MsgCode.SimpleAck
+    sender_id: int
+    seq_num: int
+    view: int
+    acked_msg_code: int
+    SPEC = [("sender_id", "u32"), ("seq_num", "u64"), ("view", "u64"),
+            ("acked_msg_code", "u16")]
+
+
+# ---------------- view change ----------------
+
+@dataclass
+class PreparedCertificate:
+    """Evidence inside ViewChangeMsg that a seqnum may have committed in an
+    earlier view (reference ViewChangeMsg element + PrepareFull proof)."""
+    seq_num: int
+    view: int                     # view in which it was prepared
+    pp_digest: bytes
+    combined_sig: bytes           # PrepareFull/FullCommitProof combined sig
+    pre_prepare: bytes            # packed PrePrepareMsg (so the new primary
+                                  # can re-propose without refetching)
+    SPEC = [("seq_num", "u64"), ("view", "u64"), ("pp_digest", "bytes"),
+            ("combined_sig", "bytes"), ("pre_prepare", "bytes")]
+
+
+@register
+@dataclass
+class ViewChangeMsg(ConsensusMsg):
+    """Reference ViewChangeMsg.hpp: replica's signed statement entering a
+    new view: last stable checkpoint + prepared certificates in-flight."""
+    CODE = MsgCode.ViewChange
+    sender_id: int
+    new_view: int
+    last_stable_seq: int
+    prepared: List[PreparedCertificate]
+    signature: bytes
+    SPEC = [("sender_id", "u32"), ("new_view", "u64"),
+            ("last_stable_seq", "u64"),
+            ("prepared", ("list", ("msg", PreparedCertificate))),
+            ("signature", "bytes")]
+
+    def digest(self) -> bytes:
+        return sha256(self.signed_payload())
+
+
+@dataclass
+class ReplicaDigest:
+    """(replica id, digest-or-signature bytes) pair used in certificates."""
+    replica: int
+    digest: bytes
+    SPEC = [("replica", "u32"), ("digest", "bytes")]
+
+
+@register
+@dataclass
+class NewViewMsg(ConsensusMsg):
+    """Reference NewViewMsg.hpp: new primary's certificate — digests of the
+    2f+2c+1 ViewChangeMsgs it built the new view from."""
+    CODE = MsgCode.NewView
+    sender_id: int
+    new_view: int
+    view_change_digests: List[ReplicaDigest]
+    signature: bytes
+    SPEC = [("sender_id", "u32"), ("new_view", "u64"),
+            ("view_change_digests", ("list", ("msg", ReplicaDigest))),
+            ("signature", "bytes")]
+
+
+@register
+@dataclass
+class ReplicaAsksToLeaveViewMsg(ConsensusMsg):
+    """Reference ReplicaAsksToLeaveViewMsg.hpp: signed view-change
+    complaint; f+1 of these start an actual view change."""
+    CODE = MsgCode.ReplicaAsksToLeaveView
+    sender_id: int
+    view: int
+    reason: int                   # enum: timeout=0, primary-misbehavior=1…
+    signature: bytes
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("reason", "u8"),
+            ("signature", "bytes")]
+
+
+# ---------------- recovery / status ----------------
+
+@register
+@dataclass
+class ReqMissingDataMsg(ConsensusMsg):
+    """Reference ReqMissingDataMsg.hpp: ask a peer for missing protocol
+    msgs for a seqnum (bitmask of what's needed)."""
+    CODE = MsgCode.ReqMissingData
+    sender_id: int
+    view: int
+    seq_num: int
+    missing: int                  # bitmask: 1=PrePrepare, 2=PrepareFull,
+                                  # 4=CommitFull, 8=FullCommitProof
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
+            ("missing", "u32")]
+
+
+@register
+@dataclass
+class ReplicaStatusMsg(ConsensusMsg):
+    """Reference ReplicaStatusMsg.hpp: periodic gap-detection beacon."""
+    CODE = MsgCode.ReplicaStatus
+    sender_id: int
+    view: int
+    last_stable_seq: int
+    last_executed_seq: int
+    in_view_change: bool
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("last_stable_seq", "u64"),
+            ("last_executed_seq", "u64"), ("in_view_change", "bool")]
+
+
+@register
+@dataclass
+class StateTransferMsg(ConsensusMsg):
+    """Opaque envelope for the state-transfer module's own messages
+    (reference StateTransferMsg.hpp → BCStateTran wire msgs)."""
+    CODE = MsgCode.StateTransfer
+    sender_id: int
+    payload: bytes
+    SPEC = [("sender_id", "u32"), ("payload", "bytes")]
+
+
+@register
+@dataclass
+class ReplicaRestartReadyMsg(ConsensusMsg):
+    """Reference ReplicaRestartReadyMsg.hpp: signed 'ready to restart' vote
+    (n/n super-stable wedge for upgrades)."""
+    CODE = MsgCode.ReplicaRestartReady
+    sender_id: int
+    seq_num: int
+    reason: int
+    signature: bytes
+    SPEC = [("sender_id", "u32"), ("seq_num", "u64"), ("reason", "u8"),
+            ("signature", "bytes")]
+
+
+@register
+@dataclass
+class RestartProofMsg(ConsensusMsg):
+    """Reference RestartProofMsg: n ReplicaRestartReady sigs combined."""
+    CODE = MsgCode.RestartProof
+    sender_id: int
+    seq_num: int
+    signatures: List[ReplicaDigest]
+    SPEC = [("sender_id", "u32"), ("seq_num", "u64"),
+            ("signatures", ("list", ("msg", ReplicaDigest)))]
