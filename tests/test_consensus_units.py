@@ -1,0 +1,142 @@
+"""Unit tests for consensus building blocks: sig manager + batch verifier,
+persistent storage WAL recovery, clients manager, active window."""
+import os
+
+import pytest
+
+from tpubft.consensus.clients_manager import ClientsManager
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.consensus.messages import ClientReplyMsg
+from tpubft.consensus.persistent import (FilePersistentStorage,
+                                         InMemoryPersistentStorage,
+                                         restore_replica_state)
+from tpubft.consensus.seq_num_info import ActiveWindow, SeqNumInfo
+from tpubft.consensus.sig_manager import BatchVerifier, SigManager
+from tpubft.utils.config import ReplicaConfig
+
+
+@pytest.fixture(scope="module")
+def keys():
+    return ClusterKeys.generate(ReplicaConfig(f_val=1), num_clients=2)
+
+
+def test_sig_manager_sign_verify(keys):
+    sm0 = SigManager(keys.for_node(0))
+    sm1 = SigManager(keys.for_node(1))
+    sig = sm0.sign(b"hello")
+    assert sm1.verify(0, b"hello", sig)
+    assert not sm1.verify(0, b"hello!", sig)
+    assert not sm1.verify(1, b"hello", sig)    # wrong principal
+    assert not sm1.verify(99, b"hello", sig)   # unknown principal
+    assert sm1.sigs_verified.value == 1
+    assert sm1.sig_failures.value == 3
+
+
+def test_sig_manager_verify_batch_mixed(keys):
+    sm0 = SigManager(keys.for_node(0))
+    sm4 = SigManager(keys.for_node(4))         # client signs too
+    verifier = SigManager(keys.for_node(1))
+    items = [(0, b"a", sm0.sign(b"a")),
+             (4, b"b", sm4.sign(b"b")),
+             (0, b"c", b"\x00" * 64),
+             (4, b"b", sm0.sign(b"b"))]        # signed by wrong principal
+    assert verifier.verify_batch(items) == [True, True, False, False]
+
+
+def test_batch_verifier_async(keys):
+    sm0 = SigManager(keys.for_node(0))
+    verifier = SigManager(keys.for_node(1))
+    bv = BatchVerifier(verifier, batch_size=4, flush_us=100)
+    try:
+        good = [bv.submit(0, b"m%d" % i, sm0.sign(b"m%d" % i))
+                for i in range(5)]
+        bad = bv.submit(0, b"x", b"\x00" * 64)
+        assert all(v.result(timeout=2) for v in good)
+        assert not bad.result(timeout=2)
+    finally:
+        bv.stop()
+
+
+def test_file_persistent_storage_recovery(tmp_path):
+    path = str(tmp_path / "meta.wal")
+    ps = FilePersistentStorage(path)
+    st = ps.begin_write_tran()
+    st.last_view = 3
+    st.last_executed_seq = 17
+    st.seq(17).pre_prepare = b"fake-pp"
+    ps.end_write_tran()
+    ps.close()
+
+    ps2 = FilePersistentStorage(path)
+    st2 = ps2.load()
+    assert st2.last_view == 3
+    assert st2.last_executed_seq == 17
+    assert st2.seq_states[17].pre_prepare == b"fake-pp"
+    ps2.close()
+
+
+def test_file_persistent_storage_torn_tail(tmp_path):
+    path = str(tmp_path / "meta.wal")
+    ps = FilePersistentStorage(path)
+    st = ps.begin_write_tran()
+    st.last_executed_seq = 5
+    ps.end_write_tran()
+    ps.close()
+    with open(path, "ab") as fh:
+        fh.write(b'{"v": 9, "e": 99, TRUNCATED')   # torn write
+    ps2 = FilePersistentStorage(path)
+    assert ps2.load().last_executed_seq == 5       # last complete line wins
+    ps2.close()
+
+
+def test_file_persistent_storage_compaction(tmp_path):
+    path = str(tmp_path / "meta.wal")
+    ps = FilePersistentStorage(path, compact_bytes=1024)
+    for i in range(100):
+        st = ps.begin_write_tran()
+        st.last_executed_seq = i
+        ps.end_write_tran()
+    assert os.path.getsize(path) < 4096
+    ps.close()
+    ps2 = FilePersistentStorage(path)
+    assert ps2.load().last_executed_seq == 99
+    ps2.close()
+
+
+def test_clients_manager_dedup_and_cache():
+    cm = ClientsManager([10, 11])
+    assert cm.can_become_pending(10, 1)
+    cm.add_pending(10, 1)
+    assert not cm.can_become_pending(10, 1)     # in flight
+    assert cm.can_become_pending(10, 2)
+    reply = ClientReplyMsg(sender_id=0, req_seq_num=1, current_primary=0,
+                           reply=b"r", replica_specific_info=b"")
+    cm.on_request_executed(10, 1, reply)
+    assert not cm.can_become_pending(10, 1)     # executed
+    assert cm.cached_reply(10, 1) == reply
+    assert cm.cached_reply(10, 2) is None
+    assert not cm.can_become_pending(99, 1)     # unknown client
+
+
+def test_active_window_slide():
+    w = ActiveWindow(300, SeqNumInfo)
+    assert w.in_window(1) and w.in_window(300)
+    assert not w.in_window(0) and not w.in_window(301)
+    w.get(5).prepared = True
+    w.advance(150)
+    assert not w.in_window(150) and w.in_window(450)
+    with pytest.raises(KeyError):
+        w.get(150)
+    assert w.peek(5) is None                    # GC'd
+
+
+def test_restore_replica_state_skips_stable(tmp_path):
+    ps = InMemoryPersistentStorage()
+    st = ps.begin_write_tran()
+    st.last_stable_seq = 150
+    st.seq(100).pre_prepare = b"old"            # below stable: ignored
+    st.seq(151).slow_started = True
+    ps.end_write_tran()
+    state, window = restore_replica_state(ps)
+    assert 100 not in window
+    assert window[151]["slow_started"] is True

@@ -1,0 +1,41 @@
+"""Crash-recovery e2e: replica restart from the file-backed WAL
+(reference ReplicaLoader + recoverRequests path)."""
+import pytest
+
+from tpubft.apps import counter
+from tpubft.consensus.persistent import FilePersistentStorage
+from tpubft.testing import InProcessCluster
+
+
+def test_backup_restart_rejoins_and_cluster_progresses(tmp_path):
+    from tpubft.apps.counter import PersistentCounterHandler
+    storages = {}
+
+    def storage_factory(r):
+        st = FilePersistentStorage(str(tmp_path / f"replica-{r}.wal"))
+        storages[r] = st
+        return st
+
+    def handler_factory(r):
+        return PersistentCounterHandler(str(tmp_path / f"counter-{r}.state"))
+
+    with InProcessCluster(f=1, storage_factory=storage_factory,
+                          handler_factory=handler_factory) as cluster:
+        cl = cluster.client()
+        assert counter.decode_reply(cl.send_write(counter.encode_add(10))) == 10
+        assert counter.decode_reply(cl.send_write(counter.encode_add(5))) == 15
+        # crash + restart a backup; it must reload metadata and the
+        # cluster must keep committing with it back
+        storages[2].close()
+        rep = cluster.restart(2)
+        assert rep.last_executed >= 1   # recovered executed prefix from WAL
+        assert counter.decode_reply(cl.send_write(counter.encode_add(1))) == 16
+        # restarted replica replays committed requests on recovery, then
+        # applies new ones: its state must converge to the cluster's
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if cluster.handlers[2].value == 16:
+                break
+            time.sleep(0.05)
+        assert cluster.handlers[2].value == 16

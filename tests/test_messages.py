@@ -127,6 +127,15 @@ def test_unknown_code_and_truncation_rejected():
 
 
 def test_all_codes_unique_and_registered():
-    assert len(m._REGISTRY) == len(set(m._REGISTRY))
+    assert set(m._REGISTRY) == {int(c) for c in m.MsgCode}
     for code, cls in m._REGISTRY.items():
         assert int(cls.CODE) == code
+
+
+def test_invalid_utf8_in_str_field_is_msg_error():
+    raw = bytearray(make_request(payload=b"x").pack())
+    # corrupt the cid bytes region to invalid UTF-8
+    idx = raw.rfind(b"cid-0")
+    raw[idx] = 0xFF
+    with pytest.raises(m.MsgError):
+        m.unpack(bytes(raw))

@@ -1,0 +1,2 @@
+"""Demo applications / test state machines (reference tests/simpleTest,
+tests/simpleKVBC, examples/)."""

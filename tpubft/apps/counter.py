@@ -1,0 +1,85 @@
+"""Counter state machine — the reference's simpleTest app.
+
+Rebuild of /root/reference/tests/simpleTest/ (simple_test_replica.hpp):
+the state is one signed 64-bit counter; writes add a delta and return the
+new value; reads return the current value. Deterministic, so all replicas
+agree on the state digest at every checkpoint.
+
+Wire format: op byte 'A' (add) + i64 delta | 'R' (read). Replies: i64.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+
+from tpubft.consensus.replica import IRequestsHandler
+from tpubft.crypto.digest import digest as sha256
+
+_I64 = struct.Struct("<q")
+
+
+def encode_add(delta: int) -> bytes:
+    return b"A" + _I64.pack(delta)
+
+
+def encode_read() -> bytes:
+    return b"R"
+
+
+def decode_reply(reply: bytes) -> int:
+    return _I64.unpack(reply)[0]
+
+
+class CounterHandler(IRequestsHandler):
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def _persist(self) -> None:
+        pass
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def execute(self, client_id: int, req_seq: int, flags: int,
+                request: bytes) -> bytes:
+        if request[:1] == b"A" and len(request) == 1 + _I64.size:
+            delta = _I64.unpack(request[1:])[0]
+            with self._lock:
+                self._value += delta
+                self._persist()
+                return _I64.pack(self._value)
+        if request[:1] == b"R":
+            return _I64.pack(self._value)
+        return b""
+
+    def read(self, client_id: int, request: bytes) -> bytes:
+        return _I64.pack(self._value)
+
+    def state_digest(self) -> bytes:
+        return sha256(b"counter" + _I64.pack(self._value))
+
+
+class PersistentCounterHandler(CounterHandler):
+    """Counter with durable state — the app-persistence role RocksDB plays
+    in the reference (consensus metadata and app state are persisted
+    separately; see kvbc/). Survives replica restart."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self._path = path
+        try:
+            with open(path, "rb") as fh:
+                self._value = _I64.unpack(fh.read(_I64.size))[0]
+        except (OSError, struct.error):
+            self._value = 0
+
+    def _persist(self) -> None:
+        import os
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(_I64.pack(self._value))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._path)

@@ -1,0 +1,4 @@
+"""BFT client stack (reference /root/reference/client/bftclient/)."""
+from tpubft.bftclient.client import BftClient, ClientConfig, Quorum
+
+__all__ = ["BftClient", "ClientConfig", "Quorum"]

@@ -1,0 +1,141 @@
+"""BFT client: signed requests + reply quorum matching.
+
+Rebuild of the reference's bftclient
+(/root/reference/client/bftclient/include/bftclient/bft_client.h:36
+Client::send; quorums.h:45-46 LinearizableQuorum = 2f+c+1,
+ByzantineSafeQuorum = f+1; src/matcher.cpp Matcher): the client signs a
+ClientRequestMsg, sends it to all replicas, retransmits on a timer, and
+returns once enough replies agree byte-for-byte (replica-specific info
+excluded from matching, as in the reference's RSI handling).
+"""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from tpubft.comm.interfaces import ICommunication, IReceiver
+from tpubft.consensus import messages as m
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.consensus.replicas_info import ReplicasInfo
+
+
+class Quorum(enum.Enum):
+    LINEARIZABLE = "linearizable"       # 2f + c + 1
+    BYZANTINE_SAFE = "byzantine_safe"   # f + 1
+    ALL = "all"                         # n
+
+
+@dataclass
+class ClientConfig:
+    client_id: int
+    f_val: int = 1
+    c_val: int = 0
+    retry_timeout_ms: int = 250
+    request_timeout_ms: int = 10000
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+class BftClient(IReceiver):
+    def __init__(self, cfg: ClientConfig, keys: ClusterKeys,
+                 comm: ICommunication):
+        self.cfg = cfg
+        self.info = ReplicasInfo(n=3 * cfg.f_val + 2 * cfg.c_val + 1,
+                                 f=cfg.f_val, c=cfg.c_val)
+        self.keys = keys
+        self.comm = comm
+        self._signer = keys.my_signer()
+        self._req_seq = int(time.time() * 1e6)  # monotonic across restarts
+        self._lock = threading.Lock()
+        self._replies: Dict[int, Dict[int, m.ClientReplyMsg]] = {}
+        self._done: Dict[int, threading.Event] = {}
+        self._result: Dict[int, m.ClientReplyMsg] = {}
+        self._quorum_needed: Dict[int, int] = {}
+        self._started = False
+
+    def start(self) -> None:
+        if not self._started:
+            self.comm.start(self)
+            self._started = True
+
+    def stop(self) -> None:
+        self.comm.stop()
+        self._started = False
+
+    # ---- transport upcall ----
+    def on_new_message(self, sender: int, data: bytes) -> None:
+        try:
+            msg = m.unpack(data)
+        except m.MsgError:
+            return
+        if not isinstance(msg, m.ClientReplyMsg) or msg.sender_id != sender:
+            return
+        with self._lock:
+            needed = self._quorum_needed.get(msg.req_seq_num)
+            if needed is None:
+                return
+            slot = self._replies.setdefault(msg.req_seq_num, {})
+            slot[sender] = msg
+            matching = sum(1 for r in slot.values()
+                           if r.matching_digest() == msg.matching_digest())
+            if matching >= needed:
+                self._result[msg.req_seq_num] = msg
+                self._done[msg.req_seq_num].set()
+
+    # ---- API ----
+    def quorum_size(self, q: Quorum) -> int:
+        if q is Quorum.LINEARIZABLE:
+            return self.info.slow_quorum
+        if q is Quorum.BYZANTINE_SAFE:
+            return self.info.f + 1
+        return self.info.n
+
+    def send_write(self, request: bytes,
+                   quorum: Quorum = Quorum.LINEARIZABLE,
+                   timeout_ms: Optional[int] = None) -> bytes:
+        return self._send(request, flags=0, quorum=quorum,
+                          timeout_ms=timeout_ms)
+
+    def send_read(self, request: bytes,
+                  quorum: Quorum = Quorum.BYZANTINE_SAFE,
+                  timeout_ms: Optional[int] = None) -> bytes:
+        return self._send(request, flags=int(m.RequestFlag.READ_ONLY),
+                          quorum=quorum, timeout_ms=timeout_ms)
+
+    def _send(self, request: bytes, flags: int, quorum: Quorum,
+              timeout_ms: Optional[int]) -> bytes:
+        self.start()
+        with self._lock:
+            self._req_seq += 1
+            req_seq = self._req_seq
+            evt = self._done[req_seq] = threading.Event()
+            self._quorum_needed[req_seq] = self.quorum_size(quorum)
+        req = m.ClientRequestMsg(sender_id=self.cfg.client_id,
+                                 req_seq_num=req_seq, flags=flags,
+                                 request=request, cid=f"c{req_seq}",
+                                 signature=b"")
+        req.signature = self._signer.sign(req.signed_payload())
+        raw = req.pack()
+        deadline = time.monotonic() + (timeout_ms
+                                       or self.cfg.request_timeout_ms) / 1e3
+        retry_s = self.cfg.retry_timeout_ms / 1e3
+        try:
+            while time.monotonic() < deadline:
+                for r in self.info.replica_ids:
+                    self.comm.send(r, raw)
+                if evt.wait(timeout=retry_s):
+                    return self._result[req_seq].reply
+            raise TimeoutError_(
+                f"client {self.cfg.client_id} req {req_seq}: no quorum "
+                f"within {timeout_ms or self.cfg.request_timeout_ms}ms")
+        finally:
+            with self._lock:
+                self._done.pop(req_seq, None)
+                self._replies.pop(req_seq, None)
+                self._result.pop(req_seq, None)
+                self._quorum_needed.pop(req_seq, None)

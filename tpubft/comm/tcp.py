@@ -56,7 +56,8 @@ class _Peer:
                 old.close()
             except OSError:
                 pass
-        self.reader = threading.Thread(target=self._read_loop, daemon=True,
+        self.reader = threading.Thread(target=self._read_loop, args=(sock,),
+                                       daemon=True,
                                        name=f"tcp-read-{self.node}")
         self.reader.start()
         self.comm._notify(self.node, ConnectionStatus.CONNECTED)
@@ -104,10 +105,9 @@ class _Peer:
                 break
             # deadline expired with no connection: message dropped
 
-    def _read_loop(self) -> None:
-        sock = self.sock
+    def _read_loop(self, sock: socket.socket) -> None:
         while self.comm.is_running():
-            if sock is None or self.sock is not sock:
+            if self.sock is not sock:
                 return  # replaced: the new socket has its own reader
             hdr = _recv_exact(sock, _LEN.size)
             if hdr is None:

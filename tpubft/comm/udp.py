@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Optional
 
 from tpubft.comm.interfaces import (CommConfig, ConnectionStatus,
                                     ICommunication, IReceiver, NodeNum)

@@ -1,0 +1,77 @@
+"""Per-client bookkeeping: pending requests + reply cache.
+
+Rebuild of the reference's ClientsManager
+(/root/reference/bftengine/src/bftengine/ClientsManager.cpp): tracks the
+highest executed request seqnum per client (for at-most-once execution),
+the pending (not yet committed) request, and caches the last reply so a
+retransmitted request gets the cached answer instead of re-execution.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from tpubft.consensus.messages import ClientReplyMsg
+
+
+@dataclass
+class _ClientInfo:
+    last_executed_req: int = -1
+    last_reply: Optional[ClientReplyMsg] = None
+    pending_req_seq: Optional[int] = None
+    pending_cid: str = ""
+
+
+class ClientsManager:
+    def __init__(self, client_ids) -> None:
+        self._clients: Dict[int, _ClientInfo] = {c: _ClientInfo()
+                                                 for c in client_ids}
+
+    def is_valid_client(self, client_id: int) -> bool:
+        return client_id in self._clients
+
+    # ---- request admission (primary + all replicas) ----
+    def can_become_pending(self, client_id: int, req_seq: int) -> bool:
+        info = self._clients.get(client_id)
+        if info is None:
+            return False
+        if req_seq <= info.last_executed_req:
+            return False                       # already executed (dup)
+        if info.pending_req_seq is not None and req_seq <= info.pending_req_seq:
+            return False                       # already in flight
+        return True
+
+    def add_pending(self, client_id: int, req_seq: int, cid: str = "") -> None:
+        info = self._clients[client_id]
+        info.pending_req_seq = req_seq
+        info.pending_cid = cid
+
+    def has_pending(self, client_id: int) -> bool:
+        return self._clients[client_id].pending_req_seq is not None
+
+    # ---- execution results ----
+    def on_request_executed(self, client_id: int, req_seq: int,
+                            reply: ClientReplyMsg) -> None:
+        info = self._clients.get(client_id)
+        if info is None:
+            return
+        if req_seq > info.last_executed_req:
+            info.last_executed_req = req_seq
+            info.last_reply = reply
+        if info.pending_req_seq is not None and req_seq >= info.pending_req_seq:
+            info.pending_req_seq = None
+            info.pending_cid = ""
+
+    def cached_reply(self, client_id: int,
+                     req_seq: int) -> Optional[ClientReplyMsg]:
+        """Reply for a retransmitted already-executed request (reference
+        stores replies in reserved pages; we cache the latest)."""
+        info = self._clients.get(client_id)
+        if info and info.last_reply is not None \
+                and info.last_executed_req == req_seq:
+            return info.last_reply
+        return None
+
+    def last_executed(self, client_id: int) -> int:
+        info = self._clients.get(client_id)
+        return info.last_executed_req if info else -1

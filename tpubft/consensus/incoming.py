@@ -1,0 +1,134 @@
+"""Incoming message pipeline: bounded queues + THE dispatcher thread.
+
+Rebuild of the reference's IncomingMsgsStorageImp
+(/root/reference/bftengine/src/bftengine/IncomingMsgsStorageImp.hpp:32,
+maxNumberOfPendingExternalMsgs_=20000 :64) + MsgHandlersRegistrator
+(MsgHandlersRegistrator.hpp:48) + MsgsCommunicator (MsgsCommunicator.cpp:41).
+
+All protocol state is mutated only on the single dispatcher thread;
+transports and crypto workers communicate with it exclusively through
+these queues. Internal messages (collector results, timer ticks) bypass
+the external bound and have priority, as in the reference.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+MAX_EXTERNAL_PENDING = 20000
+
+
+@dataclass
+class ExternalMsg:
+    sender: int
+    raw: bytes
+
+
+@dataclass
+class InternalMsg:
+    """Result of background work re-entering the main loop (reference
+    CombinedSigSucceeded/Failed internal msgs)."""
+    kind: str
+    payload: Any
+
+
+class IncomingMsgsStorage:
+    def __init__(self, max_external: int = MAX_EXTERNAL_PENDING):
+        self._external: "queue.Queue[ExternalMsg]" = queue.Queue(max_external)
+        self._internal: "queue.Queue[InternalMsg]" = queue.Queue()
+        self._dropped_external = 0
+
+    def push_external(self, sender: int, raw: bytes) -> bool:
+        try:
+            self._external.put_nowait(ExternalMsg(sender, raw))
+            return True
+        except queue.Full:
+            self._dropped_external += 1
+            return False
+
+    def push_internal(self, kind: str, payload: Any = None) -> None:
+        self._internal.put(InternalMsg(kind, payload))
+
+    def pop(self, timeout: float):
+        """Internal msgs first (they unblock consensus progress), then
+        external; returns ExternalMsg | InternalMsg | None on timeout."""
+        try:
+            return self._internal.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            return self._external.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    @property
+    def dropped_external(self) -> int:
+        return self._dropped_external
+
+
+class Dispatcher:
+    """The single consensus thread: pops queues, dispatches to registered
+    handlers, fires periodic timers between messages."""
+
+    def __init__(self, storage: IncomingMsgsStorage, name: str = "dispatch"):
+        self._storage = storage
+        self._external_handler: Optional[Callable[[int, bytes], None]] = None
+        self._internal_handlers: Dict[str, Callable[[Any], None]] = {}
+        self._timers = []  # (period_s, callback, next_due)
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+
+    def set_external_handler(self, fn: Callable[[int, bytes], None]) -> None:
+        self._external_handler = fn
+
+    def register_internal(self, kind: str, fn: Callable[[Any], None]) -> None:
+        self._internal_handlers[kind] = fn
+
+    def add_timer(self, period_s: float, fn: Callable[[], None]) -> None:
+        self._timers.append([period_s, fn, time.monotonic() + period_s])
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self._name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while self._running:
+            now = time.monotonic()
+            next_due = min((t[2] for t in self._timers), default=now + 0.05)
+            timeout = max(0.0, min(next_due - now, 0.05))
+            item = self._storage.pop(timeout)
+            if item is not None:
+                try:
+                    if isinstance(item, ExternalMsg):
+                        if self._external_handler is not None:
+                            self._external_handler(item.sender, item.raw)
+                    else:
+                        fn = self._internal_handlers.get(item.kind)
+                        if fn is not None:
+                            fn(item.payload)
+                except Exception:  # noqa: BLE001 — a bad msg must not kill
+                    import traceback
+                    traceback.print_exc()
+            now = time.monotonic()
+            for t in self._timers:
+                if now >= t[2]:
+                    t[2] = now + t[0]
+                    try:
+                        t[1]()
+                    except Exception:  # noqa: BLE001
+                        import traceback
+                        traceback.print_exc()

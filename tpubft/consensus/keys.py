@@ -1,0 +1,109 @@
+"""Cluster key material generation and per-replica key views.
+
+Rebuild of the reference's key tooling (tools/GenerateConcordKeys.cpp +
+KeyfileIOUtils.cpp) and CryptoManager's per-path threshold systems
+(bftengine/include/bftengine/CryptoManager.hpp:109-111: slow path signs
+with threshold 2f+c+1, fast-with-threshold 3f+c+1, optimistic n).
+
+Deterministic from a seed so tests and multi-process harnesses can derive
+identical key material without shipping files; real deployments serialize
+`ClusterKeys.to_json()` per replica (private material included only in each
+replica's own view).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpubft.crypto.cpu import Ed25519Signer, Ed25519Verifier
+from tpubft.crypto.interfaces import (Cryptosystem, IThresholdSigner,
+                                      IThresholdVerifier)
+from tpubft.utils.config import ReplicaConfig
+
+
+def _derive_seed(root: bytes, *labels) -> bytes:
+    h = hashlib.sha256(root)
+    for lab in labels:
+        h.update(str(lab).encode())
+        h.update(b"|")
+    return h.digest()
+
+
+@dataclass
+class ClusterKeys:
+    """All public material + this node's private material."""
+    n: int
+    f: int
+    c: int
+    threshold_scheme: str
+    # per-message signing (SigManager principals)
+    replica_pubkeys: Dict[int, bytes] = field(default_factory=dict)
+    client_pubkeys: Dict[int, bytes] = field(default_factory=dict)
+    # private: only for this node
+    my_id: Optional[int] = None
+    my_sign_seed: Optional[bytes] = None
+    # threshold cryptosystems per commit path (shared public material;
+    # secret shares live inside — prune for untrusted serialization)
+    slow_path_system: Optional[Cryptosystem] = None
+    commit_path_system: Optional[Cryptosystem] = None
+    optimistic_system: Optional[Cryptosystem] = None
+
+    @classmethod
+    def generate(cls, cfg: ReplicaConfig, num_clients: int,
+                 seed: bytes = b"tpubft-test-cluster") -> "ClusterKeys":
+        """Generate the full cluster's material (test/keygen-tool path —
+        the reference's GenerateConcordKeys writes one file per replica)."""
+        n, f, c = cfg.n_val, cfg.f_val, cfg.c_val
+        ck = cls(n=n, f=f, c=c, threshold_scheme=cfg.threshold_scheme)
+        for r in range(n):
+            s = Ed25519Signer.generate(seed=_derive_seed(seed, "replica", r))
+            ck.replica_pubkeys[r] = s.public_bytes()
+        first_client = n + cfg.num_ro_replicas
+        for cl in range(first_client, first_client + num_clients):
+            s = Ed25519Signer.generate(seed=_derive_seed(seed, "client", cl))
+            ck.client_pubkeys[cl] = s.public_bytes()
+        scheme = cfg.threshold_scheme
+        ck.slow_path_system = Cryptosystem(
+            scheme, 2 * f + c + 1, n, seed=_derive_seed(seed, "slow"))
+        ck.commit_path_system = Cryptosystem(
+            scheme, 3 * f + c + 1, n, seed=_derive_seed(seed, "fastthresh"))
+        ck.optimistic_system = Cryptosystem(
+            scheme, n, n, seed=_derive_seed(seed, "optimistic"))
+        ck._seed = seed
+        return ck
+
+    def for_node(self, node_id: int) -> "ClusterKeys":
+        """This node's private view (sign seed derivation)."""
+        kind = "replica" if node_id < self.n else "client"
+        me = ClusterKeys(
+            n=self.n, f=self.f, c=self.c,
+            threshold_scheme=self.threshold_scheme,
+            replica_pubkeys=self.replica_pubkeys,
+            client_pubkeys=self.client_pubkeys,
+            my_id=node_id,
+            my_sign_seed=_derive_seed(self._seed, kind, node_id),
+            slow_path_system=self.slow_path_system,
+            commit_path_system=self.commit_path_system,
+            optimistic_system=self.optimistic_system)
+        me._seed = self._seed
+        return me
+
+    # ---- accessors ----
+    def my_signer(self) -> Ed25519Signer:
+        assert self.my_sign_seed is not None
+        return Ed25519Signer.generate(seed=self.my_sign_seed)
+
+    def verifier_of(self, node: int) -> Ed25519Verifier:
+        pk = self.replica_pubkeys.get(node) or self.client_pubkeys.get(node)
+        if pk is None:
+            raise KeyError(f"no public key for node {node}")
+        return Ed25519Verifier(pk)
+
+    def threshold_signer(self, system: Cryptosystem,
+                         replica_id: int) -> IThresholdSigner:
+        """Threshold signer ids are 1-based in the reference."""
+        return system.create_threshold_signer(replica_id + 1)
+
+    def threshold_verifier(self, system: Cryptosystem) -> IThresholdVerifier:
+        return system.create_threshold_verifier()

@@ -117,7 +117,11 @@ def unpack(data: bytes) -> ConsensusMsg:
         raise MsgError(f"unknown msg code {code}")
     try:
         msg = ser.decode_msg(data[2:], cls)
-    except ser.SerializeError as e:
+    except MsgError:
+        raise
+    except Exception as e:  # noqa: BLE001 — untrusted bytes: any decode
+        # failure (SerializeError, UnicodeDecodeError, …) is a bad message,
+        # never an exception that may kill the receive path
         raise MsgError(f"{cls.__name__}: {e}") from e
     msg.validate()
     return msg
@@ -201,8 +205,7 @@ class PrePrepareMsg(ConsensusMsg):
     def digest(self) -> bytes:
         """Digest of the proposal identity (digestOfRequests + seq/view),
         the value threshold signatures commit to."""
-        return sha256(struct.pack("<QQ", self.view, self.seq_num)
-                      + self.requests_digest)
+        return calc_combination(self.requests_digest, self.view, self.seq_num)
 
     def validate(self) -> None:
         if self.first_path not in (0, 1, 2):

@@ -1,0 +1,198 @@
+"""Persistent consensus metadata — crash-recovery write-ahead state.
+
+Rebuild of the reference's PersistentStorageImp
+(/root/reference/bftengine/src/bftengine/PersistentStorageImp.cpp) +
+ReplicaLoader (ReplicaLoader.cpp): transactional `begin/end_write_tran`
+bracketing, descriptors (lastView, lastExecutedSeq, lastStableSeq), and
+the seqnum-window contents (PrePrepare / full certificates) so a crashed
+replica rejoins mid-protocol safely.
+
+Two backends: InMemoryPersistentStorage (tests, NullStateTransfer-style)
+and FilePersistentStorage (append-only JSON-lines WAL with atomic snapshot
+compaction — the MetadataStorage-over-IDBClient role).
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tpubft.consensus import messages as m
+
+
+@dataclass
+class PersistedSeqState:
+    pre_prepare: Optional[bytes] = None        # packed PrePrepareMsg
+    prepare_full: Optional[bytes] = None       # packed PrepareFullMsg
+    commit_full: Optional[bytes] = None        # packed CommitFullMsg
+    full_commit_proof: Optional[bytes] = None  # packed FullCommitProofMsg
+    slow_started: bool = False
+
+
+@dataclass
+class PersistedState:
+    """Everything needed to rejoin safely after a crash."""
+    last_view: int = 0
+    last_executed_seq: int = 0
+    last_stable_seq: int = 0
+    in_view_change: bool = False
+    seq_states: Dict[int, PersistedSeqState] = field(default_factory=dict)
+
+    def seq(self, seq_num: int) -> PersistedSeqState:
+        st = self.seq_states.get(seq_num)
+        if st is None:
+            st = self.seq_states[seq_num] = PersistedSeqState()
+        return st
+
+
+class PersistentStorage:
+    """Interface (reference PersistentStorage.hpp). Mutations must happen
+    inside begin/end_write_tran; end commits atomically."""
+
+    def begin_write_tran(self) -> PersistedState:
+        raise NotImplementedError
+
+    def end_write_tran(self) -> None:
+        raise NotImplementedError
+
+    def load(self) -> PersistedState:
+        raise NotImplementedError
+
+
+class InMemoryPersistentStorage(PersistentStorage):
+    def __init__(self) -> None:
+        self._state = PersistedState()
+        self._depth = 0
+
+    def begin_write_tran(self) -> PersistedState:
+        self._depth += 1
+        return self._state
+
+    def end_write_tran(self) -> None:
+        assert self._depth > 0
+        self._depth -= 1
+
+    def load(self) -> PersistedState:
+        return self._state
+
+
+class FilePersistentStorage(PersistentStorage):
+    """Append-only WAL of state deltas with whole-state snapshots.
+
+    Simple but crash-consistent: every end_write_tran appends one fsynced
+    JSON line holding the FULL descriptor state + dirty seq entries;
+    recovery replays the last complete line. Compaction rewrites the file
+    atomically (tempfile + rename) when it grows past `compact_bytes`.
+    """
+
+    def __init__(self, path: str, compact_bytes: int = 4 << 20):
+        self._path = path
+        self._compact_bytes = compact_bytes
+        self._state = self._recover()
+        self._depth = 0
+        self._fh = open(self._path, "ab")
+
+    # ---- transactions ----
+    def begin_write_tran(self) -> PersistedState:
+        self._depth += 1
+        return self._state
+
+    def end_write_tran(self) -> None:
+        assert self._depth > 0
+        self._depth -= 1
+        if self._depth == 0:
+            line = json.dumps(self._encode(self._state),
+                              separators=(",", ":")) + "\n"
+            self._fh.write(line.encode())
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            if self._fh.tell() > self._compact_bytes:
+                self._compact()
+
+    def load(self) -> PersistedState:
+        return self._state
+
+    def close(self) -> None:
+        self._fh.close()
+
+    # ---- encoding ----
+    @staticmethod
+    def _encode(st: PersistedState) -> Dict[str, Any]:
+        def b64(x: Optional[bytes]) -> Optional[str]:
+            import base64
+            return base64.b64encode(x).decode() if x is not None else None
+        return {
+            "v": st.last_view, "e": st.last_executed_seq,
+            "s": st.last_stable_seq, "ivc": st.in_view_change,
+            "seqs": {str(k): {
+                "pp": b64(v.pre_prepare), "pf": b64(v.prepare_full),
+                "cf": b64(v.commit_full), "fcp": b64(v.full_commit_proof),
+                "slow": v.slow_started,
+            } for k, v in st.seq_states.items()},
+        }
+
+    @staticmethod
+    def _decode(d: Dict[str, Any]) -> PersistedState:
+        import base64
+
+        def unb64(x: Optional[str]) -> Optional[bytes]:
+            return base64.b64decode(x) if x is not None else None
+        st = PersistedState(last_view=d["v"], last_executed_seq=d["e"],
+                            last_stable_seq=d["s"], in_view_change=d["ivc"])
+        for k, v in d.get("seqs", {}).items():
+            st.seq_states[int(k)] = PersistedSeqState(
+                pre_prepare=unb64(v["pp"]), prepare_full=unb64(v["pf"]),
+                commit_full=unb64(v["cf"]),
+                full_commit_proof=unb64(v["fcp"]), slow_started=v["slow"])
+        return st
+
+    def _recover(self) -> PersistedState:
+        if not os.path.exists(self._path):
+            return PersistedState()
+        last = None
+        with open(self._path, "rb") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    last = json.loads(line)
+                except json.JSONDecodeError:
+                    break  # torn tail write: stop at last complete line
+        return self._decode(last) if last else PersistedState()
+
+    def _compact(self) -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(self._path) or ".")
+        with os.fdopen(fd, "wb") as out:
+            out.write((json.dumps(self._encode(self._state),
+                                  separators=(",", ":")) + "\n").encode())
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, self._path)
+        self._fh.close()
+        self._fh = open(self._path, "ab")
+
+
+def restore_replica_state(storage: PersistentStorage):
+    """ReplicaLoader::loadReplica equivalent — returns the PersistedState
+    plus unpacked window messages ready to seed a Replica."""
+    st = storage.load()
+    unpacked = {}
+    for seq, entry in st.seq_states.items():
+        if seq <= st.last_stable_seq:
+            continue
+        row = {}
+        for name, raw in (("pre_prepare", entry.pre_prepare),
+                          ("prepare_full", entry.prepare_full),
+                          ("commit_full", entry.commit_full),
+                          ("full_commit_proof", entry.full_commit_proof)):
+            if raw is not None:
+                try:
+                    row[name] = m.unpack(raw)
+                except m.MsgError:
+                    row[name] = None
+        row["slow_started"] = entry.slow_started
+        unpacked[seq] = row
+    return st, unpacked

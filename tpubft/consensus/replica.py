@@ -1,0 +1,514 @@
+"""The replica: SBFT protocol state machine (slow path first).
+
+Rebuild of the reference's ReplicaImp
+(/root/reference/bftengine/src/bftengine/ReplicaImp.{hpp,cpp}): message
+handlers per MsgCode (onMessage<ClientRequestMsg> :397,
+onMessage<PrePrepareMsg> :1047, tryToSendPrePrepareMsg :657,
+sendPreparePartial :1373, sendCommitPartial :1399,
+executeNextCommittedRequests :5720), driven by the single dispatcher
+thread; threshold combine/verify jobs run on the collector pool and
+re-enter as internal msgs, exactly the reference's
+CollectorOfThresholdSignatures round trip.
+
+Commit flow implemented here (slow path, the PBFT-like 2-round core):
+  ClientRequest → [primary] batch → PrePrepare
+  → every replica sends PreparePartial (threshold share) to the collector
+  → collector combines 2f+c+1 shares → PrepareFull broadcast → prepared
+  → every replica sends CommitPartial → collector → CommitFull → committed
+  → execute in seqnum order → ClientReply
+Fast-path (PartialCommitProof/FullCommitProof) arrives in the fast-path
+module; this replica already persists + window-manages for it.
+"""
+from __future__ import annotations
+
+import abc
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpubft.comm.interfaces import ICommunication, IReceiver
+from tpubft.consensus import messages as m
+from tpubft.consensus.clients_manager import ClientsManager
+from tpubft.consensus.collectors import (CollectorPool, CombineResult,
+                                         ShareCollector)
+from tpubft.consensus.incoming import Dispatcher, IncomingMsgsStorage
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.consensus.persistent import (InMemoryPersistentStorage,
+                                         PersistentStorage,
+                                         restore_replica_state)
+from tpubft.consensus.replicas_info import ReplicasInfo
+from tpubft.consensus.seq_num_info import ActiveWindow, SeqNumInfo
+from tpubft.consensus.sig_manager import SigManager
+from tpubft.crypto.digest import digest as sha256
+from tpubft.utils.config import ReplicaConfig
+from tpubft.utils.metrics import Aggregator, Component
+
+
+def share_digest(kind: str, view: int, seq_num: int, pp_digest: bytes) -> bytes:
+    """Domain-separated digest each threshold share signs: 'prepare' and
+    'commit' rounds must not be cross-replayable (the reference separates
+    them by message type inside the signed blob)."""
+    return sha256(kind.encode() + b"|" + struct.pack("<QQ", view, seq_num)
+                  + pp_digest)
+
+
+class IRequestsHandler(abc.ABC):
+    """Execution upcall (reference IRequestsHandler.hpp / RequestHandler)."""
+
+    @abc.abstractmethod
+    def execute(self, client_id: int, req_seq: int, flags: int,
+                request: bytes) -> bytes: ...
+
+    def read(self, client_id: int, request: bytes) -> bytes:
+        """Read-only query — must not mutate state."""
+        return b""
+
+    def state_digest(self) -> bytes:
+        """Digest of app state for checkpoint agreement."""
+        return b"\x00" * 32
+
+
+class Replica(IReceiver):
+    def __init__(self, cfg: ReplicaConfig, keys: ClusterKeys,
+                 comm: ICommunication, handler: IRequestsHandler,
+                 storage: Optional[PersistentStorage] = None,
+                 aggregator: Optional[Aggregator] = None):
+        cfg.validate()
+        self.cfg = cfg
+        self.id = cfg.replica_id
+        self.info = ReplicasInfo.from_config(cfg)
+        self.keys = keys
+        self.comm = comm
+        self.handler = handler
+        self.storage = storage or InMemoryPersistentStorage()
+        self.aggregator = aggregator or Aggregator()
+
+        self.sig = SigManager(keys, self.aggregator)
+        # threshold machinery for the slow path (CryptoManager.hpp:109-111)
+        sysm = keys.slow_path_system
+        self.slow_signer = keys.threshold_signer(sysm, self.id)
+        self.slow_verifier = keys.threshold_verifier(sysm)
+
+        # --- protocol state (dispatcher-thread only) ---
+        st, window_msgs = restore_replica_state(self.storage)
+        self.view = st.last_view
+        self.last_executed = st.last_executed_seq
+        self.last_stable = st.last_stable_seq
+        self.primary_next_seq = max(st.last_executed_seq,
+                                    st.last_stable_seq) + 1
+        self.window: ActiveWindow[SeqNumInfo] = ActiveWindow(
+            cfg.work_window_size, SeqNumInfo)
+        self.window.advance(st.last_stable_seq)
+        self.clients = ClientsManager(
+            range(self.info.first_client_id,
+                  self.info.first_client_id + self.info.num_clients))
+        self.pending_requests: List[m.ClientRequestMsg] = []
+        self.checkpoints: Dict[int, Dict[int, m.CheckpointMsg]] = {}
+
+        # --- pipeline ---
+        self.incoming = IncomingMsgsStorage()
+        self.dispatcher = Dispatcher(self.incoming, name=f"replica-{self.id}")
+        self.dispatcher.set_external_handler(self._on_external)
+        self.dispatcher.register_internal("combine", self._on_combine_result)
+        self.dispatcher.add_timer(cfg.batch_flush_period_ms / 1000.0,
+                                  self._try_send_pre_prepare)
+        self.collector_pool = CollectorPool(
+            lambda res: self.incoming.push_internal("combine", res))
+
+        # --- metrics (names mirror the reference's replica component) ---
+        self.metrics = Component("replica", self.aggregator)
+        self.m_executed = self.metrics.register_counter("executed_requests")
+        self.m_preprepares = self.metrics.register_counter("sent_preprepares")
+        self.m_view = self.metrics.register_gauge("view")
+        self.m_last_executed = self.metrics.register_gauge("last_executed_seq")
+        self.m_last_stable = self.metrics.register_gauge("last_stable_seq")
+
+        self._restore_window(window_msgs)
+        self._running = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self.comm.start(self)
+        self.dispatcher.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self.dispatcher.stop()
+        self.collector_pool.shutdown()
+        self.comm.stop()
+
+    @property
+    def is_primary(self) -> bool:
+        return self.info.primary_of_view(self.view) == self.id
+
+    @property
+    def primary(self) -> int:
+        return self.info.primary_of_view(self.view)
+
+    # ------------------------------------------------------------------
+    # transport upcall (any thread) → queue
+    # ------------------------------------------------------------------
+    def on_new_message(self, sender: int, data: bytes) -> None:
+        self.incoming.push_external(sender, data)
+
+    # ------------------------------------------------------------------
+    # dispatch (dispatcher thread)
+    # ------------------------------------------------------------------
+    def _on_external(self, sender: int, raw: bytes) -> None:
+        try:
+            msg = m.unpack(raw)
+        except m.MsgError:
+            return
+        if getattr(msg, "sender_id", sender) != sender:
+            return                              # sender spoofing: drop
+        if isinstance(msg, m.ClientRequestMsg):
+            self._on_client_request(msg)
+        elif isinstance(msg, m.PrePrepareMsg):
+            self._on_pre_prepare(msg)
+        elif isinstance(msg, m.PreparePartialMsg):
+            self._on_share(msg, "prepare")
+        elif isinstance(msg, m.PrepareFullMsg):
+            self._on_prepare_full(msg)
+        elif isinstance(msg, m.CommitPartialMsg):
+            self._on_share(msg, "commit")
+        elif isinstance(msg, m.CommitFullMsg):
+            self._on_commit_full(msg)
+        elif isinstance(msg, m.CheckpointMsg):
+            self._on_checkpoint(msg)
+
+    # ------------------------------------------------------------------
+    # client requests (ReplicaImp.cpp:397)
+    # ------------------------------------------------------------------
+    def _on_client_request(self, req: m.ClientRequestMsg) -> None:
+        client = req.sender_id
+        if not self.clients.is_valid_client(client):
+            return
+        if not self.sig.verify(client, req.signed_payload(), req.signature):
+            return
+        if req.flags & m.RequestFlag.READ_ONLY:
+            reply = self.handler.read(client, req.request)
+            self._send_reply(client, req.req_seq_num, reply)
+            return
+        cached = self.clients.cached_reply(client, req.req_seq_num)
+        if cached is not None:
+            self.comm.send(client, cached.pack())
+            return
+        if not self.is_primary:
+            # forward to the current primary (reference forwards or the
+            # client retransmits; forwarding is cheap and speeds recovery)
+            self.comm.send(self.primary, req.pack())
+            return
+        if not self.clients.can_become_pending(client, req.req_seq_num):
+            return
+        self.clients.add_pending(client, req.req_seq_num, req.cid)
+        self.pending_requests.append(req)
+        self._try_send_pre_prepare()
+
+    # ------------------------------------------------------------------
+    # primary: batching + PrePrepare (ReplicaImp.cpp:657,865)
+    # ------------------------------------------------------------------
+    def _try_send_pre_prepare(self) -> None:
+        if not (self._running and self.is_primary and self.pending_requests):
+            return
+        seq = self.primary_next_seq
+        if seq > self.last_stable + self.cfg.work_window_size:
+            return                              # window full: wait for stability
+        batch = self.pending_requests[:self.cfg.max_num_of_requests_in_batch]
+        self.pending_requests = self.pending_requests[len(batch):]
+        raw_reqs = [r.pack() for r in batch]
+        pp = m.PrePrepareMsg(
+            sender_id=self.id, view=self.view, seq_num=seq,
+            first_path=int(m.CommitPath.SLOW),
+            time=int(time.time() * 1e6),
+            requests_digest=m.PrePrepareMsg.compute_requests_digest(raw_reqs),
+            requests=raw_reqs, signature=b"")
+        pp.signature = self.sig.sign(pp.signed_payload())
+        self.primary_next_seq = seq + 1
+        self.m_preprepares.inc()
+        self._broadcast(pp)
+        self._accept_pre_prepare(pp)            # primary processes its own
+
+    # ------------------------------------------------------------------
+    # PrePrepare (ReplicaImp.cpp:1047)
+    # ------------------------------------------------------------------
+    def _on_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
+        if pp.view != self.view or pp.sender_id != self.primary:
+            return
+        if not self.window.in_window(pp.seq_num) or pp.seq_num <= self.last_stable:
+            return
+        info = self.window.get(pp.seq_num)
+        if info.pre_prepare is not None:
+            return                              # already have it
+        if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature):
+            return
+        self._accept_pre_prepare(pp)
+
+    def _accept_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
+        info = self.window.get(pp.seq_num)
+        info.pre_prepare = pp
+        info.commit_path = pp.first_path
+        with self._tran() as st:
+            st.seq(pp.seq_num).pre_prepare = pp.pack()
+        self._send_prepare_partial(info)
+        self._drain_early_shares(info)
+
+    # ------------------------------------------------------------------
+    # slow path: shares → collectors (ReplicaImp.cpp:1373,1399)
+    # ------------------------------------------------------------------
+    def _send_prepare_partial(self, info: SeqNumInfo) -> None:
+        pp = info.pre_prepare
+        d = share_digest("prepare", self.view, pp.seq_num, pp.digest())
+        share = self.slow_signer.sign_share(d)
+        msg = m.PreparePartialMsg(sender_id=self.id, view=self.view,
+                                  seq_num=pp.seq_num, digest=d, sig=share)
+        collector_id = self.info.collector_for(self.view, pp.seq_num)
+        if collector_id == self.id:
+            self._on_share(msg, "prepare")
+        else:
+            self.comm.send(collector_id, msg.pack())
+
+    def _send_commit_partial(self, info: SeqNumInfo) -> None:
+        pp = info.pre_prepare
+        d = share_digest("commit", self.view, pp.seq_num, pp.digest())
+        share = self.slow_signer.sign_share(d)
+        msg = m.CommitPartialMsg(sender_id=self.id, view=self.view,
+                                 seq_num=pp.seq_num, digest=d, sig=share)
+        collector_id = self.info.collector_for(self.view, pp.seq_num)
+        if collector_id == self.id:
+            self._on_share(msg, "commit")
+        else:
+            self.comm.send(collector_id, msg.pack())
+
+    def _on_share(self, msg: m.PreparePartialMsg, kind: str) -> None:
+        """Collector side: accumulate a threshold share
+        (CollectorOfThresholdSignatures::addMsgWithPartialSignature)."""
+        if msg.view != self.view or not self.info.is_replica(msg.sender_id):
+            return
+        if not self.window.in_window(msg.seq_num) \
+                or msg.seq_num <= self.last_stable:
+            return
+        info = self.window.get(msg.seq_num)
+        if info.pre_prepare is None:
+            info.early_shares.setdefault(kind, []).append(msg)
+            return
+        collector = self._collector(info, kind)
+        if collector is None or msg.digest != collector.digest:
+            return                              # share over a wrong digest
+        if collector.add_share(msg.sender_id, msg.sig):
+            self.collector_pool.maybe_launch(collector)
+
+    def _collector(self, info: SeqNumInfo, kind: str) -> Optional[ShareCollector]:
+        pp = info.pre_prepare
+        if pp is None:
+            return None
+        attr = f"{kind}_collector"
+        col = getattr(info, attr)
+        if col is None:
+            d = share_digest(kind, self.view, pp.seq_num, pp.digest())
+            col = ShareCollector(self.view, pp.seq_num, kind, d,
+                                 self.slow_verifier)
+            setattr(info, attr, col)
+        return col
+
+    def _drain_early_shares(self, info: SeqNumInfo) -> None:
+        for kind, msgs in list(info.early_shares.items()):
+            info.early_shares[kind] = []
+            for msg in msgs:
+                self._on_share(msg, kind)
+
+    # ------------------------------------------------------------------
+    # combine results (internal msg; reference onInternalMsg :1517)
+    # ------------------------------------------------------------------
+    def _on_combine_result(self, res: CombineResult) -> None:
+        if res.view != self.view or not self.window.in_window(res.seq_num):
+            return
+        info = self.window.peek(res.seq_num)
+        if info is None or info.pre_prepare is None:
+            return
+        if not res.ok:
+            # bad shares identified; drop them and await honest quorum
+            col = getattr(info, f"{res.kind}_collector", None)
+            if col is not None:
+                for sid in res.bad_shares:
+                    col.shares.pop(sid, None)
+            return
+        pp = info.pre_prepare
+        d = share_digest(res.kind, self.view, pp.seq_num, pp.digest())
+        if res.kind == "prepare":
+            full = m.PrepareFullMsg(sender_id=self.id, view=self.view,
+                                    seq_num=res.seq_num, digest=d,
+                                    sig=res.combined_sig)
+            self._broadcast(full)
+            self._accept_prepare_full(full)
+        elif res.kind == "commit":
+            full = m.CommitFullMsg(sender_id=self.id, view=self.view,
+                                   seq_num=res.seq_num, digest=d,
+                                   sig=res.combined_sig)
+            self._broadcast(full)
+            self._accept_commit_full(full)
+
+    # ------------------------------------------------------------------
+    # full certificates
+    # ------------------------------------------------------------------
+    def _verify_full(self, msg, kind: str) -> bool:
+        if msg.view != self.view or not self.window.in_window(msg.seq_num):
+            return False
+        info = self.window.peek(msg.seq_num)
+        if info is None or info.pre_prepare is None:
+            return False                        # need PP first (ReqMissing later)
+        d = share_digest(kind, self.view, msg.seq_num,
+                         info.pre_prepare.digest())
+        if msg.digest != d:
+            return False
+        return self.slow_verifier.verify(d, msg.sig)
+
+    def _on_prepare_full(self, msg: m.PrepareFullMsg) -> None:
+        if self._verify_full(msg, "prepare"):
+            self._accept_prepare_full(msg)
+
+    def _accept_prepare_full(self, msg: m.PrepareFullMsg) -> None:
+        info = self.window.get(msg.seq_num)
+        if info.prepared:
+            return
+        info.prepare_full = msg
+        info.prepared = True
+        with self._tran() as st:
+            st.seq(msg.seq_num).prepare_full = msg.pack()
+        self._send_commit_partial(info)
+
+    def _on_commit_full(self, msg: m.CommitFullMsg) -> None:
+        if self._verify_full(msg, "commit"):
+            self._accept_commit_full(msg)
+
+    def _accept_commit_full(self, msg: m.CommitFullMsg) -> None:
+        info = self.window.get(msg.seq_num)
+        if info.committed:
+            return
+        info.commit_full = msg
+        info.committed = True
+        with self._tran() as st:
+            st.seq(msg.seq_num).commit_full = msg.pack()
+        self._execute_committed()
+
+    # ------------------------------------------------------------------
+    # execution (ReplicaImp.cpp:5720,5364)
+    # ------------------------------------------------------------------
+    def _execute_committed(self) -> None:
+        while True:
+            nxt = self.last_executed + 1
+            if not self.window.in_window(nxt):
+                return
+            info = self.window.peek(nxt)
+            if info is None or not info.committed or info.executed:
+                return
+            for req in info.pre_prepare.client_requests():
+                reply = self.handler.execute(req.sender_id, req.req_seq_num,
+                                             req.flags, req.request)
+                self.m_executed.inc()
+                self._send_reply(req.sender_id, req.req_seq_num, reply)
+            info.executed = True
+            self.last_executed = nxt
+            self.m_last_executed.set(nxt)
+            with self._tran() as st:
+                st.last_executed_seq = nxt
+            if nxt % self.cfg.checkpoint_window_size == 0:
+                self._send_checkpoint(nxt)
+
+    def _send_reply(self, client: int, req_seq: int, payload: bytes) -> None:
+        reply = m.ClientReplyMsg(sender_id=self.id, req_seq_num=req_seq,
+                                 current_primary=self.primary, reply=payload,
+                                 replica_specific_info=b"")
+        self.clients.on_request_executed(client, req_seq, reply)
+        self.comm.send(client, reply.pack())
+
+    # ------------------------------------------------------------------
+    # checkpointing (ReplicaImp.cpp:2280,3274,3439)
+    # ------------------------------------------------------------------
+    def _send_checkpoint(self, seq: int) -> None:
+        ck = m.CheckpointMsg(sender_id=self.id, seq_num=seq,
+                             state_digest=self.handler.state_digest(),
+                             is_stable=False, signature=b"")
+        ck.signature = self.sig.sign(ck.signed_payload())
+        self._broadcast(ck)
+        self._store_checkpoint(ck)
+
+    def _on_checkpoint(self, ck: m.CheckpointMsg) -> None:
+        if not self.info.is_replica(ck.sender_id):
+            return
+        if ck.seq_num <= self.last_stable:
+            return
+        if not self.sig.verify(ck.sender_id, ck.signed_payload(),
+                               ck.signature):
+            return
+        self._store_checkpoint(ck)
+
+    def _store_checkpoint(self, ck: m.CheckpointMsg) -> None:
+        slot = self.checkpoints.setdefault(ck.seq_num, {})
+        slot[ck.sender_id] = ck
+        matching = sum(1 for other in slot.values()
+                       if other.state_digest == ck.state_digest)
+        if matching >= self.info.checkpoint_quorum \
+                and ck.seq_num <= self.last_executed:
+            self._on_seq_stable(ck.seq_num)
+
+    def _on_seq_stable(self, seq: int) -> None:
+        """onSeqNumIsStable: slide the work window, GC old state."""
+        if seq <= self.last_stable:
+            return
+        self.last_stable = seq
+        self.m_last_stable.set(seq)
+        self.window.advance(seq)
+        for s in [s for s in self.checkpoints if s <= seq]:
+            del self.checkpoints[s]
+        with self._tran() as st:
+            st.last_stable_seq = seq
+            for s in [s for s in st.seq_states if s <= seq]:
+                del st.seq_states[s]
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _broadcast(self, msg) -> None:
+        raw = msg.pack()
+        for r in self.info.other_replicas(self.id):
+            self.comm.send(r, raw)
+
+    def _tran(self):
+        storage = self.storage
+
+        class _Ctx:
+            def __enter__(self_inner):
+                return storage.begin_write_tran()
+
+            def __exit__(self_inner, *exc):
+                storage.end_write_tran()
+                return False
+        return _Ctx()
+
+    def _restore_window(self, window_msgs: Dict[int, dict]) -> None:
+        """Seed in-flight state from persisted metadata (ReplicaLoader)."""
+        for seq, row in sorted(window_msgs.items()):
+            if not self.window.in_window(seq):
+                continue
+            info = self.window.get(seq)
+            pp = row.get("pre_prepare")
+            if pp is not None and pp.view == self.view:
+                info.pre_prepare = pp
+                info.commit_path = pp.first_path
+            pf = row.get("prepare_full")
+            if pf is not None and info.pre_prepare is not None:
+                info.prepare_full = pf
+                info.prepared = True
+            cf = row.get("commit_full")
+            if cf is not None and info.pre_prepare is not None:
+                info.commit_full = cf
+                info.committed = True
+            info.slow_started = row.get("slow_started", False)
+        # re-execute anything committed-but-unexecuted (recoverRequests)
+        self._execute_committed()

@@ -1,0 +1,82 @@
+"""Cluster topology arithmetic.
+
+Rebuild of the reference's ReplicasInfo
+(/root/reference/bftengine/src/bftengine/ReplicasInfo.hpp): replica/client
+id ranges, primary-of-view, and collector selection for threshold shares.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpubft.utils.config import ReplicaConfig
+
+
+@dataclass(frozen=True)
+class ReplicasInfo:
+    n: int
+    f: int
+    c: int
+    num_ro: int = 0
+    num_clients: int = 16
+
+    @classmethod
+    def from_config(cls, cfg: ReplicaConfig) -> "ReplicasInfo":
+        return cls(n=cfg.n_val, f=cfg.f_val, c=cfg.c_val,
+                   num_ro=cfg.num_ro_replicas,
+                   num_clients=cfg.num_of_client_proxies)
+
+    # ---- id ranges (reference convention: replicas, then RO, then clients)
+    @property
+    def replica_ids(self) -> range:
+        return range(self.n)
+
+    @property
+    def first_client_id(self) -> int:
+        return self.n + self.num_ro
+
+    def is_replica(self, node: int) -> bool:
+        return 0 <= node < self.n
+
+    def is_client(self, node: int) -> bool:
+        return node >= self.first_client_id
+
+    def other_replicas(self, me: int) -> list:
+        return [r for r in self.replica_ids if r != me]
+
+    # ---- roles ----
+    def primary_of_view(self, view: int) -> int:
+        return view % self.n
+
+    def collector_for(self, view: int, seq_num: int) -> int:
+        """Collector of threshold shares for (view, seq). The reference
+        supports rotating collectors (getCollectorsForPartialProofs); the
+        primary is the default collector."""
+        return self.primary_of_view(view)
+
+    # ---- quorums ----
+    @property
+    def slow_quorum(self) -> int:
+        return 2 * self.f + self.c + 1
+
+    @property
+    def fast_threshold_quorum(self) -> int:
+        return 3 * self.f + self.c + 1
+
+    @property
+    def optimistic_quorum(self) -> int:
+        return self.n
+
+    @property
+    def checkpoint_quorum(self) -> int:
+        return self.f + 1
+
+    @property
+    def view_change_quorum(self) -> int:
+        """2f + 2c + 1 ViewChangeMsgs form a new-view certificate
+        (reference ViewsManager)."""
+        return 2 * self.f + 2 * self.c + 1
+
+    @property
+    def complaint_quorum(self) -> int:
+        """f + 1 ReplicaAsksToLeaveView complaints trigger a view change."""
+        return self.f + 1

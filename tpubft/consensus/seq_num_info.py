@@ -1,0 +1,96 @@
+"""Per-seqnum consensus state + the sliding work window.
+
+Rebuild of the reference's SeqNumInfo
+(/root/reference/bftengine/src/bftengine/SeqNumInfo.hpp:34) and
+SequenceWithActiveWindow (SequenceWithActiveWindow.hpp): each in-flight
+seqnum holds the PrePrepare, the prepare/commit share collectors (slow
+path), the fast-path collector, and the full (combined) certificates;
+the window slides on stable checkpoints.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Generic, Iterator, Optional, TypeVar
+
+from tpubft.consensus.collectors import ShareCollector
+from tpubft.consensus.messages import (CommitFullMsg, FullCommitProofMsg,
+                                       PrePrepareMsg, PrepareFullMsg)
+
+
+@dataclass
+class SeqNumInfo:
+    seq_num: int
+    pre_prepare: Optional[PrePrepareMsg] = None
+    commit_path: Optional[int] = None          # CommitPath actually taken
+    slow_started: bool = False
+    # slow path
+    prepare_collector: Optional[ShareCollector] = None
+    prepare_full: Optional[PrepareFullMsg] = None
+    commit_collector: Optional[ShareCollector] = None
+    commit_full: Optional[CommitFullMsg] = None
+    # fast path
+    fast_collector: Optional[ShareCollector] = None
+    full_commit_proof: Optional[FullCommitProofMsg] = None
+    # flags
+    prepared: bool = False
+    committed: bool = False
+    executed: bool = False
+    # shares that arrived before our PrePrepare did (reference keeps them
+    # in the collectors keyed by digest; we buffer until digest is known)
+    early_shares: Dict[str, list] = field(default_factory=dict)
+
+    def reset_for_view(self) -> None:
+        """On view change, in-flight non-committed state is rebuilt."""
+        if not self.committed:
+            self.prepare_collector = None
+            self.prepare_full = None
+            self.commit_collector = None
+            self.commit_full = None
+            self.fast_collector = None
+            self.prepared = False
+            self.slow_started = False
+            self.commit_path = None
+
+
+T = TypeVar("T")
+
+
+class ActiveWindow(Generic[T]):
+    """Sliding window keyed by seqnum: (stable, stable + size]. The
+    reference's SequenceWithActiveWindow with kWorkWindowSize=300."""
+
+    def __init__(self, size: int, factory):
+        self._size = size
+        self._factory = factory
+        self._base = 0                         # last stable seq
+        self._items: Dict[int, T] = {}
+
+    @property
+    def base(self) -> int:
+        return self._base
+
+    def in_window(self, seq: int) -> bool:
+        return self._base < seq <= self._base + self._size
+
+    def get(self, seq: int) -> T:
+        if not self.in_window(seq):
+            raise KeyError(f"seq {seq} outside window "
+                           f"({self._base}, {self._base + self._size}]")
+        item = self._items.get(seq)
+        if item is None:
+            item = self._items[seq] = self._factory(seq)
+        return item
+
+    def peek(self, seq: int) -> Optional[T]:
+        return self._items.get(seq)
+
+    def advance(self, new_base: int) -> None:
+        """Slide forward on stable checkpoint; drops state <= new_base."""
+        if new_base <= self._base:
+            return
+        self._base = new_base
+        for s in [s for s in self._items if s <= new_base]:
+            del self._items[s]
+
+    def items(self) -> Iterator:
+        return iter(sorted(self._items.items()))

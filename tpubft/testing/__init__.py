@@ -1,0 +1,4 @@
+"""In-process test harnesses (reference fake_comm.h + Apollo's BftTestNetwork)."""
+from tpubft.testing.cluster import InProcessCluster
+
+__all__ = ["InProcessCluster"]

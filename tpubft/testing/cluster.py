@@ -1,0 +1,104 @@
+"""In-process multi-replica cluster over the loopback bus.
+
+The unit/integration-test equivalent of the reference's in-process
+multi-node fixtures (client/bftclient fake_comm.h quorum simulations +
+tests/simpleTest in-proc mode): n replicas + clients share one LoopbackBus,
+so byzantine hooks (drop/mutate) apply to the whole cluster.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from tpubft.bftclient import BftClient, ClientConfig
+from tpubft.comm.loopback import LoopbackBus
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.consensus.persistent import PersistentStorage
+from tpubft.consensus.replica import IRequestsHandler, Replica
+from tpubft.utils.config import ReplicaConfig
+from tpubft.utils.metrics import Aggregator
+
+
+class InProcessCluster:
+    def __init__(self, f: int = 1, c: int = 0, num_clients: int = 2,
+                 handler_factory: Optional[Callable[[], IRequestsHandler]] = None,
+                 cfg_overrides: Optional[dict] = None,
+                 storage_factory: Optional[Callable[[int], PersistentStorage]] = None,
+                 seed: bytes = b"tpubft-test-cluster"):
+        from tpubft.apps.counter import CounterHandler
+        self.handler_factory = handler_factory or CounterHandler
+        base_cfg = ReplicaConfig(f_val=f, c_val=c,
+                                 num_of_client_proxies=num_clients,
+                                 **(cfg_overrides or {}))
+        self.n = base_cfg.n_val
+        self.bus = LoopbackBus()
+        self.keys = ClusterKeys.generate(base_cfg, num_clients, seed=seed)
+        self.aggregators: Dict[int, Aggregator] = {}
+        self.handlers: Dict[int, IRequestsHandler] = {}
+        self.replicas: Dict[int, Replica] = {}
+        self.storage_factory = storage_factory
+        self._cfg_overrides = cfg_overrides or {}
+        self._num_clients = num_clients
+        self.f, self.c = f, c
+        for r in range(self.n):
+            self._make_replica(r)
+        self.clients: Dict[int, BftClient] = {}
+
+    def _make_replica(self, r: int) -> Replica:
+        cfg = ReplicaConfig(replica_id=r, f_val=self.f, c_val=self.c,
+                            num_of_client_proxies=self._num_clients,
+                            **self._cfg_overrides)
+        agg = self.aggregators[r] = Aggregator()
+        try:
+            handler = self.handler_factory(r)   # id-aware factories
+        except TypeError:
+            handler = self.handler_factory()
+        self.handlers[r] = handler
+        storage = (self.storage_factory(r) if self.storage_factory else None)
+        rep = Replica(cfg, self.keys.for_node(r), self.bus.create(r),
+                      handler, storage=storage, aggregator=agg)
+        self.replicas[r] = rep
+        return rep
+
+    def start(self) -> "InProcessCluster":
+        for rep in self.replicas.values():
+            rep.start()
+        return self
+
+    def stop(self) -> None:
+        for cl in self.clients.values():
+            cl.stop()
+        for rep in self.replicas.values():
+            rep.stop()
+        self.bus.shutdown()
+
+    def client(self, idx: int = 0, **cfg_kw) -> BftClient:
+        client_id = self.n + idx
+        cl = self.clients.get(client_id)
+        if cl is None:
+            cfg = ClientConfig(client_id=client_id, f_val=self.f,
+                               c_val=self.c, **cfg_kw)
+            cl = BftClient(cfg, self.keys.for_node(client_id),
+                           self.bus.create(client_id))
+            self.clients[client_id] = cl
+        return cl
+
+    # ---- fault injection ----
+    def kill(self, replica_id: int) -> None:
+        self.replicas[replica_id].stop()
+
+    def restart(self, replica_id: int) -> Replica:
+        """Stop + recreate from persistent storage (crash recovery)."""
+        self.kill(replica_id)
+        rep = self._make_replica(replica_id)
+        rep.start()
+        return rep
+
+    def metric(self, replica_id: int, kind: str, name: str,
+               component: str = "replica"):
+        return self.aggregators[replica_id].get(component, kind, name)
+
+    def __enter__(self) -> "InProcessCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
