@@ -1,0 +1,59 @@
+"""Byzantine-behavior tests: forged client requests in a PrePrepare must
+be rejected by backups; replayed requests must not re-execute; forwarded
+client requests must still be admitted."""
+import time
+
+from tpubft.apps import counter
+from tpubft.consensus import messages as m
+from tpubft.testing import InProcessCluster
+
+
+def test_backup_rejects_preprepare_with_forged_client_request():
+    with InProcessCluster(f=1) as cluster:
+        primary = cluster.replicas[0]
+        victim_client = cluster.n  # valid client id, but we forge its sig
+        forged = m.ClientRequestMsg(sender_id=victim_client, req_seq_num=999,
+                                    flags=0,
+                                    request=counter.encode_add(1_000_000),
+                                    cid="forged", signature=b"\x00" * 64)
+        raw = [forged.pack()]
+        pp = m.PrePrepareMsg(
+            sender_id=0, view=0, seq_num=1,
+            first_path=int(m.CommitPath.SLOW), time=0,
+            requests_digest=m.PrePrepareMsg.compute_requests_digest(raw),
+            requests=raw, signature=b"")
+        pp.signature = primary.sig.sign(pp.signed_payload())
+        for r in range(1, cluster.n):
+            cluster.bus.post(0, r, pp.pack())
+        time.sleep(0.5)
+        # no backup may sign shares over the forged batch or execute it
+        for r in range(1, cluster.n):
+            assert cluster.handlers[r].value == 0
+            assert cluster.metric(r, "counters", "executed_requests") == 0
+
+
+def test_replayed_request_in_batch_not_reexecuted():
+    """Even if a request seqnum reappears in a later committed batch, it
+    must execute at most once per client."""
+    with InProcessCluster(f=1) as cluster:
+        cl = cluster.client()
+        assert counter.decode_reply(cl.send_write(counter.encode_add(5))) == 5
+        exec_before = cluster.metric(1, "counters", "executed_requests")
+        # a second distinct request executes normally
+        assert counter.decode_reply(cl.send_write(counter.encode_add(2))) == 7
+        assert cluster.metric(1, "counters", "executed_requests") \
+            == exec_before + 1
+
+
+def test_forwarded_client_request_reaches_primary():
+    """A request arriving at a backup must be forwarded to and admitted by
+    the primary (partial-partition recovery path)."""
+    with InProcessCluster(f=1) as cluster:
+        cl = cluster.client()
+        # block the client's direct path to the primary (node 0) only
+        client_id = cluster.n
+        cluster.bus.add_hook(
+            lambda s, d, data: None if (s == client_id and d == 0) else data)
+        v = counter.decode_reply(
+            cl.send_write(counter.encode_add(3), timeout_ms=15000))
+        assert v == 3

@@ -1,0 +1,92 @@
+"""Fast commit path tests: optimistic (n shares), fast-with-threshold
+(3f+c+1), demotion to slow on replica failure, controller adaptation."""
+import time
+
+import pytest
+
+from tpubft.apps import counter
+from tpubft.consensus.controller import (EVALUATION_WINDOW,
+                                         CommitPathController)
+from tpubft.consensus.messages import CommitPath
+from tpubft.testing import InProcessCluster
+
+
+def wait_metric(cluster, r, name, minimum, timeout=5.0, component="replica"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cluster.metric(r, "counters", name, component) >= minimum:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_optimistic_fast_path_commits():
+    """c=0, all replicas alive: commits must use OPTIMISTIC_FAST (one
+    round, n shares), not the slow path."""
+    with InProcessCluster(f=1) as cluster:
+        cl = cluster.client()
+        for i in range(3):
+            cl.send_write(counter.encode_add(1))
+        assert wait_metric(cluster, 0, "fast_path_commits", 3)
+        assert cluster.metric(0, "counters", "slow_path_commits") == 0
+
+
+def test_fast_path_demotes_to_slow_when_replica_down():
+    """Optimistic path needs all n shares; with one backup dead the
+    primary must demote via StartSlowCommit and still commit."""
+    with InProcessCluster(f=1,
+                          cfg_overrides={"fast_path_timeout_ms": 150}) as cluster:
+        cl = cluster.client()
+        cl.send_write(counter.encode_add(1))        # warm fast path
+        cluster.kill(3)
+        v = counter.decode_reply(
+            cl.send_write(counter.encode_add(2), timeout_ms=15000))
+        assert v == 3
+        assert wait_metric(cluster, 0, "slow_path_starts", 1)
+        assert wait_metric(cluster, 0, "slow_path_commits", 1)
+
+
+def test_fast_with_threshold_survives_c_slow_replicas():
+    """c=1: FAST_WITH_THRESHOLD needs 3f+c+1 = 5 of n = 6; one dead
+    replica must not leave the fast path."""
+    with InProcessCluster(f=1, c=1) as cluster:
+        assert cluster.n == 6
+        cl = cluster.client()
+        cl.send_write(counter.encode_add(1))
+        cluster.kill(5)
+        v = counter.decode_reply(
+            cl.send_write(counter.encode_add(2), timeout_ms=15000))
+        assert v == 3
+        assert wait_metric(cluster, 0, "fast_path_commits", 2)
+        assert cluster.metric(0, "counters", "slow_path_starts") == 0
+
+
+def test_controller_demotes_and_reprobes():
+    ctl = CommitPathController(f=1, c=0)
+    assert ctl.current_path is CommitPath.OPTIMISTIC_FAST
+    # a window full of fallbacks: demote one step
+    for i in range(EVALUATION_WINDOW):
+        ctl.on_slow_fallback(i)
+    assert ctl.current_path is CommitPath.FAST_WITH_THRESHOLD
+    for i in range(EVALUATION_WINDOW):
+        ctl.on_slow_fallback(i)
+    assert ctl.current_path is CommitPath.SLOW
+    # stability in SLOW probes one step faster
+    for i in range(EVALUATION_WINDOW):
+        ctl.on_slow_path_commit(i)
+    assert ctl.current_path is CommitPath.FAST_WITH_THRESHOLD
+    # sustained fast success promotes back to fastest
+    for i in range(EVALUATION_WINDOW):
+        ctl.on_fast_path_commit(i)
+    assert ctl.current_path is CommitPath.OPTIMISTIC_FAST
+
+
+def test_controller_mixed_history_holds_path():
+    ctl = CommitPathController(f=1, c=0)
+    # 20% failures: under the 30% demote threshold — hold OPTIMISTIC
+    for i in range(EVALUATION_WINDOW):
+        if i % 5 == 0:
+            ctl.on_slow_fallback(i)
+        else:
+            ctl.on_fast_path_commit(i)
+    assert ctl.current_path is CommitPath.OPTIMISTIC_FAST

@@ -46,6 +46,7 @@ class ShareCollector:
         self.shares: Dict[int, bytes] = {}     # signer id (1-based) -> share
         self.combined: Optional[bytes] = None
         self.job_launched = False
+        self.last_attempt: Optional[frozenset] = None
 
     def add_share(self, signer_id: int, share: bytes) -> bool:
         """Store a share (0-based replica id). Returns True if new."""
@@ -59,16 +60,22 @@ class ShareCollector:
         return len(self.shares) >= self.verifier.threshold
 
     def ready_for_job(self) -> bool:
+        """Quorum reached, no job in flight, not combined yet, and the
+        share set changed since the last (failed) attempt — identical
+        inputs would fail identically."""
         return (self.has_quorum() and not self.job_launched
-                and self.combined is None)
+                and self.combined is None
+                and frozenset(self.shares) != self.last_attempt)
 
-    def combine_and_verify(self) -> CombineResult:
+    def combine_and_verify(self, shares: Dict[int, bytes]) -> CombineResult:
         """The background job body (reference SignaturesProcessingJob
-        ::execute): accumulate WITHOUT share verification, combine, verify
-        the combined signature; on failure verify shares individually."""
+        ::execute) over a SNAPSHOT of the shares (the dispatcher thread
+        keeps mutating self.shares): accumulate WITHOUT share
+        verification, combine, verify the combined signature; on failure
+        verify shares individually."""
         acc = self.verifier.new_accumulator(with_share_verification=False)
         acc.set_expected_digest(self.digest)
-        for sid, share in self.shares.items():
+        for sid, share in shares.items():
             acc.add(sid, share)
         combined = acc.get_full_signed_data()
         if self.verifier.verify(self.digest, combined):
@@ -92,23 +99,27 @@ class CollectorPool:
         self._closed = False
 
     def maybe_launch(self, collector: ShareCollector) -> bool:
+        """Called on the dispatcher thread only; snapshots the share set
+        so the job never races dispatcher-side mutations."""
         if self._closed or not collector.ready_for_job():
             return False
         collector.job_launched = True
-        self._pool.submit(self._run, collector)
+        snapshot = dict(collector.shares)
+        collector.last_attempt = frozenset(snapshot)
+        self._pool.submit(self._run, collector, snapshot)
         return True
 
-    def _run(self, collector: ShareCollector) -> None:
+    def _run(self, collector: ShareCollector, shares) -> None:
         try:
-            result = collector.combine_and_verify()
+            result = collector.combine_and_verify(shares)
         except Exception:  # noqa: BLE001 — job failure = combine failure
             import traceback
             traceback.print_exc()
             result = CombineResult(collector.view, collector.seq_num,
                                    collector.kind, False)
-        collector.job_launched = False
         if result.ok:
             collector.combined = result.combined_sig
+        collector.job_launched = False
         self._post(result)
 
     def shutdown(self) -> None:

@@ -32,6 +32,7 @@ from tpubft.consensus import messages as m
 from tpubft.consensus.clients_manager import ClientsManager
 from tpubft.consensus.collectors import (CollectorPool, CombineResult,
                                          ShareCollector)
+from tpubft.consensus.controller import CommitPathController
 from tpubft.consensus.incoming import Dispatcher, IncomingMsgsStorage
 from tpubft.consensus.keys import ClusterKeys
 from tpubft.consensus.persistent import (InMemoryPersistentStorage,
@@ -85,10 +86,18 @@ class Replica(IReceiver):
         self.aggregator = aggregator or Aggregator()
 
         self.sig = SigManager(keys, self.aggregator)
-        # threshold machinery for the slow path (CryptoManager.hpp:109-111)
-        sysm = keys.slow_path_system
-        self.slow_signer = keys.threshold_signer(sysm, self.id)
-        self.slow_verifier = keys.threshold_verifier(sysm)
+        # threshold machinery per commit path (CryptoManager.hpp:109-111):
+        # slow = 2f+c+1, fast-with-threshold = 3f+c+1, optimistic = n
+        self.slow_signer = keys.threshold_signer(keys.slow_path_system,
+                                                 self.id)
+        self.slow_verifier = keys.threshold_verifier(keys.slow_path_system)
+        self.thr_signer = keys.threshold_signer(keys.commit_path_system,
+                                                self.id)
+        self.thr_verifier = keys.threshold_verifier(keys.commit_path_system)
+        self.opt_signer = keys.threshold_signer(keys.optimistic_system,
+                                                self.id)
+        self.opt_verifier = keys.threshold_verifier(keys.optimistic_system)
+        self.controller = CommitPathController(cfg.f_val, cfg.c_val)
 
         # --- protocol state (dispatcher-thread only) ---
         st, window_msgs = restore_replica_state(self.storage)
@@ -113,6 +122,8 @@ class Replica(IReceiver):
         self.dispatcher.register_internal("combine", self._on_combine_result)
         self.dispatcher.add_timer(cfg.batch_flush_period_ms / 1000.0,
                                   self._try_send_pre_prepare)
+        self.dispatcher.add_timer(cfg.fast_path_timeout_ms / 1000.0 / 4,
+                                  self._check_fast_path_timeouts)
         self.collector_pool = CollectorPool(
             lambda res: self.incoming.push_internal("combine", res))
 
@@ -120,6 +131,9 @@ class Replica(IReceiver):
         self.metrics = Component("replica", self.aggregator)
         self.m_executed = self.metrics.register_counter("executed_requests")
         self.m_preprepares = self.metrics.register_counter("sent_preprepares")
+        self.m_fast_commits = self.metrics.register_counter("fast_path_commits")
+        self.m_slow_commits = self.metrics.register_counter("slow_path_commits")
+        self.m_slow_starts = self.metrics.register_counter("slow_path_starts")
         self.m_view = self.metrics.register_gauge("view")
         self.m_last_executed = self.metrics.register_gauge("last_executed_seq")
         self.m_last_stable = self.metrics.register_gauge("last_stable_seq")
@@ -165,11 +179,16 @@ class Replica(IReceiver):
             msg = m.unpack(raw)
         except m.MsgError:
             return
+        if isinstance(msg, m.ClientRequestMsg):
+            # accepted from the client itself OR forwarded by a replica;
+            # either way the client's own signature is verified next
+            if msg.sender_id != sender and not self.info.is_replica(sender):
+                return
+            self._on_client_request(msg)
+            return
         if getattr(msg, "sender_id", sender) != sender:
             return                              # sender spoofing: drop
-        if isinstance(msg, m.ClientRequestMsg):
-            self._on_client_request(msg)
-        elif isinstance(msg, m.PrePrepareMsg):
+        if isinstance(msg, m.PrePrepareMsg):
             self._on_pre_prepare(msg)
         elif isinstance(msg, m.PreparePartialMsg):
             self._on_share(msg, "prepare")
@@ -179,6 +198,12 @@ class Replica(IReceiver):
             self._on_share(msg, "commit")
         elif isinstance(msg, m.CommitFullMsg):
             self._on_commit_full(msg)
+        elif isinstance(msg, m.PartialCommitProofMsg):
+            self._on_share(msg, "fast")
+        elif isinstance(msg, m.FullCommitProofMsg):
+            self._on_full_commit_proof(msg)
+        elif isinstance(msg, m.StartSlowCommitMsg):
+            self._on_start_slow_commit(msg)
         elif isinstance(msg, m.CheckpointMsg):
             self._on_checkpoint(msg)
 
@@ -224,7 +249,7 @@ class Replica(IReceiver):
         raw_reqs = [r.pack() for r in batch]
         pp = m.PrePrepareMsg(
             sender_id=self.id, view=self.view, seq_num=seq,
-            first_path=int(m.CommitPath.SLOW),
+            first_path=int(self.controller.current_path),
             time=int(time.time() * 1e6),
             requests_digest=m.PrePrepareMsg.compute_requests_digest(raw_reqs),
             requests=raw_reqs, signature=b"")
@@ -247,15 +272,35 @@ class Replica(IReceiver):
             return                              # already have it
         if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature):
             return
+        # Verify every embedded client request before signing shares over
+        # the batch — a byzantine primary must not be able to smuggle
+        # forged client operations (reference: per-request verification
+        # via RequestThreadPool, ReplicaImp.cpp onMessage<PrePrepareMsg>).
+        try:
+            reqs = pp.client_requests()
+        except m.MsgError:
+            return
+        items = [(r.sender_id, r.signed_payload(), r.signature)
+                 for r in reqs]
+        if items and not all(self.sig.verify_batch(items)):
+            return
+        for r in reqs:
+            if not self.clients.is_valid_client(r.sender_id):
+                return
         self._accept_pre_prepare(pp)
 
     def _accept_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
         info = self.window.get(pp.seq_num)
         info.pre_prepare = pp
         info.commit_path = pp.first_path
+        info.received_at = time.monotonic()
         with self._tran() as st:
             st.seq(pp.seq_num).pre_prepare = pp.pack()
-        self._send_prepare_partial(info)
+        if pp.first_path == int(m.CommitPath.SLOW):
+            info.slow_started = True
+            self._send_prepare_partial(info)
+        else:
+            self._send_partial_commit_proof(info)
         self._drain_early_shares(info)
 
     # ------------------------------------------------------------------
@@ -285,6 +330,27 @@ class Replica(IReceiver):
         else:
             self.comm.send(collector_id, msg.pack())
 
+    def _fast_tools(self, path: int):
+        """(signer, verifier, domain-tag) for a fast commit path."""
+        if path == int(m.CommitPath.OPTIMISTIC_FAST):
+            return self.opt_signer, self.opt_verifier, "fast0"
+        return self.thr_signer, self.thr_verifier, "fast1"
+
+    def _send_partial_commit_proof(self, info: SeqNumInfo) -> None:
+        """Fast path share (reference sendPartialProof ReplicaImp.cpp:1319)."""
+        pp = info.pre_prepare
+        signer, _, tag = self._fast_tools(pp.first_path)
+        d = share_digest(tag, self.view, pp.seq_num, pp.digest())
+        msg = m.PartialCommitProofMsg(sender_id=self.id, view=self.view,
+                                      seq_num=pp.seq_num, digest=d,
+                                      sig=signer.sign_share(d),
+                                      path=pp.first_path)
+        collector_id = self.info.collector_for(self.view, pp.seq_num)
+        if collector_id == self.id:
+            self._on_share(msg, "fast")
+        else:
+            self.comm.send(collector_id, msg.pack())
+
     def _on_share(self, msg: m.PreparePartialMsg, kind: str) -> None:
         """Collector side: accumulate a threshold share
         (CollectorOfThresholdSignatures::addMsgWithPartialSignature)."""
@@ -297,6 +363,8 @@ class Replica(IReceiver):
         if info.pre_prepare is None:
             info.early_shares.setdefault(kind, []).append(msg)
             return
+        if kind == "fast" and msg.path != info.pre_prepare.first_path:
+            return                              # share for the wrong path
         collector = self._collector(info, kind)
         if collector is None or msg.digest != collector.digest:
             return                              # share over a wrong digest
@@ -310,9 +378,12 @@ class Replica(IReceiver):
         attr = f"{kind}_collector"
         col = getattr(info, attr)
         if col is None:
-            d = share_digest(kind, self.view, pp.seq_num, pp.digest())
-            col = ShareCollector(self.view, pp.seq_num, kind, d,
-                                 self.slow_verifier)
+            if kind == "fast":
+                _, verifier, tag = self._fast_tools(pp.first_path)
+            else:
+                verifier, tag = self.slow_verifier, kind
+            d = share_digest(tag, self.view, pp.seq_num, pp.digest())
+            col = ShareCollector(self.view, pp.seq_num, kind, d, verifier)
             setattr(info, attr, col)
         return col
 
@@ -332,13 +403,24 @@ class Replica(IReceiver):
         if info is None or info.pre_prepare is None:
             return
         if not res.ok:
-            # bad shares identified; drop them and await honest quorum
+            # bad shares identified: drop them, then retry if an honest
+            # quorum is still present (or when the next share arrives)
             col = getattr(info, f"{res.kind}_collector", None)
             if col is not None:
                 for sid in res.bad_shares:
                     col.shares.pop(sid, None)
+                self.collector_pool.maybe_launch(col)
             return
         pp = info.pre_prepare
+        if res.kind == "fast":
+            _, _, tag = self._fast_tools(pp.first_path)
+            d = share_digest(tag, self.view, pp.seq_num, pp.digest())
+            full = m.FullCommitProofMsg(sender_id=self.id, view=self.view,
+                                        seq_num=res.seq_num, digest=d,
+                                        sig=res.combined_sig)
+            self._broadcast(full)
+            self._accept_full_commit_proof(full)
+            return
         d = share_digest(res.kind, self.view, pp.seq_num, pp.digest())
         if res.kind == "prepare":
             full = m.PrepareFullMsg(sender_id=self.id, view=self.view,
@@ -392,9 +474,80 @@ class Replica(IReceiver):
             return
         info.commit_full = msg
         info.committed = True
+        self.m_slow_commits.inc()
+        if self.is_primary and info.pre_prepare is not None:
+            if info.pre_prepare.first_path != int(m.CommitPath.SLOW):
+                self.controller.on_slow_fallback(msg.seq_num)
+            else:
+                self.controller.on_slow_path_commit(msg.seq_num)
         with self._tran() as st:
             st.seq(msg.seq_num).commit_full = msg.pack()
         self._execute_committed()
+
+    # ------------------------------------------------------------------
+    # fast path: full proof + demotion (ReplicaImp.cpp:1468,1284)
+    # ------------------------------------------------------------------
+    def _on_full_commit_proof(self, msg: m.FullCommitProofMsg) -> None:
+        if msg.view != self.view or not self.window.in_window(msg.seq_num):
+            return
+        info = self.window.peek(msg.seq_num)
+        if info is None or info.pre_prepare is None:
+            return
+        _, verifier, tag = self._fast_tools(info.pre_prepare.first_path)
+        d = share_digest(tag, self.view, msg.seq_num,
+                         info.pre_prepare.digest())
+        if msg.digest != d or not verifier.verify(d, msg.sig):
+            return
+        self._accept_full_commit_proof(msg)
+
+    def _accept_full_commit_proof(self, msg: m.FullCommitProofMsg) -> None:
+        info = self.window.get(msg.seq_num)
+        if info.committed:
+            return
+        info.full_commit_proof = msg
+        info.committed = True
+        self.m_fast_commits.inc()
+        if self.is_primary:
+            self.controller.on_fast_path_commit(msg.seq_num)
+        with self._tran() as st:
+            st.seq(msg.seq_num).full_commit_proof = msg.pack()
+        self._execute_committed()
+
+    def _check_fast_path_timeouts(self) -> None:
+        """Primary: demote stuck fast-path seqnums to the slow path
+        (reference's controller timeout → StartSlowCommitMsg)."""
+        if not self.is_primary:
+            return
+        now = time.monotonic()
+        timeout_s = self.cfg.fast_path_timeout_ms / 1e3
+        for seq, info in list(self.window.items()):
+            if (info.pre_prepare is not None and not info.committed
+                    and not info.slow_started
+                    and info.pre_prepare.first_path != int(m.CommitPath.SLOW)
+                    and now - info.received_at > timeout_s):
+                ssc = m.StartSlowCommitMsg(sender_id=self.id, view=self.view,
+                                           seq_num=seq)
+                self._broadcast(ssc)
+                self._start_slow_path(info)
+
+    def _on_start_slow_commit(self, msg: m.StartSlowCommitMsg) -> None:
+        if msg.view != self.view or msg.sender_id != self.primary:
+            return
+        if not self.window.in_window(msg.seq_num):
+            return
+        info = self.window.peek(msg.seq_num)
+        if info is None or info.pre_prepare is None:
+            return
+        self._start_slow_path(info)
+
+    def _start_slow_path(self, info: SeqNumInfo) -> None:
+        if info.slow_started or info.committed:
+            return
+        info.slow_started = True
+        self.m_slow_starts.inc()
+        with self._tran() as st:
+            st.seq(info.seq_num).slow_started = True
+        self._send_prepare_partial(info)
 
     # ------------------------------------------------------------------
     # execution (ReplicaImp.cpp:5720,5364)
@@ -408,6 +561,14 @@ class Replica(IReceiver):
             if info is None or not info.committed or info.executed:
                 return
             for req in info.pre_prepare.client_requests():
+                # at-most-once: a request seqnum already executed for this
+                # client must not re-execute (replay inside a later batch)
+                if req.req_seq_num <= self.clients.last_executed(req.sender_id):
+                    cached = self.clients.cached_reply(req.sender_id,
+                                                       req.req_seq_num)
+                    if cached is not None:
+                        self.comm.send(req.sender_id, cached.pack())
+                    continue
                 reply = self.handler.execute(req.sender_id, req.req_seq_num,
                                              req.flags, req.request)
                 self.m_executed.inc()
@@ -501,6 +662,7 @@ class Replica(IReceiver):
             if pp is not None and pp.view == self.view:
                 info.pre_prepare = pp
                 info.commit_path = pp.first_path
+                info.received_at = time.monotonic()  # fresh fast-path clock
             pf = row.get("prepare_full")
             if pf is not None and info.pre_prepare is not None:
                 info.prepare_full = pf
@@ -508,6 +670,10 @@ class Replica(IReceiver):
             cf = row.get("commit_full")
             if cf is not None and info.pre_prepare is not None:
                 info.commit_full = cf
+                info.committed = True
+            fcp = row.get("full_commit_proof")
+            if fcp is not None and info.pre_prepare is not None:
+                info.full_commit_proof = fcp
                 info.committed = True
             info.slow_started = row.get("slow_started", False)
         # re-execute anything committed-but-unexecuted (recoverRequests)

@@ -35,6 +35,7 @@ class SeqNumInfo:
     prepared: bool = False
     committed: bool = False
     executed: bool = False
+    received_at: float = 0.0                   # monotonic, for path timeout
     # shares that arrived before our PrePrepare did (reference keeps them
     # in the collectors keyed by digest; we buffer until digest is known)
     early_shares: Dict[str, list] = field(default_factory=dict)

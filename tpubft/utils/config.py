@@ -39,6 +39,7 @@ class ReplicaConfig:
     max_reply_size_bytes: int = 1_048_576
 
     # commit paths
+    fast_path_timeout_ms: int = 300     # demote in-flight seq to slow path
     auto_primary_rotation_enabled: bool = False
     view_change_protocol_enabled: bool = True
     pre_execution_enabled: bool = False
