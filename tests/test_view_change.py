@@ -1,0 +1,251 @@
+"""View change: primary failure → complaints → new view → liveness.
+
+Mirrors the reference's Apollo view-change suite
+(tests/apollo/test_skvbc_view_change.py) at in-process scale, plus unit
+tests for the ViewChangeSafetyLogic equivalent.
+"""
+import time
+
+import pytest
+
+from tpubft.apps import counter
+from tpubft.consensus import messages as m
+from tpubft.consensus import view_change as vc
+from tpubft.testing import InProcessCluster
+
+FAST_VC = {"view_change_timer_ms": 500}
+
+
+def wait_for(pred, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_view_change_after_primary_failure():
+    with InProcessCluster(f=1, cfg_overrides=FAST_VC) as cluster:
+        cluster.kill(0)                       # primary of view 0
+        cl = cluster.client()
+        reply = cl.send_write(counter.encode_add(5), timeout_ms=20000)
+        assert counter.decode_reply(reply) == 5
+        # surviving replicas all moved past view 0
+        for r in (1, 2, 3):
+            assert cluster.replicas[r].view >= 1
+            assert cluster.replicas[r].primary != 0
+
+
+def test_committed_state_survives_view_change():
+    with InProcessCluster(f=1, cfg_overrides=FAST_VC) as cluster:
+        cl = cluster.client()
+        assert counter.decode_reply(cl.send_write(counter.encode_add(10))) == 10
+        cluster.kill(0)
+        reply = cl.send_write(counter.encode_add(7), timeout_ms=20000)
+        assert counter.decode_reply(reply) == 17   # history preserved
+        assert wait_for(lambda: all(
+            cluster.handlers[r].value == 17 for r in (1, 2, 3)))
+
+
+def test_progress_resumes_in_new_view():
+    with InProcessCluster(f=1, cfg_overrides=FAST_VC) as cluster:
+        cluster.kill(0)
+        cl = cluster.client()
+        total = 0
+        for delta in (1, 2, 3):
+            total += delta
+            reply = cl.send_write(counter.encode_add(delta),
+                                  timeout_ms=20000)
+            assert counter.decode_reply(reply) == total
+
+
+def test_cascading_view_change_two_dead_primaries():
+    """f=2 (n=7): views 0 and 1 both have dead primaries; the view change
+    must escalate until a live primary (replica 2) is found."""
+    with InProcessCluster(f=2, cfg_overrides=FAST_VC) as cluster:
+        cluster.kill(0)
+        cluster.kill(1)
+        cl = cluster.client()
+        reply = cl.send_write(counter.encode_add(9), timeout_ms=40000)
+        assert counter.decode_reply(reply) == 9
+        live = [r for r in range(2, 7)]
+        assert all(cluster.replicas[r].view >= 2 for r in live)
+
+
+def test_view_metric_updates():
+    with InProcessCluster(f=1, cfg_overrides=FAST_VC) as cluster:
+        cluster.kill(0)
+        cl = cluster.client()
+        cl.send_write(counter.encode_add(1), timeout_ms=20000)
+        assert cluster.metric(1, "gauges", "view") >= 1
+
+
+# ---------------- unit: safety logic ----------------
+
+def test_forged_certificate_rejected():
+    """A certificate whose combined signature is garbage must not create a
+    restriction (a byzantine replica cannot force a bogus re-proposal)."""
+    pp = m.PrePrepareMsg(sender_id=0, view=0, seq_num=5, first_path=2,
+                         time=0, requests_digest=b"\x00" * 32, requests=[],
+                         signature=b"")
+    pp.requests_digest = m.PrePrepareMsg.compute_requests_digest([])
+    cert = m.PreparedCertificate(
+        seq_num=5, view=0, kind=vc.CERT_PREPARE, pp_digest=pp.digest(),
+        combined_sig=b"\xde\xad" * 32, pre_prepare=pp.pack())
+
+    class RejectingVerifier:
+        threshold = 3
+
+        def verify(self, digest, sig):
+            return False
+
+    from tpubft.consensus.replica import share_digest
+    assert vc.validate_certificate(
+        cert, share_digest, lambda kind: RejectingVerifier()) is None
+
+
+def test_cert_inconsistent_preprepare_rejected():
+    """Cert whose embedded PrePrepare doesn't match the claimed digest."""
+    pp = m.PrePrepareMsg(sender_id=0, view=0, seq_num=5, first_path=2,
+                         time=0,
+                         requests_digest=m.PrePrepareMsg.compute_requests_digest([]),
+                         requests=[], signature=b"")
+    cert = m.PreparedCertificate(
+        seq_num=5, view=0, kind=vc.CERT_PREPARE, pp_digest=b"\x11" * 32,
+        combined_sig=b"x", pre_prepare=pp.pack())
+
+    class AcceptingVerifier:
+        threshold = 3
+
+        def verify(self, digest, sig):
+            return True
+
+    from tpubft.consensus.replica import share_digest
+    assert vc.validate_certificate(
+        cert, share_digest, lambda kind: AcceptingVerifier()) is None
+
+
+def test_restrictions_pick_highest_view():
+    from tpubft.consensus.replica import share_digest
+
+    class AcceptingVerifier:
+        threshold = 3
+
+        def verify(self, digest, sig):
+            return True
+
+    def make_vc(sender, view_of_cert):
+        pp = m.PrePrepareMsg(
+            sender_id=0, view=view_of_cert, seq_num=3, first_path=2, time=0,
+            requests_digest=m.PrePrepareMsg.compute_requests_digest([]),
+            requests=[], signature=b"")
+        cert = m.PreparedCertificate(
+            seq_num=3, view=view_of_cert, kind=vc.CERT_PREPARE,
+            pp_digest=pp.digest(), combined_sig=b"sig", pre_prepare=pp.pack())
+        return m.ViewChangeMsg(sender_id=sender, new_view=5,
+                               last_stable_seq=0, prepared=[cert],
+                               signature=b"")
+
+    restr = vc.compute_restrictions(
+        [make_vc(1, 0), make_vc(2, 2), make_vc(3, 1)],
+        share_digest, lambda kind: AcceptingVerifier(), report_quorum=2)
+    assert restr[3].view == 2
+
+
+def test_signed_reports_restrict_fast_path():
+    """f+c+1 matching SIGNED elements (no threshold proof) must produce a
+    restriction — this is the only evidence a fast-path commit leaves at
+    the share signers."""
+    from tpubft.consensus.replica import share_digest
+    pp = m.PrePrepareMsg(
+        sender_id=0, view=0, seq_num=7, first_path=0, time=0,
+        requests_digest=m.PrePrepareMsg.compute_requests_digest([]),
+        requests=[], signature=b"")
+
+    def make_vc(sender):
+        cert = m.PreparedCertificate(
+            seq_num=7, view=0, kind=vc.CERT_SIGNED, pp_digest=pp.digest(),
+            combined_sig=b"", pre_prepare=pp.pack())
+        return m.ViewChangeMsg(sender_id=sender, new_view=1,
+                               last_stable_seq=0, prepared=[cert],
+                               signature=b"")
+
+    # below quorum: no restriction
+    restr = vc.compute_restrictions([make_vc(1)], share_digest,
+                                    lambda kind: None, report_quorum=2)
+    assert 7 not in restr
+    # at quorum: restricted
+    restr = vc.compute_restrictions([make_vc(1), make_vc(2)], share_digest,
+                                    lambda kind: None, report_quorum=2)
+    assert restr[7].requests_digest == pp.requests_digest
+
+
+def test_state_bounded_per_sender():
+    """A byzantine replica spamming complaints/VC msgs for ever-higher
+    views must not grow memory: only its latest is kept."""
+    st = vc.ViewChangeState(complaint_quorum=2, view_change_quorum=3)
+    for view in range(1000):
+        st.add_complaint(m.ReplicaAsksToLeaveViewMsg(
+            sender_id=3, view=view, reason=0, signature=b""))
+        st.add_view_change(m.ViewChangeMsg(
+            sender_id=3, new_view=view + 1, last_stable_seq=0, prepared=[],
+            signature=b""))
+    assert sum(len(d) for d in st.complaints.values()) == 1
+    assert sum(len(d) for d in st.vc_msgs.values()) == 1
+    # stale (lower-view) messages from the same sender are ignored
+    st.add_complaint(m.ReplicaAsksToLeaveViewMsg(
+        sender_id=3, view=5, reason=0, signature=b""))
+    assert st.complaint_count(999) == 1
+    assert st.complaint_count(5) == 0
+
+
+def test_restrictions_survive_crash(tmp_path):
+    """Safety state persisted at view entry must reload after a crash."""
+    from tpubft.consensus.persistent import FilePersistentStorage
+    from tpubft.consensus.view_change import (pack_cert, pack_restriction,
+                                              unpack_cert,
+                                              unpack_restriction)
+    pp = m.PrePrepareMsg(
+        sender_id=0, view=2, seq_num=9, first_path=2, time=0,
+        requests_digest=m.PrePrepareMsg.compute_requests_digest([]),
+        requests=[], signature=b"")
+    restriction = vc.Restriction(seq_num=9, view=2,
+                                 requests_digest=pp.requests_digest,
+                                 pre_prepare=pp.pack())
+    cert = m.PreparedCertificate(
+        seq_num=9, view=2, kind=vc.CERT_PREPARE, pp_digest=pp.digest(),
+        combined_sig=b"csig", pre_prepare=pp.pack())
+    path = str(tmp_path / "meta.wal")
+    storage = FilePersistentStorage(path)
+    st = storage.begin_write_tran()
+    st.restrictions = [pack_restriction(restriction)]
+    st.carried_certs = [pack_cert(cert)]
+    storage.end_write_tran()
+    storage.close()
+
+    reloaded = FilePersistentStorage(path).load()
+    r2 = unpack_restriction(reloaded.restrictions[0])
+    assert (r2.seq_num, r2.view) == (9, 2)
+    assert r2.requests_digest == restriction.requests_digest
+    c2 = unpack_cert(reloaded.carried_certs[0])
+    assert (c2.seq_num, c2.kind, c2.combined_sig) == (9, vc.CERT_PREPARE,
+                                                      b"csig")
+
+
+def test_view_change_state_quorums():
+    st = vc.ViewChangeState(complaint_quorum=2, view_change_quorum=3)
+    for sender in (1, 2):
+        st.add_complaint(m.ReplicaAsksToLeaveViewMsg(
+            sender_id=sender, view=0, reason=0, signature=b""))
+    assert st.has_complaint_quorum(0)
+    assert not st.has_complaint_quorum(1)
+    for sender in (0, 1, 2, 3):
+        st.add_view_change(m.ViewChangeMsg(
+            sender_id=sender, new_view=1, last_stable_seq=0, prepared=[],
+            signature=b""))
+    assert st.has_view_change_quorum(1)
+    quorum = st.quorum_for_new_view(1)
+    # every available msg is used (deterministic order) so no certificate
+    # evidence is discarded
+    assert [v.sender_id for v in quorum] == [0, 1, 2, 3]

@@ -75,3 +75,10 @@ class ClientsManager:
     def last_executed(self, client_id: int) -> int:
         info = self._clients.get(client_id)
         return info.last_executed_req if info else -1
+
+    def clear_pending(self) -> None:
+        """View change: in-flight requests are abandoned; clients will
+        retransmit and the new primary re-admits them."""
+        for info in self._clients.values():
+            info.pending_req_seq = None
+            info.pending_cid = ""

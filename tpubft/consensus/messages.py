@@ -346,12 +346,15 @@ class PreparedCertificate:
     earlier view (reference ViewChangeMsg element + PrepareFull proof)."""
     seq_num: int
     view: int                     # view in which it was prepared
+    kind: int                     # which threshold system signed it
+                                  # (view_change.CERT_* constants)
     pp_digest: bytes
     combined_sig: bytes           # PrepareFull/FullCommitProof combined sig
     pre_prepare: bytes            # packed PrePrepareMsg (so the new primary
                                   # can re-propose without refetching)
-    SPEC = [("seq_num", "u64"), ("view", "u64"), ("pp_digest", "bytes"),
-            ("combined_sig", "bytes"), ("pre_prepare", "bytes")]
+    SPEC = [("seq_num", "u64"), ("view", "u64"), ("kind", "u8"),
+            ("pp_digest", "bytes"), ("combined_sig", "bytes"),
+            ("pre_prepare", "bytes")]
 
 
 @register

@@ -39,6 +39,10 @@ class PersistedState:
     last_stable_seq: int = 0
     in_view_change: bool = False
     seq_states: Dict[int, PersistedSeqState] = field(default_factory=dict)
+    # view-change safety state (reference PersistentStorageDescriptors):
+    # packed view_change.Restriction / messages.PreparedCertificate blobs
+    restrictions: List[bytes] = field(default_factory=list)
+    carried_certs: List[bytes] = field(default_factory=list)
 
     def seq(self, seq_num: int) -> PersistedSeqState:
         st = self.seq_states.get(seq_num)
@@ -131,6 +135,8 @@ class FilePersistentStorage(PersistentStorage):
                 "cf": b64(v.commit_full), "fcp": b64(v.full_commit_proof),
                 "slow": v.slow_started,
             } for k, v in st.seq_states.items()},
+            "restr": [b64(r) for r in st.restrictions],
+            "certs": [b64(c) for c in st.carried_certs],
         }
 
     @staticmethod
@@ -140,7 +146,11 @@ class FilePersistentStorage(PersistentStorage):
         def unb64(x: Optional[str]) -> Optional[bytes]:
             return base64.b64decode(x) if x is not None else None
         st = PersistedState(last_view=d["v"], last_executed_seq=d["e"],
-                            last_stable_seq=d["s"], in_view_change=d["ivc"])
+                            last_stable_seq=d["s"], in_view_change=d["ivc"],
+                            restrictions=[unb64(r)
+                                          for r in d.get("restr", [])],
+                            carried_certs=[unb64(c)
+                                           for c in d.get("certs", [])])
         for k, v in d.get("seqs", {}).items():
             st.seq_states[int(k)] = PersistedSeqState(
                 pre_prepare=unb64(v["pp"]), prepare_full=unb64(v["pf"]),

@@ -41,6 +41,15 @@ from tpubft.consensus.persistent import (InMemoryPersistentStorage,
 from tpubft.consensus.replicas_info import ReplicasInfo
 from tpubft.consensus.seq_num_info import ActiveWindow, SeqNumInfo
 from tpubft.consensus.sig_manager import SigManager
+from tpubft.consensus.view_change import (CERT_COMMIT, CERT_FAST_OPT,
+                                          CERT_FAST_THR, CERT_PREPARE,
+                                          CERT_SIGNED, Restriction,
+                                          ViewChangeState,
+                                          build_certificates,
+                                          compute_restrictions, pack_cert,
+                                          pack_restriction, unpack_cert,
+                                          unpack_restriction,
+                                          validate_certificate)
 from tpubft.crypto.digest import digest as sha256
 from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.metrics import Aggregator, Component
@@ -115,6 +124,29 @@ class Replica(IReceiver):
         self.pending_requests: List[m.ClientRequestMsg] = []
         self.checkpoints: Dict[int, Dict[int, m.CheckpointMsg]] = {}
 
+        # --- view change state (ViewsManager equivalent) ---
+        self.vc = ViewChangeState(self.info.complaint_quorum,
+                                  self.info.view_change_quorum)
+        self.in_view_change = st.in_view_change
+        self.pending_view: Optional[int] = None
+        # safety state surviving crashes mid-view-change (the reference
+        # persists view-change descriptors, PersistentStorageDescriptors):
+        # restrictions = what the current view's primary must re-propose;
+        # carried_certs = evidence from earlier views, keyed by
+        # (seq, is_signed_element) — a threshold cert and our own SIGNED
+        # report can coexist for one seqnum
+        self.restrictions: Dict[int, Restriction] = {
+            r.seq_num: r for r in map(unpack_restriction, st.restrictions)}
+        self.carried_certs: Dict[tuple, m.PreparedCertificate] = {}
+        for raw in st.carried_certs:
+            cert = unpack_cert(raw)
+            self.carried_certs[(cert.seq_num, cert.kind == CERT_SIGNED)] = cert
+        self._my_vc_msg: Optional[m.ViewChangeMsg] = None
+        self._complained_views: set = set()
+        self._vc_started_at = 0.0
+        self._last_progress = time.monotonic()
+        self._forwarded: Dict[tuple, float] = {}   # (client, req_seq) -> time
+
         # --- pipeline ---
         self.incoming = IncomingMsgsStorage()
         self.dispatcher = Dispatcher(self.incoming, name=f"replica-{self.id}")
@@ -124,6 +156,8 @@ class Replica(IReceiver):
                                   self._try_send_pre_prepare)
         self.dispatcher.add_timer(cfg.fast_path_timeout_ms / 1000.0 / 4,
                                   self._check_fast_path_timeouts)
+        self.dispatcher.add_timer(cfg.view_change_timer_ms / 1000.0 / 4,
+                                  self._check_view_change_timer)
         self.collector_pool = CollectorPool(
             lambda res: self.incoming.push_internal("combine", res))
 
@@ -149,6 +183,17 @@ class Replica(IReceiver):
             return
         self._running = True
         self.comm.start(self)
+        # crash between entering a view as primary and finishing the
+        # re-proposals: restrictions were persisted, PrePrepares were not —
+        # re-issue any that the restored window is missing
+        if self.is_primary and not self.in_view_change and self.restrictions \
+                and any(self.window.in_window(s)
+                        and (self.window.peek(s) is None
+                             or self.window.peek(s).pre_prepare is None)
+                        for s in self.restrictions):
+            self.incoming.push_internal("repropose", None)
+        self.dispatcher.register_internal("repropose",
+                                          lambda _: self._repropose())
         self.dispatcher.start()
 
     def stop(self) -> None:
@@ -188,6 +233,23 @@ class Replica(IReceiver):
             return
         if getattr(msg, "sender_id", sender) != sender:
             return                              # sender spoofing: drop
+        # view-change & checkpoint msgs flow even mid-view-change; normal
+        # ordering msgs are frozen until the new view starts (reference
+        # ReplicaImp gates handlers on currentViewIsActive())
+        if isinstance(msg, m.ReplicaAsksToLeaveViewMsg):
+            self._on_ask_to_leave_view(msg)
+            return
+        if isinstance(msg, m.ViewChangeMsg):
+            self._on_view_change(msg)
+            return
+        if isinstance(msg, m.NewViewMsg):
+            self._on_new_view(msg)
+            return
+        if isinstance(msg, m.CheckpointMsg):
+            self._on_checkpoint(msg)
+            return
+        if self.in_view_change:
+            return
         if isinstance(msg, m.PrePrepareMsg):
             self._on_pre_prepare(msg)
         elif isinstance(msg, m.PreparePartialMsg):
@@ -204,8 +266,6 @@ class Replica(IReceiver):
             self._on_full_commit_proof(msg)
         elif isinstance(msg, m.StartSlowCommitMsg):
             self._on_start_slow_commit(msg)
-        elif isinstance(msg, m.CheckpointMsg):
-            self._on_checkpoint(msg)
 
     # ------------------------------------------------------------------
     # client requests (ReplicaImp.cpp:397)
@@ -217,17 +277,30 @@ class Replica(IReceiver):
         if not self.sig.verify(client, req.signed_payload(), req.signature):
             return
         if req.flags & m.RequestFlag.READ_ONLY:
-            reply = self.handler.read(client, req.request)
-            self._send_reply(client, req.req_seq_num, reply)
+            # replied directly — MUST NOT advance the client's
+            # last-executed counter (that would make _execute_committed
+            # skip a committed write with a lower req_seq: divergence)
+            reply = m.ClientReplyMsg(
+                sender_id=self.id, req_seq_num=req.req_seq_num,
+                current_primary=self.primary,
+                reply=self.handler.read(client, req.request),
+                replica_specific_info=b"")
+            self.comm.send(client, reply.pack())
             return
         cached = self.clients.cached_reply(client, req.req_seq_num)
         if cached is not None:
             self.comm.send(client, cached.pack())
             return
-        if not self.is_primary:
+        if not self.is_primary or self.in_view_change:
             # forward to the current primary (reference forwards or the
-            # client retransmits; forwarding is cheap and speeds recovery)
-            self.comm.send(self.primary, req.pack())
+            # client retransmits; forwarding is cheap and speeds recovery);
+            # remember it so a dead primary is detected (liveness → complaint)
+            if not self.in_view_change:
+                self.comm.send(self.primary, req.pack())
+            # first-sighting timestamp only: retransmissions must not reset
+            # the liveness clock or the complaint never fires
+            self._forwarded.setdefault((client, req.req_seq_num),
+                                       time.monotonic())
             return
         if not self.clients.can_become_pending(client, req.req_seq_num):
             return
@@ -239,7 +312,8 @@ class Replica(IReceiver):
     # primary: batching + PrePrepare (ReplicaImp.cpp:657,865)
     # ------------------------------------------------------------------
     def _try_send_pre_prepare(self) -> None:
-        if not (self._running and self.is_primary and self.pending_requests):
+        if not (self._running and self.is_primary and self.pending_requests) \
+                or self.in_view_change:
             return
         seq = self.primary_next_seq
         if seq > self.last_stable + self.cfg.work_window_size:
@@ -287,6 +361,12 @@ class Replica(IReceiver):
         for r in reqs:
             if not self.clients.is_valid_client(r.sender_id):
                 return
+        # view-change safety: a seqnum certified as possibly-committed in
+        # an earlier view may ONLY be re-proposed with the same batch
+        # (ViewChangeSafetyLogic restrictions)
+        restr = self.restrictions.get(pp.seq_num)
+        if restr is not None and pp.requests_digest != restr.requests_digest:
+            return
         self._accept_pre_prepare(pp)
 
     def _accept_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
@@ -397,7 +477,8 @@ class Replica(IReceiver):
     # combine results (internal msg; reference onInternalMsg :1517)
     # ------------------------------------------------------------------
     def _on_combine_result(self, res: CombineResult) -> None:
-        if res.view != self.view or not self.window.in_window(res.seq_num):
+        if res.view != self.view or not self.window.in_window(res.seq_num) \
+                or self.in_view_change:
             return
         info = self.window.peek(res.seq_num)
         if info is None or info.pre_prepare is None:
@@ -576,6 +657,7 @@ class Replica(IReceiver):
             info.executed = True
             self.last_executed = nxt
             self.m_last_executed.set(nxt)
+            self._last_progress = time.monotonic()
             with self._tran() as st:
                 st.last_executed_seq = nxt
             if nxt % self.cfg.checkpoint_window_size == 0:
@@ -586,6 +668,7 @@ class Replica(IReceiver):
                                  current_primary=self.primary, reply=payload,
                                  replica_specific_info=b"")
         self.clients.on_request_executed(client, req_seq, reply)
+        self._forwarded.pop((client, req_seq), None)
         self.comm.send(client, reply.pack())
 
     # ------------------------------------------------------------------
@@ -627,10 +710,269 @@ class Replica(IReceiver):
         self.window.advance(seq)
         for s in [s for s in self.checkpoints if s <= seq]:
             del self.checkpoints[s]
+        for key in [k for k in self.carried_certs if k[0] <= seq]:
+            del self.carried_certs[key]
+        for s in [s for s in self.restrictions if s <= seq]:
+            del self.restrictions[s]
         with self._tran() as st:
             st.last_stable_seq = seq
             for s in [s for s in st.seq_states if s <= seq]:
                 del st.seq_states[s]
+            st.restrictions = [pack_restriction(r)
+                               for r in self.restrictions.values()]
+            st.carried_certs = [pack_cert(c)
+                                for c in self.carried_certs.values()]
+
+    # ------------------------------------------------------------------
+    # view change (ReplicaImp.cpp:3771,544,2900,2978,3094 + ViewsManager)
+    # ------------------------------------------------------------------
+    def _verifier_for_cert_kind(self, kind: int):
+        if kind in (CERT_PREPARE, CERT_COMMIT):
+            return self.slow_verifier
+        if kind == CERT_FAST_OPT:
+            return self.opt_verifier
+        if kind == CERT_FAST_THR:
+            return self.thr_verifier
+        return None
+
+    def _check_view_change_timer(self) -> None:
+        """Liveness watchdog: no progress while work is in flight, or a
+        view change that never completes, triggers a complaint about the
+        stuck view (reference viewChangeTimerMillisec → askToLeaveView)."""
+        if not self._running:
+            return
+        now = time.monotonic()
+        timeout = self.cfg.view_change_timer_ms / 1e3
+        if self.in_view_change:
+            if now - self._vc_started_at > timeout:
+                self._vc_started_at = now
+                # escalate AND retransmit: UDP may have dropped our
+                # complaint or ViewChangeMsg; a one-shot broadcast could
+                # wedge the cluster forever
+                self._complain(self.pending_view or self.view, force=True)
+                if self._my_vc_msg is not None \
+                        and self._my_vc_msg.new_view == self.pending_view:
+                    self._broadcast(self._my_vc_msg)
+            return
+        in_flight = any(info.pre_prepare is not None and not info.committed
+                        for _, info in self.window.items())
+        # forwarded-but-unexecuted client requests are work the primary owes
+        # us; executed or abandoned entries are GC'd
+        for key in [k for k, t in self._forwarded.items()
+                    if k[1] <= self.clients.last_executed(k[0])
+                    or now - t > 4 * timeout]:
+            del self._forwarded[key]
+        if in_flight or self.pending_requests or self._forwarded:
+            if now - self._last_progress > timeout:
+                self._complain(self.view)
+        else:
+            self._last_progress = now           # idle: reset the clock
+
+    def _complain(self, view: int, reason: int = 0,
+                  force: bool = False) -> None:
+        """Broadcast a signed view-change complaint for `view` (complaints
+        about the pending view escalate a failed view change). `force`
+        retransmits an already-issued complaint."""
+        first = view not in self._complained_views
+        if not first and not force:
+            return
+        self._complained_views.add(view)
+        msg = m.ReplicaAsksToLeaveViewMsg(sender_id=self.id, view=view,
+                                          reason=reason, signature=b"")
+        msg.signature = self.sig.sign(msg.signed_payload())
+        if first:
+            self.vc.add_complaint(msg)
+        self._broadcast(msg)
+        if first:
+            self._maybe_start_view_change()
+
+    def _on_ask_to_leave_view(self, msg: m.ReplicaAsksToLeaveViewMsg) -> None:
+        if not self.info.is_replica(msg.sender_id) or msg.view < self.view:
+            return
+        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
+                               msg.signature):
+            return
+        self.vc.add_complaint(msg)
+        # adopt: quorum-minus-me complaints for a view I'm stuck in too
+        self._maybe_start_view_change()
+
+    def _maybe_start_view_change(self) -> None:
+        for v in sorted(self.vc.complaints):
+            if v >= self.view and self.vc.has_complaint_quorum(v):
+                self._start_view_change(v + 1)
+
+    def _start_view_change(self, target: int) -> None:
+        if target <= self.view:
+            return
+        if self.in_view_change and self.pending_view is not None \
+                and target <= self.pending_view:
+            return
+        self.in_view_change = True
+        self.pending_view = target
+        self._vc_started_at = time.monotonic()
+        # harvest evidence: current window + evidence carried from earlier
+        # views (a cert or signed report must survive cascading view
+        # changes or a committed request could be lost)
+        self._harvest_evidence()
+        certs = sorted(self.carried_certs.values(),
+                       key=lambda c: (c.seq_num, c.kind))
+        vc = m.ViewChangeMsg(sender_id=self.id, new_view=target,
+                             last_stable_seq=self.last_stable,
+                             prepared=certs, signature=b"")
+        vc.signature = self.sig.sign(vc.signed_payload())
+        self._my_vc_msg = vc
+        self.vc.add_view_change(vc)
+        with self._tran() as st:
+            st.in_view_change = True
+            st.carried_certs = [pack_cert(c) for c in certs]
+        self._broadcast(vc)
+        self._try_complete_view_change(target)
+
+    def _harvest_evidence(self) -> None:
+        """Merge the window's current certs/reports into carried_certs
+        (keyed by (seq, is_signed_element); higher view wins)."""
+        for c in build_certificates(self.window.items(), self.last_stable,
+                                    lambda pp: pp.first_path):
+            key = (c.seq_num, c.kind == CERT_SIGNED)
+            cur = self.carried_certs.get(key)
+            if cur is None or c.view > cur.view:
+                self.carried_certs[key] = c
+
+    def _on_view_change(self, msg: m.ViewChangeMsg) -> None:
+        if not self.info.is_replica(msg.sender_id) \
+                or msg.new_view <= self.view:
+            return
+        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
+                               msg.signature):
+            return
+        self.vc.add_view_change(msg)
+        # f+1 replicas already moving to a higher view ⇒ join them
+        # (reference computeCorrectRelevantViewNumbers)
+        if self.vc.view_change_count(msg.new_view) \
+                >= self.info.complaint_quorum:
+            self._start_view_change(msg.new_view)
+        self._try_complete_view_change(msg.new_view)
+
+    def _try_complete_view_change(self, new_view: int) -> None:
+        """New primary: form NewViewMsg once the quorum is in. Backup:
+        enter once a pending NewViewMsg resolves."""
+        if new_view <= self.view:
+            return
+        if self.info.primary_of_view(new_view) == self.id:
+            if not self.vc.has_view_change_quorum(new_view):
+                return
+            quorum = self.vc.quorum_for_new_view(new_view)
+            nv = m.NewViewMsg(
+                sender_id=self.id, new_view=new_view,
+                view_change_digests=[
+                    m.ReplicaDigest(replica=vc.sender_id, digest=vc.digest())
+                    for vc in quorum],
+                signature=b"")
+            nv.signature = self.sig.sign(nv.signed_payload())
+            # rebroadcast the quorum's ViewChangeMsgs first so every backup
+            # can resolve the NewView digests without a fetch round
+            for vc in quorum:
+                if vc.sender_id != self.id:
+                    self._broadcast(vc)
+            self._broadcast(nv)
+            restrictions = compute_restrictions(
+                quorum, share_digest, self._verifier_for_cert_kind,
+                self.info.f + self.info.c + 1)
+            self._enter_view(new_view, restrictions)
+        else:
+            nv = self.vc.pending_new_view
+            if nv is None or nv.new_view != new_view:
+                return
+            matched = self.vc.match_new_view(nv)
+            if matched is None:
+                return                          # still missing VC msgs
+            restrictions = compute_restrictions(
+                matched, share_digest, self._verifier_for_cert_kind,
+                self.info.f + self.info.c + 1)
+            self._enter_view(new_view, restrictions)
+
+    def _on_new_view(self, msg: m.NewViewMsg) -> None:
+        if msg.new_view <= self.view:
+            return
+        if msg.sender_id != self.info.primary_of_view(msg.new_view):
+            return
+        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
+                               msg.signature):
+            return
+        self.vc.pending_new_view = msg
+        self._try_complete_view_change(msg.new_view)
+
+    def _enter_view(self, new_view: int,
+                    restrictions: Dict[int, Restriction]) -> None:
+        """tryToEnterView: adopt the new view, wipe in-flight state, apply
+        re-proposal restrictions; the new primary re-proposes."""
+        if new_view <= self.view:
+            return
+        # harvest one last time: local certs may be stronger than what the
+        # VC quorum carried (e.g. we committed on the fast path)
+        self._harvest_evidence()
+        self.view = new_view
+        self.in_view_change = False
+        self.pending_view = None
+        self.restrictions = restrictions
+        self.m_view.set(new_view)
+        # purge complaints ABOUT the view we just entered too: complaint
+        # quorums accumulated while the view change was forming must not
+        # depose the fresh primary; if it really is unhealthy, complaints
+        # re-accumulate via the escalation retransmit
+        self.vc.gc_below(new_view + 1)
+        # wipe all in-flight entries; consensus for uncommitted seqnums
+        # restarts in the new view under the restrictions
+        for seq, _ in list(self.window.items()):
+            self.window.drop(seq)
+        self.clients.clear_pending()
+        self.pending_requests = []
+        # reset liveness clocks: the new primary gets a full timeout before
+        # anyone complains about the view we just entered
+        now = time.monotonic()
+        self._last_progress = now
+        self._forwarded = {k: now for k in self._forwarded}
+        with self._tran() as st:
+            st.last_view = new_view
+            st.in_view_change = False
+            st.seq_states.clear()
+            st.restrictions = [pack_restriction(r)
+                               for r in restrictions.values()]
+            st.carried_certs = [pack_cert(c)
+                                for c in self.carried_certs.values()]
+        if self.is_primary:
+            self._repropose()
+
+    def _repropose(self) -> None:
+        """New primary: re-issue PrePrepares for every restricted seqnum
+        (same batch, slow path — safest after a view change) and fill gaps
+        below the highest certified seqnum with empty batches."""
+        base = self.last_stable
+        max_cert = max(self.restrictions, default=base)
+        self.primary_next_seq = max(max_cert, self.last_executed, base) + 1
+        for seq in range(base + 1, max_cert + 1):
+            existing = self.window.peek(seq)
+            if existing is not None and existing.pre_prepare is not None:
+                # already (re)proposed before a crash — rebroadcast the
+                # SAME message; a fresh timestamp would change the digest
+                # and strand backups' shares on the old one
+                self._broadcast(existing.pre_prepare)
+                continue
+            restr = self.restrictions.get(seq)
+            if restr is not None:
+                old = m.unpack(restr.pre_prepare)
+                requests, pp_time = old.requests, old.time
+            else:
+                requests, pp_time = [], 0
+            pp = m.PrePrepareMsg(
+                sender_id=self.id, view=self.view, seq_num=seq,
+                first_path=int(m.CommitPath.SLOW), time=pp_time,
+                requests_digest=m.PrePrepareMsg.compute_requests_digest(
+                    requests),
+                requests=requests, signature=b"")
+            pp.signature = self.sig.sign(pp.signed_payload())
+            self._broadcast(pp)
+            self._accept_pre_prepare(pp)
 
     # ------------------------------------------------------------------
     # helpers

@@ -40,18 +40,6 @@ class SeqNumInfo:
     # in the collectors keyed by digest; we buffer until digest is known)
     early_shares: Dict[str, list] = field(default_factory=dict)
 
-    def reset_for_view(self) -> None:
-        """On view change, in-flight non-committed state is rebuilt."""
-        if not self.committed:
-            self.prepare_collector = None
-            self.prepare_full = None
-            self.commit_collector = None
-            self.commit_full = None
-            self.fast_collector = None
-            self.prepared = False
-            self.slow_started = False
-            self.commit_path = None
-
 
 T = TypeVar("T")
 
@@ -92,6 +80,10 @@ class ActiveWindow(Generic[T]):
         self._base = new_base
         for s in [s for s in self._items if s <= new_base]:
             del self._items[s]
+
+    def drop(self, seq: int) -> None:
+        """Discard one entry (view change wipes in-flight state)."""
+        self._items.pop(seq, None)
 
     def items(self) -> Iterator:
         return iter(sorted(self._items.items()))
