@@ -1,0 +1,2 @@
+"""Native (C++) runtime components, built on demand with g++ and loaded
+via ctypes. See build.py for the compile-and-cache logic."""

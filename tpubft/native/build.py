@@ -1,0 +1,41 @@
+"""Build-and-cache for the native C++ components.
+
+Compiles <name>.cpp in this directory into _<name>.so next to it on first
+use; recompiles when the source is newer than the cached object. No
+network, no external build system — just g++ (baked into the image).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def load(name: str) -> ctypes.CDLL:
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_HERE, f"{name}.cpp")
+        so = os.path.join(_HERE, f"_{name}.so")
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            tmp = so + ".build"
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-o", tmp, src]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"g++ failed for {name}:\n{proc.stderr[-4000:]}")
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        _cache[name] = lib
+        return lib

@@ -1,0 +1,13 @@
+"""Storage layer — abstract KV DB + backends + consensus metadata store.
+
+Rebuild of /root/reference/storage/ (IDBClient, memorydb, RocksDB client)
+and bftengine's DBMetadataStorage. The persistent backend here is a
+native C++ log-structured engine (tpubft/native/kvlog.cpp) instead of
+RocksDB, loaded via ctypes.
+"""
+from tpubft.storage.interfaces import (DEFAULT_FAMILY, IDBClient, StorageError,
+                                       WriteBatch)
+from tpubft.storage.memorydb import MemoryDB
+
+__all__ = ["IDBClient", "WriteBatch", "MemoryDB", "StorageError",
+           "DEFAULT_FAMILY"]

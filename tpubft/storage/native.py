@@ -1,0 +1,140 @@
+"""Persistent IDBClient backed by the native C++ kvlog engine
+(tpubft/native/kvlog.cpp) — the RocksDB role of the reference's storage
+layer (/root/reference/storage/src/rocksdb_client.cpp), via ctypes."""
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator, List, Optional, Tuple
+
+from tpubft.native.build import load
+from tpubft.storage.interfaces import (DEFAULT_FAMILY, IDBClient, StorageError,
+                                       WriteBatch, family_upper_bound, fkey)
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _lib():
+    lib = load("kvlog")
+    if getattr(lib, "_kvlog_typed", False):
+        return lib
+    lib.kvlog_open.restype = ctypes.c_void_p
+    lib.kvlog_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.kvlog_close.argtypes = [ctypes.c_void_p]
+    lib.kvlog_apply.restype = ctypes.c_int
+    lib.kvlog_apply.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32]
+    lib.kvlog_get.restype = ctypes.c_int
+    lib.kvlog_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint32, ctypes.POINTER(_U8P),
+                              ctypes.POINTER(ctypes.c_uint32)]
+    lib.kvlog_free.argtypes = [_U8P]
+    lib.kvlog_count.restype = ctypes.c_uint64
+    lib.kvlog_count.argtypes = [ctypes.c_void_p]
+    lib.kvlog_wal_bytes.restype = ctypes.c_uint64
+    lib.kvlog_wal_bytes.argtypes = [ctypes.c_void_p]
+    lib.kvlog_scan.restype = ctypes.c_int
+    lib.kvlog_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint32, ctypes.c_char_p,
+                               ctypes.c_uint32, ctypes.POINTER(_U8P),
+                               ctypes.POINTER(ctypes.c_uint32)]
+    lib.kvlog_compact.restype = ctypes.c_int
+    lib.kvlog_compact.argtypes = [ctypes.c_void_p]
+    lib.kvlog_sync.restype = ctypes.c_int
+    lib.kvlog_sync.argtypes = [ctypes.c_void_p]
+    lib._kvlog_typed = True
+    return lib
+
+
+def _decode_scan(buf: bytes) -> List[Tuple[bytes, bytes]]:
+    out, off, n = [], 0, len(buf)
+    while off < n:
+        klen = int.from_bytes(buf[off + 1:off + 5], "little")
+        off += 5
+        k = buf[off:off + klen]
+        off += klen
+        vlen = int.from_bytes(buf[off:off + 4], "little")
+        off += 4
+        out.append((k, buf[off:off + vlen]))
+        off += vlen
+    return out
+
+
+class NativeDB(IDBClient):
+    """Crash-consistent persistent KV store. `sync_writes=False` trades
+    durability-per-batch for throughput (recovery still sees a prefix of
+    committed batches — record CRCs stop replay at the torn tail)."""
+
+    def __init__(self, path: str, sync_writes: bool = True,
+                 compact_bytes: int = 64 << 20) -> None:
+        self._lib = _lib()
+        self._h = self._lib.kvlog_open(path.encode(), 1 if sync_writes else 0)
+        if not self._h:
+            raise StorageError(f"kvlog_open failed for {path}")
+        self._compact_bytes = compact_bytes
+
+    def _handle(self):
+        if not self._h:
+            raise StorageError("NativeDB is closed")
+        return self._h
+
+    def get(self, key: bytes,
+            family: bytes = DEFAULT_FAMILY) -> Optional[bytes]:
+        self._handle()
+        k = fkey(family, key)
+        val = _U8P()
+        vlen = ctypes.c_uint32()
+        rc = self._lib.kvlog_get(self._h, k, len(k), ctypes.byref(val),
+                                 ctypes.byref(vlen))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise StorageError(f"kvlog_get rc={rc}")
+        try:
+            return ctypes.string_at(val, vlen.value)
+        finally:
+            self._lib.kvlog_free(val)
+
+    def write(self, batch: WriteBatch) -> None:
+        self._handle()
+        payload = batch.encode()
+        rc = self._lib.kvlog_apply(self._h, payload, len(payload))
+        if rc != 0:
+            raise StorageError(f"kvlog_apply rc={rc}")
+        if self._lib.kvlog_wal_bytes(self._h) > self._compact_bytes:
+            self.compact()
+
+    def range_iter(self, family: bytes = DEFAULT_FAMILY,
+                   start: Optional[bytes] = None,
+                   end: Optional[bytes] = None
+                   ) -> Iterator[Tuple[bytes, bytes]]:
+        self._handle()
+        lo = fkey(family, start if start is not None else b"")
+        hi = fkey(family, end) if end is not None else family_upper_bound(family)
+        out = _U8P()
+        outlen = ctypes.c_uint32()
+        rc = self._lib.kvlog_scan(
+            self._h, lo, len(lo), hi if hi is not None else b"",
+            0xFFFFFFFF if hi is None else len(hi),
+            ctypes.byref(out), ctypes.byref(outlen))
+        if rc != 0:
+            raise StorageError(f"kvlog_scan rc={rc}")
+        try:
+            buf = ctypes.string_at(out, outlen.value)
+        finally:
+            self._lib.kvlog_free(out)
+        prefix = 1 + len(family)
+        for k, v in _decode_scan(buf):
+            yield k[prefix:], v
+
+    def compact(self) -> None:
+        rc = self._lib.kvlog_compact(self._handle())
+        if rc != 0:
+            raise StorageError(f"kvlog_compact rc={rc}")
+
+    def count(self) -> int:
+        return self._lib.kvlog_count(self._handle())
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kvlog_close(self._h)
+            self._h = None
