@@ -1,0 +1,233 @@
+"""KVBC tests: SHA-256 kernel vs hashlib, sparse Merkle semantics +
+proofs, categorized blockchain behavior (reference test model:
+kvbc/test/categorization/, kvbc/test/sparse_merkle/)."""
+import hashlib
+
+import numpy as np
+import pytest
+
+from tpubft.kvbc import (BLOCK_MERKLE, IMMUTABLE, VERSIONED_KV, BlockUpdates,
+                         KeyValueBlockchain, SparseMerkleTree)
+from tpubft.kvbc.categories import CategoryError, get_tagged
+from tpubft.kvbc.blockchain import BlockchainError
+from tpubft.storage import MemoryDB
+
+
+# ---------------- SHA-256 kernel ----------------
+
+def test_sha256_kernel_matches_hashlib():
+    from tpubft.ops import sha256 as k
+    msgs = [b"", b"abc", b"x" * 55, b"y" * 40, bytes(range(50))]
+    # same-block-count groups
+    one_block = [m for m in msgs if k.blocks_needed(len(m)) == 1]
+    got = k.sha256_batch(one_block)
+    assert got == [hashlib.sha256(m).digest() for m in one_block]
+
+    two_block = [b"a" * 64, b"b" * 100, bytes(119), b"\xff" * 70]
+    got = k.sha256_batch(two_block)
+    assert got == [hashlib.sha256(m).digest() for m in two_block]
+
+    multi = [bytes([i]) * 300 for i in range(5)]
+    got = k.sha256_batch(multi)
+    assert got == [hashlib.sha256(m).digest() for m in multi]
+
+    with pytest.raises(ValueError):
+        k.prepare([b"short", b"z" * 200])
+
+
+def test_sha256_kernel_large_batch():
+    from tpubft.ops import sha256 as k
+    msgs = [b"\x01" + hashlib.sha256(str(i).encode()).digest() * 2
+            for i in range(300)]  # 65-byte merkle inner messages
+    got = k.sha256_batch(msgs)
+    assert got == [hashlib.sha256(m).digest() for m in msgs]
+
+
+# ---------------- sparse Merkle ----------------
+
+def test_smt_empty_and_single():
+    db = MemoryDB()
+    t = SparseMerkleTree(db, use_device=False)
+    empty_root = t.root()
+    vh = hashlib.sha256(b"value").digest()
+    root1 = t.update_batch({b"key": vh})
+    assert root1 != empty_root
+    assert t.get_value_hash(b"key") == vh
+    # delete restores the empty root (no residue)
+    root2 = t.update_batch({b"key": None})
+    assert root2 == empty_root
+    assert db.family_dict(b"smt") == {}
+
+
+def test_smt_batch_order_independence():
+    vh = {f"k{i}".encode(): hashlib.sha256(f"v{i}".encode()).digest()
+          for i in range(20)}
+    t1 = SparseMerkleTree(MemoryDB(), use_device=False)
+    r1 = t1.update_batch(dict(vh))
+    t2 = SparseMerkleTree(MemoryDB(), use_device=False)
+    r2 = None
+    for k, v in sorted(vh.items(), reverse=True):
+        r2 = t2.update_batch({k: v})
+    assert r1 == r2  # same final state, incremental vs batch
+
+
+def test_smt_proofs():
+    t = SparseMerkleTree(MemoryDB(), use_device=False)
+    items = {f"key-{i}".encode(): hashlib.sha256(f"val-{i}".encode()).digest()
+             for i in range(10)}
+    root = t.update_batch(items)
+    for k, vh in items.items():
+        p = t.prove(k)
+        assert SparseMerkleTree.verify(root, k, vh, p)
+        assert not SparseMerkleTree.verify(root, k, hashlib.sha256(b"x").digest(), p)
+        assert not SparseMerkleTree.verify(root, k, None, p)
+    # non-membership
+    p = t.prove(b"absent")
+    assert SparseMerkleTree.verify(root, b"absent", None, p)
+    assert not SparseMerkleTree.verify(root, b"absent", b"\x11" * 32, p)
+
+
+def test_smt_device_matches_host():
+    items = {f"key-{i}".encode(): hashlib.sha256(f"val-{i}".encode()).digest()
+             for i in range(250)}  # wide enough to engage the device path
+    th = SparseMerkleTree(MemoryDB(), use_device=False)
+    td = SparseMerkleTree(MemoryDB(), use_device=True)
+    assert th.update_batch(dict(items)) == td.update_batch(dict(items))
+
+
+# ---------------- categorized blockchain ----------------
+
+def _bc():
+    return KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+
+
+def test_add_block_and_reads():
+    bc = _bc()
+    bu = (BlockUpdates()
+          .put("merkle", b"mk", b"mv", cat_type=BLOCK_MERKLE)
+          .put("ver", b"vk", b"v1")
+          .put("imm", b"ik", b"iv", cat_type=IMMUTABLE, tags=["t1"]))
+    assert bc.add_block(bu) == 1
+    assert bc.last_block_id == 1
+    assert bc.genesis_block_id == 1
+    assert bc.get_latest("merkle", b"mk", BLOCK_MERKLE) == (1, b"mv")
+    assert bc.get_latest("ver", b"vk") == (1, b"v1")
+    assert bc.get_latest("imm", b"ik", IMMUTABLE) == (1, b"iv")
+    assert get_tagged(bc._db, "imm", "t1") == [(b"ik", b"iv")]
+
+    bc.add_block(BlockUpdates().put("ver", b"vk", b"v2"))
+    assert bc.get_latest("ver", b"vk") == (2, b"v2")
+    assert bc.get_versioned("ver", b"vk", 1) == b"v1"
+    assert bc.get_versioned("ver", b"vk", 2) == b"v2"
+
+    bc.add_block(BlockUpdates().delete("ver", b"vk"))
+    assert bc.get_latest("ver", b"vk") is None
+    assert bc.get_versioned("ver", b"vk", 2) == b"v2"
+    assert bc.get_versioned("ver", b"vk", 3) is None
+
+
+def test_immutable_rewrite_rejected():
+    bc = _bc()
+    bc.add_block(BlockUpdates().put("imm", b"k", b"v", cat_type=IMMUTABLE))
+    with pytest.raises(CategoryError):
+        bc.add_block(BlockUpdates().put("imm", b"k", b"v2",
+                                        cat_type=IMMUTABLE))
+
+
+def test_digest_chain_and_merkle_proof():
+    bc = _bc()
+    bc.add_block(BlockUpdates().put("m", b"a", b"1", cat_type=BLOCK_MERKLE))
+    bc.add_block(BlockUpdates().put("m", b"b", b"2", cat_type=BLOCK_MERKLE))
+    b2 = bc.get_block(2)
+    assert b2.parent_digest == bc.block_digest(1)
+    root = bc.merkle_root("m")
+    assert b2.category_digests["m"] == root
+    p = bc.prove("m", b"a")
+    assert SparseMerkleTree.verify(root, b"a",
+                                   hashlib.sha256(b"1").digest(), p)
+
+
+def test_pruning():
+    bc = _bc()
+    for i in range(5):
+        bc.add_block(BlockUpdates().put("v", b"k", str(i).encode()))
+    bc.delete_blocks_until(4)
+    assert bc.genesis_block_id == 4
+    assert bc.get_block(2) is None
+    assert bc.get_block(4) is not None
+    assert bc.get_latest("v", b"k") == (5, b"4")
+    with pytest.raises(BlockchainError):
+        bc.delete_blocks_until(99)
+
+
+def test_st_chain_linking():
+    src = _bc()
+    for i in range(4):
+        src.add_block(BlockUpdates()
+                      .put("m", f"k{i}".encode(), f"v{i}".encode(),
+                           cat_type=BLOCK_MERKLE)
+                      .put("ver", b"shared", str(i).encode()))
+    dst = _bc()
+    # deliver out of order: 3, 2, 4, 1
+    for bid in (3, 2):
+        dst.add_raw_st_block(bid, src.get_raw_block(bid))
+    assert dst.link_st_chain() == 0  # nothing contiguous yet
+    dst.add_raw_st_block(4, src.get_raw_block(4))
+    dst.add_raw_st_block(1, src.get_raw_block(1))
+    assert dst.link_st_chain() == 4
+    assert dst.state_digest() == src.state_digest()
+    assert dst.merkle_root("m") == src.merkle_root("m")
+    assert dst.get_latest("ver", b"shared") == (4, b"3")
+
+
+def test_st_chain_rejects_tampered_block_and_recovers():
+    src = _bc()
+    src.add_block(BlockUpdates().put("ver", b"k", b"v"))
+    raw = bytearray(src.get_raw_block(1))
+    raw[-1] ^= 0xFF  # corrupt updates blob
+    dst = _bc()
+    dst.add_raw_st_block(1, bytes(raw))
+    with pytest.raises(Exception):
+        dst.link_st_chain()
+    # the bad block was dropped: a re-fetch from an honest source links
+    assert not dst.has_st_block(1)
+    dst.add_raw_st_block(1, src.get_raw_block(1))
+    assert dst.link_st_chain() == 1
+    assert dst.state_digest() == src.state_digest()
+
+
+def test_empty_merkle_update_is_noop():
+    t = SparseMerkleTree(MemoryDB(), use_device=False)
+    r0 = t.root()
+    assert t.update_batch({}) == r0
+    t.update_batch({b"k": hashlib.sha256(b"v").digest()})
+    assert t.update_batch({}) == t.root()
+
+
+def test_prune_lower_bound_noop():
+    bc = _bc()
+    for i in range(5):
+        bc.add_block(BlockUpdates().put("v", b"k", str(i).encode()))
+    bc.delete_blocks_until(4)
+    assert bc.delete_blocks_until(2) == 4  # no backwards genesis
+    assert bc.genesis_block_id == 4
+
+
+def test_persistence_across_reopen(tmp_path):
+    from tpubft.storage.native import NativeDB
+    path = str(tmp_path / "bc.kvlog")
+    db = NativeDB(path)
+    bc = KeyValueBlockchain(db, use_device_hashing=False)
+    bc.add_block(BlockUpdates().put("m", b"a", b"1", cat_type=BLOCK_MERKLE))
+    bc.add_block(BlockUpdates().put("ver", b"b", b"2"))
+    head = bc.state_digest()
+    db.close()
+
+    db = NativeDB(path)
+    bc2 = KeyValueBlockchain(db, use_device_hashing=False)
+    assert bc2.last_block_id == 2
+    assert bc2.state_digest() == head
+    assert bc2.get_latest("m", b"a", BLOCK_MERKLE) == (1, b"1")
+    bc2.add_block(BlockUpdates().put("ver", b"b", b"3"))
+    assert bc2.get_latest("ver", b"b") == (3, b"3")
+    db.close()

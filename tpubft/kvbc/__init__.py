@@ -1,0 +1,16 @@
+"""KVBC — the ledger layer: categorized key-value blockchain over the
+storage layer, with a sparse Merkle tree for state proofs.
+
+Rebuild of /root/reference/kvbc/ (categorized KeyValueBlockchain,
+kvbc/include/categorization/kv_blockchain.h:40; sparse_merkle/tree.cpp),
+TPU-first: bulk digests (Merkle levels, block hashing) go through the
+batched SHA-256 kernel (tpubft/ops/sha256.py) instead of per-node CPU
+hashing.
+"""
+from tpubft.kvbc.blockchain import KeyValueBlockchain
+from tpubft.kvbc.categories import (BLOCK_MERKLE, IMMUTABLE, VERSIONED_KV,
+                                    BlockUpdates, CategoryUpdates)
+from tpubft.kvbc.sparse_merkle import SparseMerkleTree
+
+__all__ = ["KeyValueBlockchain", "SparseMerkleTree", "BlockUpdates",
+           "CategoryUpdates", "BLOCK_MERKLE", "VERSIONED_KV", "IMMUTABLE"]

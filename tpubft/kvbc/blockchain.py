@@ -1,0 +1,206 @@
+"""Categorized key-value blockchain.
+
+Rebuild of the reference's `concord::kvbc::categorization::KeyValueBlockchain`
+(/root/reference/kvbc/include/categorization/kv_blockchain.h:40,
+src/categorization/kv_blockchain.cpp): blocks are maps category→updates,
+chained by parent digest; per-category state digests (Merkle root for
+block_merkle categories) feed the block digest, which is what consensus
+checkpoints sign. Also carries the v4-style `st_chain` staging area
+(src/v4blockchain/detail/st_chain.cpp) so state transfer can land blocks
+out of order and link them with integrity checks.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpubft.kvbc import categories as cat
+from tpubft.kvbc.sparse_merkle import SparseMerkleTree
+from tpubft.storage.interfaces import IDBClient, WriteBatch
+from tpubft.utils import serialize as ser
+
+_BLOCKS = b"blk.blocks"
+_MISC = b"blk.misc"
+_ST = b"blk.st"
+
+_K_LAST = b"last"
+_K_GENESIS = b"genesis"
+
+
+class BlockchainError(Exception):
+    pass
+
+
+@dataclass
+class Block:
+    block_id: int
+    parent_digest: bytes
+    category_digests: Dict[str, bytes] = field(default_factory=dict)
+    updates_blob: bytes = b""
+
+    SPEC = [("block_id", "u64"), ("parent_digest", "bytes"),
+            ("category_digests", ("map", "str", "bytes")),
+            ("updates_blob", "bytes")]
+
+    def digest(self) -> bytes:
+        return hashlib.sha256(ser.encode_msg(self)).digest()
+
+
+def _bid(block_id: int) -> bytes:
+    return block_id.to_bytes(8, "big")
+
+
+class KeyValueBlockchain:
+    def __init__(self, db: IDBClient, use_device_hashing: bool = True) -> None:
+        self._db = db
+        self._use_device = use_device_hashing
+        self._trees: Dict[str, SparseMerkleTree] = {}
+        last = db.get(_K_LAST, _MISC)
+        self._last = int.from_bytes(last, "big") if last else 0
+        gen = db.get(_K_GENESIS, _MISC)
+        self._genesis = int.from_bytes(gen, "big") if gen else 0
+
+    # ---- properties ----
+    @property
+    def last_block_id(self) -> int:
+        return self._last
+
+    @property
+    def genesis_block_id(self) -> int:
+        return self._genesis
+
+    def _tree(self, category: str) -> SparseMerkleTree:
+        t = self._trees.get(category)
+        if t is None:
+            t = SparseMerkleTree(self._db, family=f"smt.{category}".encode(),
+                                 use_device=self._use_device)
+            self._trees[category] = t
+        return t
+
+    # ---- write path ----
+    def add_block(self, updates: cat.BlockUpdates) -> int:
+        block_id = self._last + 1
+        wb = WriteBatch()
+        block = self._stage_block(wb, block_id, updates)
+        self._db.write(wb)
+        self._last = block_id
+        if self._genesis == 0:
+            self._genesis = 1
+        return block_id
+
+    def _stage_block(self, wb: WriteBatch, block_id: int,
+                     updates: cat.BlockUpdates) -> Block:
+        digests: Dict[str, bytes] = {}
+        for name in sorted(updates.categories):
+            cat_type, cu = updates.categories[name]
+            digests[name] = cat.stage_category(
+                self._db, wb, name, cat_type, cu, block_id, self._tree)
+        parent = self.block_digest(block_id - 1) if block_id > 1 else b""
+        block = Block(block_id=block_id, parent_digest=parent,
+                      category_digests=digests,
+                      updates_blob=cat.encode_block_updates(updates))
+        raw = ser.encode_msg(block)
+        wb.put(_bid(block_id), raw, _BLOCKS)
+        wb.put(_K_LAST, _bid(block_id), _MISC)
+        if block_id == 1:
+            wb.put(_K_GENESIS, _bid(1), _MISC)
+        return block
+
+    # ---- read path ----
+    def get_block(self, block_id: int) -> Optional[Block]:
+        raw = self._db.get(_bid(block_id), _BLOCKS)
+        return ser.decode_msg(raw, Block) if raw is not None else None
+
+    def get_raw_block(self, block_id: int) -> Optional[bytes]:
+        return self._db.get(_bid(block_id), _BLOCKS)
+
+    def block_digest(self, block_id: int) -> bytes:
+        if block_id == 0:
+            return b""
+        blk = self.get_block(block_id)
+        if blk is None:
+            raise BlockchainError(f"missing block {block_id}")
+        return blk.digest()
+
+    def state_digest(self) -> bytes:
+        """Digest of the whole chain head — what checkpoint certificates
+        sign (reference: kv_blockchain state hash)."""
+        return self.block_digest(self._last) if self._last else b"\x00" * 32
+
+    def get_latest(self, category: str, key: bytes,
+                   cat_type: str = cat.VERSIONED_KV
+                   ) -> Optional[Tuple[int, bytes]]:
+        return cat.get_latest(self._db, category, cat_type, key)
+
+    def get_versioned(self, category: str, key: bytes,
+                      block_id: int) -> Optional[bytes]:
+        return cat.get_versioned(self._db, category, key, block_id)
+
+    def prove(self, category: str, key: bytes):
+        """Merkle proof for a block_merkle-category key (latest state)."""
+        return self._tree(category).prove(key)
+
+    def merkle_root(self, category: str) -> bytes:
+        return self._tree(category).root()
+
+    # ---- pruning (reference: deleteBlocksUntil / pruning_handler) ----
+    def delete_blocks_until(self, until_block_id: int) -> int:
+        """Delete block bodies in [genesis, until); latest state is kept.
+        Returns the new genesis id."""
+        if until_block_id > self._last:
+            raise BlockchainError("cannot prune the chain head")
+        start = self._genesis if self._genesis else 1
+        if until_block_id <= start:
+            return self._genesis
+        wb = WriteBatch()
+        for bid in range(start, until_block_id):
+            wb.delete(_bid(bid), _BLOCKS)
+        wb.put(_K_GENESIS, _bid(until_block_id), _MISC)
+        self._db.write(wb)
+        self._genesis = until_block_id
+        return self._genesis
+
+    # ---- state-transfer staging (reference v4 st_chain) ----
+    def add_raw_st_block(self, block_id: int, raw: bytes) -> None:
+        if block_id <= self._last:
+            return
+        self._db.put(_bid(block_id), raw, _ST)
+
+    def has_st_block(self, block_id: int) -> bool:
+        return self._db.has(_bid(block_id), _ST)
+
+    def link_st_chain(self) -> int:
+        """Adopt contiguous staged blocks after the head, re-executing
+        their updates and verifying recorded digests so a Byzantine
+        source can't inject state. Returns the new head."""
+        while True:
+            nxt = self._last + 1
+            raw = self._db.get(_bid(nxt), _ST)
+            if raw is None:
+                return self._last
+            try:
+                blk = ser.decode_msg(raw, Block)
+                if blk.block_id != nxt:
+                    raise BlockchainError(
+                        f"staged block id mismatch: {blk.block_id} != {nxt}")
+                expect_parent = (self.block_digest(self._last)
+                                 if self._last else b"")
+                if blk.parent_digest != expect_parent:
+                    raise BlockchainError(f"parent digest mismatch at {nxt}")
+                updates = cat.decode_block_updates(blk.updates_blob)
+                wb = WriteBatch()
+                rebuilt = self._stage_block(wb, nxt, updates)
+                if rebuilt.category_digests != blk.category_digests:
+                    raise BlockchainError(
+                        f"category digest mismatch at {nxt}")
+            except Exception:
+                # drop the bad staged block so retries can re-fetch it from
+                # another source instead of wedging on the same bytes
+                self._db.delete(_bid(nxt), _ST)
+                raise
+            wb.delete(_bid(nxt), _ST)
+            self._db.write(wb)
+            self._last = nxt
+            if self._genesis == 0:
+                self._genesis = 1

@@ -1,0 +1,186 @@
+"""Sparse Merkle tree over a 256-bit key space.
+
+Rebuild of the reference's sparse_merkle::Tree
+(/root/reference/kvbc/src/sparse_merkle/tree.cpp, internal_node.cpp) with a
+TPU-first update path: instead of nibble-batched internal nodes walked one
+at a time, updates are applied as a *batch per level* — all changed nodes
+of a level are rehashed in one call, which routes through the batched
+SHA-256 kernel (tpubft/ops/sha256.py) once the level is wide enough to
+amortize device dispatch.
+
+Layout: key -> path = SHA-256(key), 256 levels. Only non-default nodes are
+persisted (family `smt`); empty subtrees hash to precomputed defaults.
+Leaf hash = H(0x00 || path || value_hash); inner = H(0x01 || l || r).
+The tree mutates in place (latest version); historical roots are retained
+by the blockchain layer per block, and proofs are served for the latest
+state.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpubft.storage.interfaces import IDBClient, WriteBatch
+
+DEPTH = 256
+_EMPTY = b"\x00" * 32
+
+# default (empty-subtree) hash per depth: _DEFAULTS[256] = empty leaf,
+# _DEFAULTS[d] = H(0x01 || _DEFAULTS[d+1] || _DEFAULTS[d+1])
+_DEFAULTS: List[bytes] = [b""] * (DEPTH + 1)
+_DEFAULTS[DEPTH] = _EMPTY
+for _d in range(DEPTH - 1, -1, -1):
+    _DEFAULTS[_d] = hashlib.sha256(
+        b"\x01" + _DEFAULTS[_d + 1] + _DEFAULTS[_d + 1]).digest()
+
+# below this many nodes in a level, hashlib beats device dispatch
+_DEVICE_THRESHOLD = 192
+
+
+def _hash_level(messages: Sequence[bytes], use_device: bool) -> List[bytes]:
+    if use_device and len(messages) >= _DEVICE_THRESHOLD:
+        from tpubft.ops.sha256 import sha256_batch
+        return sha256_batch(messages)
+    return [hashlib.sha256(m).digest() for m in messages]
+
+
+def _leaf_hash(path: bytes, value_hash: bytes) -> bytes:
+    return hashlib.sha256(b"\x00" + path + value_hash).digest()
+
+
+def _node_key(depth: int, path_bits: int) -> bytes:
+    """Physical key: depth (2B big-endian) + the leading `depth` bits."""
+    nbytes = (depth + 7) // 8
+    return depth.to_bytes(2, "big") + (
+        (path_bits << (nbytes * 8 - depth)).to_bytes(nbytes, "big")
+        if depth else b"")
+
+
+@dataclass
+class Proof:
+    """Audit path, compressed: bitmap marks levels whose sibling is
+    non-default; `siblings` lists only those, bottom (depth 256) first."""
+    bitmap: bytes                    # 32 bytes, bit i = level DEPTH - i
+    siblings: List[bytes]
+
+
+class SparseMerkleTree:
+    def __init__(self, db: IDBClient, family: bytes = b"smt",
+                 use_device: bool = True) -> None:
+        self._db = db
+        self._family = family
+        self._leaf_family = family + b".leaf"
+        self._use_device = use_device
+
+    # ---- reads ----
+    # Reads go straight to the DB (no node cache): staged-but-uncommitted
+    # updates must never be observable, and an aborted block must leave no
+    # residue — the DB's batch atomicity is the single source of truth.
+    def _node(self, depth: int, path_bits: int) -> bytes:
+        v = self._db.get(_node_key(depth, path_bits), self._family)
+        return v if v is not None else _DEFAULTS[depth]
+
+    def root(self) -> bytes:
+        return self._node(0, 0)
+
+    def get_value_hash(self, key: bytes) -> Optional[bytes]:
+        path = hashlib.sha256(key).digest()
+        return self._db.get(path, self._leaf_family)
+
+    # ---- batch update ----
+    def update_batch(self, updates: Dict[bytes, Optional[bytes]],
+                     batch: Optional[WriteBatch] = None) -> bytes:
+        """Apply {key: value_hash or None(delete)}; returns the new root.
+        If `batch` is given, node writes are staged into it (caller
+        commits atomically with the block); otherwise committed here."""
+        if not updates:
+            return self.root()
+        own_batch = batch is None
+        wb = WriteBatch() if own_batch else batch
+
+        # leaf level
+        changed: Dict[int, bytes] = {}
+        paths: Dict[int, bytes] = {}
+        for key, vh in updates.items():
+            path = hashlib.sha256(key).digest()
+            bits = int.from_bytes(path, "big")
+            paths[bits] = path
+            if vh is None:
+                changed[bits] = _EMPTY
+                wb.delete(path, self._leaf_family)
+            else:
+                changed[bits] = _leaf_hash(path, vh)
+                wb.put(path, vh, self._leaf_family)
+        self._stage_level(wb, DEPTH, changed)
+
+        # ascend, rehashing all changed nodes of each level in one batch
+        for depth in range(DEPTH, 0, -1):
+            parents = sorted({bits >> 1 for bits in changed})
+            msgs = []
+            for pb in parents:
+                left = changed.get(pb << 1)
+                if left is None:
+                    left = self._node(depth, pb << 1)
+                right = changed.get((pb << 1) | 1)
+                if right is None:
+                    right = self._node(depth, (pb << 1) | 1)
+                msgs.append(b"\x01" + left + right)
+            hashes = _hash_level(msgs, self._use_device)
+            changed = dict(zip(parents, hashes))
+            self._stage_level(wb, depth - 1, changed)
+
+        if own_batch:
+            self._db.write(wb)
+        return changed[0]
+
+    def _stage_level(self, wb: WriteBatch, depth: int,
+                     nodes: Dict[int, bytes]) -> None:
+        default = _DEFAULTS[depth]
+        for bits, h in nodes.items():
+            k = _node_key(depth, bits)
+            if h == default:
+                wb.delete(k, self._family)
+            else:
+                wb.put(k, h, self._family)
+
+    # ---- proofs ----
+    def prove(self, key: bytes) -> Proof:
+        path = hashlib.sha256(key).digest()
+        bits = int.from_bytes(path, "big")
+        bitmap = bytearray(32)
+        siblings: List[bytes] = []
+        node_bits = bits
+        for depth in range(DEPTH, 0, -1):
+            sib = self._node(depth, node_bits ^ 1)
+            if sib != _DEFAULTS[depth]:
+                i = DEPTH - depth
+                bitmap[i // 8] |= 1 << (i % 8)
+                siblings.append(sib)
+            node_bits >>= 1
+        return Proof(bytes(bitmap), siblings)
+
+    @staticmethod
+    def verify(root: bytes, key: bytes, value_hash: Optional[bytes],
+               proof: Proof) -> bool:
+        """Checks membership (value_hash given) or non-membership (None)."""
+        path = hashlib.sha256(key).digest()
+        bits = int.from_bytes(path, "big")
+        acc = _EMPTY if value_hash is None else _leaf_hash(path, value_hash)
+        sib_iter = iter(proof.siblings)
+        node_bits = bits
+        try:
+            for depth in range(DEPTH, 0, -1):
+                i = DEPTH - depth
+                if proof.bitmap[i // 8] >> (i % 8) & 1:
+                    sib = next(sib_iter)
+                else:
+                    sib = _DEFAULTS[depth]
+                if node_bits & 1:
+                    acc = hashlib.sha256(b"\x01" + sib + acc).digest()
+                else:
+                    acc = hashlib.sha256(b"\x01" + acc + sib).digest()
+                node_bits >>= 1
+        except StopIteration:
+            return False
+        return acc == root

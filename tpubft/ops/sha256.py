@@ -1,0 +1,131 @@
+"""Batched SHA-256 as a JAX kernel.
+
+TPU-native rebuild of the digest plumbing the reference computes
+one-at-a-time on CPU (util/include/Digest.hpp, DigestType.hpp;
+computeBlockDigest in bcstatetransfer/SimpleBCStateTransfer.hpp:59; the
+state-snapshot hashing benchmark kvbc/benchmark/state_snapshot_benchmarks/).
+A whole batch of equal-block-count messages is hashed in one jitted
+program: message schedule and the 64 rounds run as `lax.scan` loops over
+uint32 lanes, vmapped across the batch — ideal VPU work, no MXU needed.
+
+Used for bulk Merkle leaf/node hashing (sparse_merkle.py) and state-
+transfer block digests, where thousands of fixed-size hashes arrive at
+once. Single digests stay on hashlib (host) — the batch is the win.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_H0 = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19],
+               dtype=np.uint32)
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _schedule(block: jnp.ndarray) -> jnp.ndarray:
+    """block (B,16) uint32 -> full message schedule (64,B)."""
+    w0 = jnp.transpose(block)  # (16,B)
+
+    def step(carry, _):
+        # carry: last 16 w's, (16,B)
+        s0 = _rotr(carry[1], 7) ^ _rotr(carry[1], 18) ^ (carry[1] >> np.uint32(3))
+        s1 = _rotr(carry[14], 17) ^ _rotr(carry[14], 19) ^ (carry[14] >> np.uint32(10))
+        w = carry[0] + s0 + carry[9] + s1
+        return jnp.concatenate([carry[1:], w[None]], axis=0), w
+
+    _, rest = jax.lax.scan(step, w0, None, length=48)
+    return jnp.concatenate([w0, rest], axis=0)  # (64,B)
+
+
+def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression over the batch. state (8,B), block (B,16)."""
+    w = _schedule(block)
+    kw = w + jnp.asarray(_K)[:, None]
+
+    def round_fn(vars8, kw_t):
+        a, b, c, d, e, f, g, h = vars8
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + kw_t
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[i] for i in range(8))
+    out, _ = jax.lax.scan(round_fn, init, kw)
+    return state + jnp.stack(out)
+
+
+@functools.partial(jax.jit, static_argnums=())
+def sha256_kernel(words: jnp.ndarray) -> jnp.ndarray:
+    """words (B, nblocks, 16) uint32 big-endian message words (padded per
+    FIPS 180-4) -> digests (B, 8) uint32."""
+    batch = words.shape[0]
+    state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, batch))
+
+    def per_block(state, block):  # block (B,16)
+        return _compress(state, block), None
+
+    state, _ = jax.lax.scan(per_block, state0,
+                            jnp.transpose(words, (1, 0, 2)))
+    return jnp.transpose(state)
+
+
+def _pad_to_words(msg: bytes, nblocks: int) -> np.ndarray:
+    bitlen = len(msg) * 8
+    data = msg + b"\x80"
+    data += b"\x00" * (nblocks * 64 - 8 - len(data))
+    data += bitlen.to_bytes(8, "big")
+    assert len(data) == nblocks * 64
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(
+        nblocks, 16)
+
+
+def blocks_needed(msg_len: int) -> int:
+    return (msg_len + 8) // 64 + 1
+
+
+def prepare(messages: Sequence[bytes]) -> np.ndarray:
+    """Pad a batch of messages to a common block count -> (B, nb, 16).
+    All messages must need the same number of blocks (callers batch
+    fixed-size items: digest pairs, leaves, ST chunks)."""
+    nb = blocks_needed(max(len(m) for m in messages))
+    for m in messages:
+        if blocks_needed(len(m)) != nb:
+            raise ValueError("mixed block counts in one batch")
+    return np.stack([_pad_to_words(m, nb) for m in messages])
+
+
+def digest_words_to_bytes(dw: np.ndarray) -> List[bytes]:
+    return [row.astype(">u4").tobytes() for row in np.asarray(dw)]
+
+
+def sha256_batch(messages: Sequence[bytes]) -> List[bytes]:
+    """Hash a batch of same-block-count messages on device."""
+    if not messages:
+        return []
+    return digest_words_to_bytes(sha256_kernel(jnp.asarray(prepare(messages))))
+
+
