@@ -1,0 +1,126 @@
+"""SimpleKVBC application tests: wire codec, conflict detection, and the
+end-to-end 4-replica consensus run over the ledger (reference model:
+tests/simpleKVBC + apollo basic suites)."""
+import hashlib
+
+import pytest
+
+from tpubft.apps import skvbc
+from tpubft.kvbc import KeyValueBlockchain
+from tpubft.storage import MemoryDB
+from tpubft.testing.cluster import InProcessCluster
+
+
+def _handler_factory(_r=None):
+    return skvbc.SkvbcHandler(KeyValueBlockchain(MemoryDB()))
+
+
+def _h(s: bytes) -> skvbc.SkvbcHandler:
+    return skvbc.SkvbcHandler(KeyValueBlockchain(MemoryDB()))
+
+
+# ---------------- codec ----------------
+
+def test_skvbc_codec_roundtrip():
+    msgs = [
+        skvbc.ReadRequest(read_version=7, keys=[b"a", b"b"]),
+        skvbc.WriteRequest(read_version=3, long_exec=True,
+                           readset=[b"r1"], writeset=[(b"k", b"v"),
+                                                      (b"k2", b"v2")]),
+        skvbc.GetLastBlockRequest(),
+        skvbc.GetBlockDataRequest(block_id=9),
+        skvbc.ReadReply(reads=[(b"x", b"y")]),
+        skvbc.WriteReply(success=True, latest_block=12),
+        skvbc.GetLastBlockReply(latest_block=4),
+    ]
+    for msg in msgs:
+        assert skvbc.unpack(skvbc.pack(msg)) == msg
+    with pytest.raises(Exception):
+        skvbc.unpack(b"\xee junk")
+
+
+# ---------------- state machine ----------------
+
+def test_write_read_and_versions():
+    h = _handler_factory()
+    r = skvbc.unpack(h.execute(100, 1, 0, skvbc.pack(
+        skvbc.WriteRequest(writeset=[(b"k", b"v1")]))))
+    assert r.success and r.latest_block == 1
+    r = skvbc.unpack(h.execute(100, 2, 0, skvbc.pack(
+        skvbc.WriteRequest(writeset=[(b"k", b"v2"), (b"j", b"w")]))))
+    assert r.success and r.latest_block == 2
+
+    reads = skvbc.unpack(h.read(100, skvbc.pack(
+        skvbc.ReadRequest(keys=[b"k", b"j", b"absent"]))))
+    assert dict(reads.reads) == {b"k": b"v2", b"j": b"w"}
+    # versioned read
+    reads = skvbc.unpack(h.read(100, skvbc.pack(
+        skvbc.ReadRequest(read_version=1, keys=[b"k", b"j"]))))
+    assert dict(reads.reads) == {b"k": b"v1"}
+
+    last = skvbc.unpack(h.read(100, skvbc.pack(skvbc.GetLastBlockRequest())))
+    assert last.latest_block == 2
+    blk = skvbc.unpack(h.read(100, skvbc.pack(
+        skvbc.GetBlockDataRequest(block_id=2))))
+    assert dict(blk.reads) == {b"k": b"v2", b"j": b"w"}
+
+
+def test_conflict_detection():
+    h = _handler_factory()
+    h.execute(1, 1, 0, skvbc.pack(skvbc.WriteRequest(writeset=[(b"a", b"1")])))
+    ver = 1
+    # concurrent writer bumps `a` to block 2
+    h.execute(1, 2, 0, skvbc.pack(skvbc.WriteRequest(writeset=[(b"a", b"2")])))
+    # write conditioned on read_version=1 with readset {a} must fail
+    r = skvbc.unpack(h.execute(1, 3, 0, skvbc.pack(
+        skvbc.WriteRequest(read_version=ver, readset=[b"a"],
+                           writeset=[(b"b", b"x")]))))
+    assert not r.success
+    # readset key untouched since read_version -> succeeds
+    r = skvbc.unpack(h.execute(1, 4, 0, skvbc.pack(
+        skvbc.WriteRequest(read_version=2, readset=[b"a"],
+                           writeset=[(b"b", b"x")]))))
+    assert r.success
+    # failed write created no block
+    assert skvbc.unpack(h.read(1, skvbc.pack(
+        skvbc.GetLastBlockRequest()))).latest_block == 3
+
+
+def test_state_digest_deterministic():
+    h1, h2 = _handler_factory(), _handler_factory()
+    for h in (h1, h2):
+        h.execute(1, 1, 0, skvbc.pack(
+            skvbc.WriteRequest(writeset=[(b"k", b"v")])))
+    assert h1.state_digest() == h2.state_digest()
+    h1.execute(1, 2, 0, skvbc.pack(
+        skvbc.WriteRequest(writeset=[(b"k", b"v2")])))
+    assert h1.state_digest() != h2.state_digest()
+
+
+# ---------------- end-to-end over consensus ----------------
+
+@pytest.mark.slow
+def test_skvbc_cluster_end_to_end():
+    with InProcessCluster(f=1, handler_factory=_handler_factory) as cluster:
+        client = cluster.client(0)
+        client.start()
+        kv = skvbc.SkvbcClient(client)
+        w = kv.write([(b"alpha", b"1"), (b"beta", b"2")])
+        assert w.success and w.latest_block == 1
+        w = kv.write([(b"alpha", b"3")], readset=[b"alpha"],
+                     read_version=w.latest_block)
+        assert w.success
+        # stale condition loses
+        w2 = kv.write([(b"alpha", b"9")], readset=[b"alpha"], read_version=1)
+        assert not w2.success
+        assert kv.read([b"alpha", b"beta"]) == {b"alpha": b"3", b"beta": b"2"}
+        assert kv.get_last_block() == 2
+        # all replicas converge to one ledger digest
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            digs = {h.state_digest() for h in cluster.handlers.values()}
+            if len(digs) == 1:
+                break
+            time.sleep(0.1)
+        assert len(digs) == 1

@@ -1,0 +1,90 @@
+"""Process-level SKVBC test: 4 real replica OS processes over UDP
+localhost + a client, with persistent DBs and crash-restart recovery
+(reference model: Apollo's BftTestNetwork subprocess launches,
+tests/apollo/util/bft.py:818)."""
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpubft.apps.simple_test import endpoint_table
+from tpubft.apps.skvbc import SkvbcClient
+from tpubft.bftclient import BftClient, ClientConfig
+from tpubft.comm import CommConfig, PlainUdpCommunication
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.utils.config import ReplicaConfig
+
+F = 1
+N = 3 * F + 1
+CLIENTS = 2
+SEED = "proc-test-seed"
+
+
+def _spawn(replica_id: int, base_port: int, db_dir: str) -> subprocess.Popen:
+    env = dict(os.environ, PYTHONPATH="/root/repo",
+               JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpubft.apps.skvbc_replica",
+         "--replica", str(replica_id), "--f", str(F),
+         "--clients", str(CLIENTS), "--base-port", str(base_port),
+         "--db-dir", db_dir, "--seed", SEED],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _client(base_port: int, idx: int = 0) -> SkvbcClient:
+    client_id = N + idx
+    cfg = ReplicaConfig(f_val=F, num_of_client_proxies=CLIENTS)
+    keys = ClusterKeys.generate(cfg, CLIENTS,
+                                seed=SEED.encode()).for_node(client_id)
+    eps = endpoint_table(base_port, N, CLIENTS)
+    comm = PlainUdpCommunication(CommConfig(self_id=client_id,
+                                            endpoints=eps))
+    cl = BftClient(ClientConfig(client_id=client_id, f_val=F), keys, comm)
+    cl.start()
+    return SkvbcClient(cl)
+
+
+@pytest.mark.slow
+def test_four_process_cluster_write_read_restart(tmp_path):
+    base_port = random.randint(20000, 40000)
+    procs = {r: _spawn(r, base_port, str(tmp_path)) for r in range(N)}
+    try:
+        time.sleep(3.0)  # let processes bind + start
+        kv = _client(base_port)
+        deadline = time.monotonic() + 30
+        w = None
+        while time.monotonic() < deadline:
+            try:
+                w = kv.write([(b"proc-k", b"v1")], timeout_ms=4000)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert w is not None and w.success
+        assert kv.read([b"proc-k"]) == {b"proc-k": b"v1"}
+
+        # crash a backup replica hard; cluster (n-1 >= quorum) continues
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait()
+        w = kv.write([(b"proc-k2", b"v2")], timeout_ms=8000)
+        assert w.success
+
+        # restart it from its persistent DB — it must rejoin
+        procs[3] = _spawn(3, base_port, str(tmp_path))
+        time.sleep(2.0)
+        w = kv.write([(b"proc-k3", b"v3")], timeout_ms=8000)
+        assert w.success
+        assert kv.read([b"proc-k", b"proc-k2", b"proc-k3"]) == {
+            b"proc-k": b"v1", b"proc-k2": b"v2", b"proc-k3": b"v3"}
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()

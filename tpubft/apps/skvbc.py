@@ -1,0 +1,208 @@
+"""SimpleKVBC — the versioned KV test application.
+
+Rebuild of the reference's SKVBC state machine and wire protocol
+(/root/reference/tests/simpleKVBC/cmf/skvbc_messages.cmf,
+TesterReplica/internalCommandsHandler.cpp): a conditional-write KV store
+over the categorized blockchain. Writes carry a read_version + readset;
+at execution the replica rejects the write (success=False) if any readset
+key changed after read_version — the conflict-detection discipline the
+reference uses to exercise pre-execution. This is the app Apollo-style
+system tests and the linearizability tracker drive.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpubft.consensus.replica import IRequestsHandler
+from tpubft.kvbc import VERSIONED_KV, BlockUpdates, KeyValueBlockchain
+from tpubft.utils import serialize as ser
+
+READ_LATEST = 0  # read_version 0 = latest (reference uses 0 the same way)
+
+_CATEGORY = "kv"
+
+
+# ---------------- wire messages (skvbc_messages.cmf) ----------------
+
+@dataclass
+class ReadRequest:
+    ID = 3
+    read_version: int = READ_LATEST
+    keys: List[bytes] = field(default_factory=list)
+    SPEC = [("read_version", "u64"), ("keys", ("list", "bytes"))]
+
+
+@dataclass
+class WriteRequest:
+    ID = 4
+    read_version: int = 0
+    long_exec: bool = False
+    readset: List[bytes] = field(default_factory=list)
+    writeset: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    SPEC = [("read_version", "u64"), ("long_exec", "bool"),
+            ("readset", ("list", "bytes")),
+            ("writeset", ("list", ("pair", "bytes", "bytes")))]
+
+
+@dataclass
+class GetLastBlockRequest:
+    ID = 5
+    SPEC = []  # no fields
+
+
+@dataclass
+class GetBlockDataRequest:
+    ID = 6
+    block_id: int = 0
+    SPEC = [("block_id", "u64")]
+
+
+@dataclass
+class ReadReply:
+    ID = 7
+    reads: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    SPEC = [("reads", ("list", ("pair", "bytes", "bytes")))]
+
+
+@dataclass
+class WriteReply:
+    ID = 8
+    success: bool = False
+    latest_block: int = 0
+    SPEC = [("success", "bool"), ("latest_block", "u64")]
+
+
+@dataclass
+class GetLastBlockReply:
+    ID = 9
+    latest_block: int = 0
+    SPEC = [("latest_block", "u64")]
+
+
+_TYPES = {cls.ID: cls for cls in
+          (ReadRequest, WriteRequest, GetLastBlockRequest,
+           GetBlockDataRequest, ReadReply, WriteReply, GetLastBlockReply)}
+
+
+def pack(msg) -> bytes:
+    return bytes([msg.ID]) + ser.encode_msg(msg)
+
+
+def unpack(data: bytes):
+    if not data or data[0] not in _TYPES:
+        raise ser.SerializeError(f"unknown skvbc msg id {data[:1]!r}")
+    return ser.decode_msg(data[1:], _TYPES[data[0]])
+
+
+# ---------------- the state machine ----------------
+
+class SkvbcHandler(IRequestsHandler):
+    """InternalCommandsHandler equivalent
+    (tests/simpleKVBC/TesterReplica/internalCommandsHandler.hpp:34)."""
+
+    def __init__(self, blockchain: KeyValueBlockchain) -> None:
+        self._bc = blockchain
+        self._lock = threading.Lock()
+
+    # -- helpers --
+    def _read_at(self, key: bytes, version: int) -> Optional[bytes]:
+        if version == READ_LATEST:
+            hit = self._bc.get_latest(_CATEGORY, key)
+            return hit[1] if hit else None
+        return self._bc.get_versioned(_CATEGORY, key, version)
+
+    # -- IRequestsHandler --
+    def execute(self, client_id: int, req_seq: int, flags: int,
+                request: bytes) -> bytes:
+        try:
+            msg = unpack(request)
+        except ser.SerializeError:
+            return b""
+        with self._lock:
+            if isinstance(msg, WriteRequest):
+                return self._execute_write(msg)
+            # reads routed through consensus still serve consistent data
+            return self._execute_read(msg)
+
+    def _execute_write(self, msg: WriteRequest) -> bytes:
+        # conflict detection (internalCommandsHandler.cpp verifyWriteCommand):
+        # any readset key written after read_version fails the write
+        for key in msg.readset:
+            hit = self._bc.get_latest(_CATEGORY, key)
+            if hit is not None and hit[0] > msg.read_version:
+                return pack(WriteReply(success=False,
+                                       latest_block=self._bc.last_block_id))
+        bu = BlockUpdates()
+        for k, v in msg.writeset:
+            bu.put(_CATEGORY, k, v, cat_type=VERSIONED_KV)
+        if msg.writeset:
+            self._bc.add_block(bu)
+        return pack(WriteReply(success=True,
+                               latest_block=self._bc.last_block_id))
+
+    def _execute_read(self, msg) -> bytes:
+        if isinstance(msg, ReadRequest):
+            reads = []
+            for k in msg.keys:
+                v = self._read_at(k, msg.read_version)
+                if v is not None:
+                    reads.append((k, v))
+            return pack(ReadReply(reads=reads))
+        if isinstance(msg, GetLastBlockRequest):
+            return pack(GetLastBlockReply(latest_block=self._bc.last_block_id))
+        if isinstance(msg, GetBlockDataRequest):
+            blk = self._bc.get_block(msg.block_id)
+            if blk is None:
+                return pack(ReadReply(reads=[]))
+            from tpubft.kvbc.categories import decode_block_updates
+            bu = decode_block_updates(blk.updates_blob)
+            reads = []
+            for _name, (_t, cu) in sorted(bu.categories.items()):
+                for k in sorted(cu.kv):
+                    v = cu.kv[k]
+                    if v is not None:
+                        reads.append((k, v))
+            return pack(ReadReply(reads=reads))
+        return b""
+
+    def read(self, client_id: int, request: bytes) -> bytes:
+        try:
+            msg = unpack(request)
+        except ser.SerializeError:
+            return b""
+        with self._lock:
+            return self._execute_read(msg)
+
+    def state_digest(self) -> bytes:
+        with self._lock:
+            return self._bc.state_digest()
+
+
+class SkvbcClient:
+    """Client-side protocol wrapper (reference: apollo util/skvbc.py
+    SimpleKVBCProtocol) over a BftClient."""
+
+    def __init__(self, bft_client) -> None:
+        self._client = bft_client
+
+    def write(self, writeset: List[Tuple[bytes, bytes]],
+              readset: Optional[List[bytes]] = None,
+              read_version: int = 0,
+              timeout_ms: Optional[int] = None) -> WriteReply:
+        req = WriteRequest(read_version=read_version,
+                           readset=readset or [], writeset=writeset)
+        reply = self._client.send_write(pack(req), timeout_ms=timeout_ms)
+        return unpack(reply)
+
+    def read(self, keys: List[bytes], read_version: int = READ_LATEST,
+             timeout_ms: Optional[int] = None) -> Dict[bytes, bytes]:
+        req = ReadRequest(read_version=read_version, keys=keys)
+        reply = self._client.send_read(pack(req), timeout_ms=timeout_ms)
+        return dict(unpack(reply).reads)
+
+    def get_last_block(self, timeout_ms: Optional[int] = None) -> int:
+        reply = self._client.send_read(pack(GetLastBlockRequest()),
+                                       timeout_ms=timeout_ms)
+        return unpack(reply).latest_block

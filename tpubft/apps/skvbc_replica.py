@@ -1,0 +1,84 @@
+"""SKVBC TesterReplica — one OS-process KVBC replica.
+
+Rebuild of /root/reference/tests/simpleKVBC/TesterReplica/main.cpp:192:
+a standalone replica process running the SimpleKVBC state machine over
+the categorized blockchain with persistent storage, a UDP metrics server
+for the system-test harness to poll, and (optionally) a byzantine
+communication-wrapping strategy for fault-injection tests
+(TesterReplica/strategy/ + WrapCommunication.cpp).
+
+Run:  python -m tpubft.apps.skvbc_replica --replica 0 --f 1 \
+          --base-port 3710 --metrics-port 4710 [--db-dir DIR] [--seed S]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+from tpubft.apps.simple_test import endpoint_table
+from tpubft.comm import CommConfig, PlainUdpCommunication
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.kvbc.replica import KvbcReplica
+from tpubft.utils.config import ReplicaConfig
+from tpubft.utils.metrics import Aggregator, UdpMetricsServer
+
+
+def build_replica(args, comm_wrapper=None) -> KvbcReplica:
+    cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f, c_val=args.c,
+                        num_of_client_proxies=args.clients,
+                        view_change_timer_ms=args.view_change_timeout_ms)
+    keys = ClusterKeys.generate(cfg, args.clients,
+                                seed=args.seed.encode()).for_node(args.replica)
+    eps = endpoint_table(args.base_port, cfg.n_val, args.clients)
+    comm = PlainUdpCommunication(
+        CommConfig(self_id=args.replica, endpoints=eps))
+    if comm_wrapper is not None:
+        comm = comm_wrapper(comm)
+    db_path = (os.path.join(args.db_dir, f"replica-{args.replica}.kvlog")
+               if args.db_dir else None)
+    agg = Aggregator()
+    return KvbcReplica(cfg, keys, comm, db_path=db_path, aggregator=agg)
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="SKVBC tester replica")
+    p.add_argument("--replica", type=int, required=True)
+    p.add_argument("--f", type=int, default=1)
+    p.add_argument("--c", type=int, default=0)
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--base-port", type=int, default=3710)
+    p.add_argument("--metrics-port", type=int, default=0)
+    p.add_argument("--db-dir", default=None)
+    p.add_argument("--seed", default="tpubft-skvbc")
+    p.add_argument("--view-change-timeout-ms", type=int, default=4000)
+    p.add_argument("--strategy", default=None,
+                   help="byzantine strategy name (testing)")
+    return p
+
+
+def main() -> None:
+    args = make_parser().parse_args()
+    comm_wrapper = None
+    if args.strategy:
+        from tpubft.testing.byzantine import strategy_wrapper
+        comm_wrapper = strategy_wrapper(args.strategy)
+    kr = build_replica(args, comm_wrapper)
+    metrics = UdpMetricsServer(kr.replica.aggregator,
+                               port=args.metrics_port)
+    metrics.start()
+    kr.start()
+    print(f"skvbc replica {args.replica} up (metrics {metrics.port})",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        kr.stop()
+        metrics.stop()
+
+
+if __name__ == "__main__":
+    main()

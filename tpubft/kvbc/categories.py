@@ -131,7 +131,8 @@ def stage_category(db: IDBClient, wb: WriteBatch, category: str,
             wb.put(k, block_id.to_bytes(8, "big") + v,
                    _fam(category, "data"))
             for tag in updates.tags.get(k, []):
-                wb.put(tag.encode() + b"\x00" + k, v,
+                tb = tag.encode()
+                wb.put(len(tb).to_bytes(4, "big") + tb + k, v,
                        _fam(category, "tag"))
             h.update(b"\x01" + len(k).to_bytes(4, "big") + k
                      + hashlib.sha256(v).digest())
@@ -168,7 +169,8 @@ def get_versioned(db: IDBClient, category: str, key: bytes,
 def get_tagged(db: IDBClient, category: str, tag: str
                ) -> List[Tuple[bytes, bytes]]:
     """IMMUTABLE: all (key, value) written under a tag."""
-    prefix = tag.encode() + b"\x00"
+    tb = tag.encode()
+    prefix = len(tb).to_bytes(4, "big") + tb
     out = []
     for k, v in db.range_iter(_fam(category, "tag"), start=prefix):
         if not k.startswith(prefix):

@@ -1,0 +1,60 @@
+"""KvbcReplica — the process object wiring consensus + ledger + storage.
+
+Rebuild of `concord::kvbc::Replica` (/root/reference/kvbc/include/Replica.h:42,
+src/Replica.cpp): owns the DB backend, the categorized blockchain, the
+consensus engine (whose persistent metadata lands in the same DB via
+DBPersistentStorage), and the command handler that executes ordered
+requests against the blockchain. The same inversion as the reference:
+this object sits *above* the consensus engine it creates while also
+implementing its execution upcall.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from tpubft.comm.interfaces import ICommunication
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.consensus.replica import IRequestsHandler, Replica
+from tpubft.kvbc.blockchain import KeyValueBlockchain
+from tpubft.storage.interfaces import IDBClient
+from tpubft.storage.memorydb import MemoryDB
+from tpubft.storage.metadata import DBPersistentStorage
+from tpubft.utils.config import ReplicaConfig
+from tpubft.utils.metrics import Aggregator
+
+
+def open_db(db_path: Optional[str]) -> IDBClient:
+    """Storage factory (reference: kvbc storage factories — RocksDB for
+    production, memorydb for tests)."""
+    if db_path is None:
+        return MemoryDB()
+    from tpubft.storage.native import NativeDB
+    os.makedirs(os.path.dirname(db_path) or ".", exist_ok=True)
+    return NativeDB(db_path)
+
+
+class KvbcReplica:
+    def __init__(self, cfg: ReplicaConfig, keys: ClusterKeys,
+                 comm: ICommunication,
+                 db_path: Optional[str] = None,
+                 handler_factory=None,
+                 aggregator: Optional[Aggregator] = None,
+                 use_device_hashing: bool = False) -> None:
+        self.db = open_db(db_path)
+        self.blockchain = KeyValueBlockchain(
+            self.db, use_device_hashing=use_device_hashing)
+        if handler_factory is None:
+            from tpubft.apps.skvbc import SkvbcHandler
+            handler_factory = SkvbcHandler
+        self.handler: IRequestsHandler = handler_factory(self.blockchain)
+        self.replica = Replica(cfg, keys, comm, self.handler,
+                               storage=DBPersistentStorage(self.db),
+                               aggregator=aggregator)
+
+    def start(self) -> None:
+        self.replica.start()
+
+    def stop(self) -> None:
+        self.replica.stop()
+        self.db.close()

@@ -101,11 +101,9 @@ class SparseMerkleTree:
 
         # leaf level
         changed: Dict[int, bytes] = {}
-        paths: Dict[int, bytes] = {}
         for key, vh in updates.items():
             path = hashlib.sha256(key).digest()
             bits = int.from_bytes(path, "big")
-            paths[bits] = path
             if vh is None:
                 changed[bits] = _EMPTY
                 wb.delete(path, self._leaf_family)
@@ -164,6 +162,8 @@ class SparseMerkleTree:
     def verify(root: bytes, key: bytes, value_hash: Optional[bytes],
                proof: Proof) -> bool:
         """Checks membership (value_hash given) or non-membership (None)."""
+        if len(proof.bitmap) != 32:
+            return False
         path = hashlib.sha256(key).digest()
         bits = int.from_bytes(path, "big")
         acc = _EMPTY if value_hash is None else _leaf_hash(path, value_hash)

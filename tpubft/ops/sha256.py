@@ -123,9 +123,16 @@ def digest_words_to_bytes(dw: np.ndarray) -> List[bytes]:
 
 
 def sha256_batch(messages: Sequence[bytes]) -> List[bytes]:
-    """Hash a batch of same-block-count messages on device."""
+    """Hash a batch of same-block-count messages on device. The batch is
+    padded to the next power of two so steady-state callers (e.g. the
+    Merkle ascend, whose width shrinks level by level) hit a handful of
+    compiled shapes instead of one XLA compile per distinct width."""
     if not messages:
         return []
-    return digest_words_to_bytes(sha256_kernel(jnp.asarray(prepare(messages))))
+    n = len(messages)
+    padded_n = 1 << (n - 1).bit_length()
+    padded = list(messages) + [messages[0]] * (padded_n - n)
+    out = digest_words_to_bytes(sha256_kernel(jnp.asarray(prepare(padded))))
+    return out[:n]
 
 

@@ -1,0 +1,176 @@
+"""Byzantine strategies — fault-injecting communication wrappers.
+
+Rebuild of the reference's TesterReplica strategy framework
+(/root/reference/tests/simpleKVBC/TesterReplica/strategy/,
+WrapCommunication.cpp): an otherwise-honest replica is wrapped so its
+*outgoing* messages are dropped, delayed, corrupted, or misdirected.
+Strategies are selected by name (`--strategy` on the tester replica, or
+passed to the in-process cluster) so system tests can inject faults
+without touching protocol code.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+from tpubft.comm.interfaces import (ConnectionStatus, ICommunication,
+                                    IReceiver, NodeNum)
+
+
+class WrapCommunication(ICommunication):
+    """Delegates to an inner transport, routing sends through a mutator:
+    mutate(dest, data) -> data | None (None = drop)."""
+
+    def __init__(self, inner: ICommunication,
+                 mutate: Callable[[NodeNum, bytes], Optional[bytes]]) -> None:
+        self._inner = inner
+        self._mutate = mutate
+
+    def start(self, receiver: IReceiver) -> None:
+        self._inner.start(receiver)
+
+    def stop(self) -> None:
+        self._inner.stop()
+
+    def is_running(self) -> bool:
+        return self._inner.is_running()
+
+    def send(self, dest: NodeNum, data: bytes) -> None:
+        out = self._mutate(dest, data)
+        if out is not None:
+            self._inner.send(dest, out)
+
+    def get_connection_status(self, node: NodeNum) -> ConnectionStatus:
+        return self._inner.get_connection_status(node)
+
+    @property
+    def max_message_size(self) -> int:
+        return self._inner.max_message_size
+
+
+def _msg_code(data: bytes) -> int:
+    """Peek the consensus msg code without a full parse (every packed
+    consensus message starts with a little-endian u16 MsgCode)."""
+    import struct
+    return struct.unpack_from("<H", data)[0] if len(data) >= 2 else -1
+
+
+def _drop_all(dest: NodeNum, data: bytes) -> Optional[bytes]:
+    return None
+
+
+def _silent_preprepare(dest: NodeNum, data: bytes) -> Optional[bytes]:
+    from tpubft.consensus.messages import MsgCode
+    return None if _msg_code(data) == int(MsgCode.PrePrepare) else data
+
+
+def _corrupt_shares(dest: NodeNum, data: bytes) -> Optional[bytes]:
+    """Flip a byte in every outgoing signature-share message — exercises
+    share verification + bad-share isolation."""
+    from tpubft.consensus.messages import MsgCode
+    if _msg_code(data) in (int(MsgCode.PreparePartial),
+                           int(MsgCode.CommitPartial),
+                           int(MsgCode.PartialCommitProof)):
+        b = bytearray(data)
+        b[-1] ^= 0xFF
+        return bytes(b)
+    return data
+
+
+class _RandomDrop:
+    def __init__(self, rate: float, seed: int = 0xBF7) -> None:
+        self._rate = rate
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def __call__(self, dest: NodeNum, data: bytes) -> Optional[bytes]:
+        with self._lock:
+            roll = self._rng.random()
+        return None if roll < self._rate else data
+
+
+class _Delay:
+    """Delays every send via one worker thread draining a time-ordered
+    queue (send stays non-blocking; stop() cancels pending sends)."""
+
+    def __init__(self, delay_s: float) -> None:
+        self._delay = delay_s
+        self._inner: Optional[ICommunication] = None
+        self._queue: list = []
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def bind(self, inner: ICommunication) -> None:
+        self._inner = inner
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="byz-delay")
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._queue.clear()
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopped and (
+                        not self._queue
+                        or self._queue[0][0] > time.monotonic()):
+                    wait = (self._queue[0][0] - time.monotonic()
+                            if self._queue else None)
+                    self._cv.wait(timeout=wait)
+                if self._stopped:
+                    return
+                _, dest, data = self._queue.pop(0)
+            try:
+                if self._inner and self._inner.is_running():
+                    self._inner.send(dest, data)
+            except Exception:
+                pass
+
+    def __call__(self, dest: NodeNum, data: bytes) -> Optional[bytes]:
+        with self._cv:
+            if not self._stopped:
+                self._queue.append((time.monotonic() + self._delay,
+                                    dest, data))
+                self._cv.notify()
+        return None
+
+
+STRATEGIES: Dict[str, Callable[[], Callable]] = {
+    # reference strategy analogs (ByzantineStrategy.hpp implementations)
+    "silent": lambda: _drop_all,                       # mute replica
+    "silent-preprepare": lambda: _silent_preprepare,   # primary withholds PP
+    "corrupt-shares": lambda: _corrupt_shares,         # bad threshold shares
+    "drop-20": lambda: _RandomDrop(0.2),               # lossy links
+    "drop-50": lambda: _RandomDrop(0.5),
+}
+
+
+def strategy_wrapper(name: str) -> Callable[[ICommunication], ICommunication]:
+    if name.startswith("delay-"):
+        delay_ms = int(name.split("-", 1)[1])
+
+        def wrap_delay(inner: ICommunication) -> ICommunication:
+            d = _Delay(delay_ms / 1000.0)
+            d.bind(inner)
+
+            class _DelayedComm(WrapCommunication):
+                def stop(self) -> None:
+                    d.stop()
+                    super().stop()
+
+            return _DelayedComm(inner, d)
+        return wrap_delay
+    if name not in STRATEGIES:
+        raise ValueError(f"unknown byzantine strategy {name!r}; "
+                         f"have {sorted(STRATEGIES)} + delay-<ms>")
+
+    def wrap(inner: ICommunication) -> ICommunication:
+        return WrapCommunication(inner, STRATEGIES[name]())
+    return wrap

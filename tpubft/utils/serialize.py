@@ -119,6 +119,10 @@ def encode_value(buf: bytearray, spec: Any, v: Any) -> None:
             for k in sorted(v):
                 encode_value(buf, spec[1], k)
                 encode_value(buf, spec[2], v[k])
+        elif tag == "pair":
+            # CMF `kvpair` — ordered 2-tuple (order-preserving, unlike map)
+            encode_value(buf, spec[1], v[0])
+            encode_value(buf, spec[2], v[1])
         elif tag == "opt":
             if v is None:
                 buf.append(0)
@@ -176,6 +180,10 @@ def decode_value(data: memoryview, off: int, spec: Any) -> Tuple[Any, int]:
                 v, off = decode_value(data, off, spec[2])
                 out[k] = v
             return out, off
+        if tag == "pair":
+            a, off = decode_value(data, off, spec[1])
+            b, off = decode_value(data, off, spec[2])
+            return (a, b), off
         if tag == "opt":
             flag, off = read_uint(data, off, 1)
             if not flag:
