@@ -15,10 +15,6 @@ def _handler_factory(_r=None):
     return skvbc.SkvbcHandler(KeyValueBlockchain(MemoryDB()))
 
 
-def _h(s: bytes) -> skvbc.SkvbcHandler:
-    return skvbc.SkvbcHandler(KeyValueBlockchain(MemoryDB()))
-
-
 # ---------------- codec ----------------
 
 def test_skvbc_codec_roundtrip():

@@ -24,15 +24,17 @@ CLIENTS = 2
 SEED = "proc-test-seed"
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def _spawn(replica_id: int, base_port: int, db_dir: str) -> subprocess.Popen:
-    env = dict(os.environ, PYTHONPATH="/root/repo",
-               JAX_PLATFORMS="cpu")
+    env = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu")
     return subprocess.Popen(
         [sys.executable, "-m", "tpubft.apps.skvbc_replica",
          "--replica", str(replica_id), "--f", str(F),
          "--clients", str(CLIENTS), "--base-port", str(base_port),
          "--db-dir", db_dir, "--seed", SEED],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
 def _client(base_port: int, idx: int = 0) -> SkvbcClient:

@@ -106,6 +106,10 @@ class SkvbcHandler(IRequestsHandler):
         self._bc = blockchain
         self._lock = threading.Lock()
 
+    @property
+    def blockchain(self) -> KeyValueBlockchain:
+        return self._bc
+
     # -- helpers --
     def _read_at(self, key: bytes, version: int) -> Optional[bytes]:
         if version == READ_LATEST:

@@ -123,6 +123,9 @@ class Replica(IReceiver):
                   self.info.first_client_id + self.info.num_clients))
         self.pending_requests: List[m.ClientRequestMsg] = []
         self.checkpoints: Dict[int, Dict[int, m.CheckpointMsg]] = {}
+        # quorum-certified checkpoints ahead of us: seq -> state digest
+        # (the trust anchor handed to state transfer)
+        self.certified_checkpoints: Dict[int, bytes] = {}
 
         # --- view change state (ViewsManager equivalent) ---
         self.vc = ViewChangeState(self.info.complaint_quorum,
@@ -172,8 +175,61 @@ class Replica(IReceiver):
         self.m_last_executed = self.metrics.register_gauge("last_executed_seq")
         self.m_last_stable = self.metrics.register_gauge("last_stable_seq")
 
+        # state transfer (attached by the kvbc layer via set_state_transfer;
+        # reference: ReplicaForStateTransfer owning an IStateTransfer)
+        self.state_transfer = None
+
         self._restore_window(window_msgs)
         self._running = False
+
+    # ------------------------------------------------------------------
+    # state transfer wiring (ReplicaForStateTransfer equivalent)
+    # ------------------------------------------------------------------
+    def set_state_transfer(self, st) -> None:
+        self.state_transfer = st
+        st.bind(
+            send_fn=lambda dest, payload: self.comm.send(
+                dest, m.StateTransferMsg(sender_id=self.id,
+                                         payload=payload).pack()),
+            complete_fn=self._on_transfer_complete,
+            replica_ids=list(self.info.replica_ids),
+            f_val=self.cfg.f_val)
+        self.dispatcher.add_timer(0.2, st.tick)
+        self._st_stall_mark = (self.last_executed, time.monotonic())
+        self.dispatcher.add_timer(
+            max(self.cfg.st_stall_timeout_ms / 4000.0, 0.25),
+            self._check_st_stall)
+
+    def _check_st_stall(self) -> None:
+        """Dead-zone guard: a certified checkpoint is ahead of us but not
+        far enough for the immediate window trigger, and ordering has made
+        no progress (peers GC'd the needed commits) — fetch state."""
+        seq, t = self._st_stall_mark
+        now = time.monotonic()
+        if self.last_executed != seq:
+            self._st_stall_mark = (self.last_executed, now)
+            return
+        ahead = [s for s in self.certified_checkpoints
+                 if s > self.last_executed]
+        if not ahead:
+            return
+        if now - t > self.cfg.st_stall_timeout_ms / 1000.0:
+            self._st_stall_mark = (self.last_executed, now)
+            self.state_transfer.start_collecting(
+                max(ahead), dict(self.certified_checkpoints))
+
+    def _on_transfer_complete(self, seq: int, state_digest: bytes) -> None:
+        """onTransferringComplete (IStateTransfer.hpp:113): jump forward to
+        the transferred checkpoint and resume normal operation."""
+        if seq <= self.last_executed:
+            return
+        self.last_executed = seq
+        self.m_last_executed.set(seq)
+        self.primary_next_seq = max(self.primary_next_seq, seq + 1)
+        with self._tran() as st:
+            st.last_executed_seq = seq
+        self._on_seq_stable(seq, state_digest)
+        self._last_progress = time.monotonic()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -247,6 +303,13 @@ class Replica(IReceiver):
             return
         if isinstance(msg, m.CheckpointMsg):
             self._on_checkpoint(msg)
+            return
+        if isinstance(msg, m.StateTransferMsg):
+            # ST flows even mid-view-change (reference handles it in
+            # ReplicaForStateTransfer below the view gate)
+            if self.state_transfer is not None \
+                    and self.info.is_replica(sender):
+                self.state_transfer.handle_message(sender, msg.payload)
             return
         if self.in_view_change:
             return
@@ -697,19 +760,43 @@ class Replica(IReceiver):
         slot[ck.sender_id] = ck
         matching = sum(1 for other in slot.values()
                        if other.state_digest == ck.state_digest)
-        if matching >= self.info.checkpoint_quorum \
-                and ck.seq_num <= self.last_executed:
-            self._on_seq_stable(ck.seq_num)
+        if matching < self.info.checkpoint_quorum:
+            return
+        if ck.seq_num <= self.last_executed:
+            self._on_seq_stable(ck.seq_num, ck.state_digest)
+            return
+        # a certified checkpoint we haven't reached: remember the signed
+        # (seq, digest) — it is the ONLY trust anchor state transfer may
+        # fetch toward (ST sub-messages are unauthenticated, like the
+        # reference's; safety comes from the digest chain ending at a
+        # certificate-backed digest)
+        self.certified_checkpoints[ck.seq_num] = ck.state_digest
+        if len(self.certified_checkpoints) > 8:
+            del self.certified_checkpoints[min(self.certified_checkpoints)]
+        if (self.state_transfer is not None
+                and ck.seq_num >= self.last_executed
+                + self.cfg.work_window_size):
+            # hopelessly behind: fetch state now (BCStateTran trigger,
+            # reference startCollectingState on checkpoint beyond window)
+            self.state_transfer.start_collecting(
+                ck.seq_num, dict(self.certified_checkpoints))
 
-    def _on_seq_stable(self, seq: int) -> None:
+    def _on_seq_stable(self, seq: int,
+                       state_digest: Optional[bytes] = None) -> None:
         """onSeqNumIsStable: slide the work window, GC old state."""
         if seq <= self.last_stable:
             return
+        if self.state_transfer is not None:
+            self.state_transfer.on_checkpoint_stable(
+                seq, state_digest if state_digest is not None
+                else self.handler.state_digest())
         self.last_stable = seq
         self.m_last_stable.set(seq)
         self.window.advance(seq)
         for s in [s for s in self.checkpoints if s <= seq]:
             del self.checkpoints[s]
+        for s in [s for s in self.certified_checkpoints if s <= seq]:
+            del self.certified_checkpoints[s]
         for key in [k for k in self.carried_certs if k[0] <= seq]:
             del self.carried_certs[key]
         for s in [s for s in self.restrictions if s <= seq]:

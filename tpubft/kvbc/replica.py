@@ -51,6 +51,10 @@ class KvbcReplica:
         self.replica = Replica(cfg, keys, comm, self.handler,
                                storage=DBPersistentStorage(self.db),
                                aggregator=aggregator)
+        from tpubft.statetransfer import StateTransferManager
+        self.state_transfer = StateTransferManager(cfg.replica_id,
+                                                   self.blockchain)
+        self.replica.set_state_transfer(self.state_transfer)
 
     def start(self) -> None:
         self.replica.start()

@@ -1,0 +1,12 @@
+"""State transfer — bringing lagging/new replicas to the cluster's state.
+
+Rebuild of /root/reference/bftengine/src/bcstatetransfer/ (BCStateTran,
+RVBManager + RangeValidationTree, SourceSelector): checkpoint-summary
+agreement (f+1 matching), sourced block fetching with chunking, and
+per-block integrity proofs against an append-only digest tree so a
+Byzantine source is caught on the first bad block, not at the end.
+"""
+from tpubft.statetransfer.manager import StateTransferManager
+from tpubft.statetransfer.rvt import RangeValidationTree
+
+__all__ = ["StateTransferManager", "RangeValidationTree"]

@@ -1,0 +1,376 @@
+"""BCStateTran-equivalent: the state-transfer protocol state machine.
+
+Rebuild of /root/reference/bftengine/src/bcstatetransfer/BCStateTran.cpp
+(destination fetch loop + source serving) with RVBManager's duties folded
+into the RangeValidationTree and a SourceSelector for rotating away from
+slow/Byzantine sources. Runs entirely on the consensus dispatcher thread
+(handle_message + tick), so no internal locking is needed — mirroring the
+reference's single-threaded ST handler invoked from the replica loop.
+
+Flow (SURVEY §3.4):
+  destination: lag detected → AskForCheckpointSummaries (all replicas)
+    → f+1 matching summaries = agreed target (seq, digest, last_block,
+    rvt_root) → FetchBlocks batches from selected source → per-block RVT
+    proof check → stage + link into the blockchain → head == target →
+    verify digest → on_transfer_complete upcall into consensus.
+  source: answers summaries from its latest stable checkpoint; streams
+    chunked ItemData with RVT proofs; RejectFetching when pruned/behind.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpubft.kvbc.blockchain import BlockchainError, KeyValueBlockchain
+from tpubft.statetransfer import messages as stm
+from tpubft.statetransfer.rvt import RangeValidationTree, RvtProof
+from tpubft.utils import serialize as ser
+
+_META_FAMILY = b"st.meta"
+_K_STABLE = b"stable"
+
+# destination states
+_IDLE = "idle"
+_SUMMARIES = "summaries"
+_FETCHING = "fetching"
+
+
+@dataclass
+class StConfig:
+    fetch_batch_blocks: int = 16
+    max_chunk_bytes: int = 24 * 1024
+    retry_timeout_s: float = 1.0
+
+
+class SourceSelector:
+    """Rotates through candidate sources, abandoning ones that failed
+    (reference: bcstatetransfer/SourceSelector.hpp)."""
+
+    def __init__(self) -> None:
+        self._candidates: List[int] = []
+        self._idx = 0
+
+    def reset(self, candidates: List[int]) -> None:
+        self._candidates = list(candidates)
+        self._idx = 0
+
+    def current(self) -> Optional[int]:
+        if not self._candidates:
+            return None
+        return self._candidates[self._idx % len(self._candidates)]
+
+    def rotate(self) -> Optional[int]:
+        self._idx += 1
+        return self.current()
+
+
+class StateTransferManager:
+    def __init__(self, replica_id: int, blockchain: KeyValueBlockchain,
+                 cfg: Optional[StConfig] = None) -> None:
+        self.id = replica_id
+        self.bc = blockchain
+        self.cfg = cfg or StConfig()
+        self._db = blockchain._db
+        self.rvt = RangeValidationTree(self._db)
+        self.sources = SourceSelector()
+
+        # wiring (bind() before start)
+        self._send: Callable[[int, bytes], None] = lambda d, p: None
+        self._complete: Callable[[int, bytes], None] = lambda s, d: None
+        self._replica_ids: List[int] = []
+        self._quorum = 1  # f+1
+
+        # source-side stable checkpoint info, persisted across restarts
+        raw = self._db.get(_K_STABLE, _META_FAMILY)
+        self._stable: Optional[Tuple[int, bytes, int]] = None
+        if raw:
+            seq = int.from_bytes(raw[:8], "big")
+            last_block = int.from_bytes(raw[8:16], "big")
+            self._stable = (seq, raw[16:48], last_block)
+
+        # destination-side state
+        self.state = _IDLE
+        self._msg_id = 0
+        self._summaries: Dict[int, stm.CheckpointSummary] = {}
+        self._agreed: Optional[stm.CheckpointSummary] = None
+        self._min_seq = 0
+        self._certified: Dict[int, bytes] = {}  # seq -> certified digest
+        self._chunks: Dict[int, Dict[int, bytes]] = {}  # block -> idx -> part
+        self._chunk_totals: Dict[int, int] = {}
+        self._proofs: Dict[int, RvtProof] = {}
+        self._last_activity = 0.0
+        self._fetch_from = 0
+
+    # ------------------------------------------------------------------
+    # wiring
+    # ------------------------------------------------------------------
+    def bind(self, send_fn: Callable[[int, bytes], None],
+             complete_fn: Callable[[int, bytes], None],
+             replica_ids: List[int], f_val: int) -> None:
+        self._send = send_fn
+        self._complete = complete_fn
+        self._replica_ids = [r for r in replica_ids if r != self.id]
+        self._quorum = f_val + 1
+
+    @property
+    def is_fetching(self) -> bool:
+        return self.state != _IDLE
+
+    # ------------------------------------------------------------------
+    # consensus upcalls (dispatcher thread)
+    # ------------------------------------------------------------------
+    def on_checkpoint_stable(self, seq: int, state_digest: bytes) -> None:
+        """Record the latest stable checkpoint we can serve
+        (RVBManager::setNewSourceCheckpoint duty) and grow the RVT."""
+        try:
+            self.rvt.sync_to(self.bc)
+        except BlockchainError:
+            return  # digest gap (shouldn't happen); keep old serving point
+        self._stable = (seq, state_digest, self.bc.last_block_id)
+        self._db.put(
+            _K_STABLE,
+            seq.to_bytes(8, "big") + self.bc.last_block_id.to_bytes(8, "big")
+            + state_digest, _META_FAMILY)
+
+    def start_collecting(self, min_checkpoint_seq: int,
+                         certified: Optional[Dict[int, bytes]] = None
+                         ) -> None:
+        """Lag detected by consensus — begin (or retarget) a transfer.
+        `certified` maps checkpoint seq -> signature-quorum-verified state
+        digest; ST sub-messages are unauthenticated, so summaries are only
+        accepted when they match one of these anchors (an attacker who can
+        spoof sender ids still cannot steer us to a state whose head
+        digest isn't certificate-backed)."""
+        if certified:
+            self._certified.update(certified)
+        if self.state == _FETCHING:
+            return
+        self._min_seq = max(self._min_seq, min_checkpoint_seq)
+        if self.state == _SUMMARIES:
+            return
+        self.state = _SUMMARIES
+        self._summaries.clear()
+        self._agreed = None
+        self._ask_summaries()
+
+    def tick(self) -> None:
+        if self.state == _IDLE:
+            return
+        if time.monotonic() - self._last_activity < self.cfg.retry_timeout_s:
+            return
+        if self.state == _SUMMARIES:
+            self._ask_summaries()
+        elif self.state == _FETCHING:
+            # stalled source: rotate and re-request the current batch
+            self.sources.rotate()
+            self._request_next_batch()
+
+    # ------------------------------------------------------------------
+    # message dispatch
+    # ------------------------------------------------------------------
+    def handle_message(self, sender: int, payload: bytes) -> None:
+        try:
+            msg = stm.unpack(payload)
+        except ser.SerializeError:
+            return
+        if isinstance(msg, stm.AskForCheckpointSummaries):
+            self._on_ask_summaries(sender, msg)
+        elif isinstance(msg, stm.CheckpointSummary):
+            self._on_summary(sender, msg)
+        elif isinstance(msg, stm.FetchBlocks):
+            self._on_fetch_blocks(sender, msg)
+        elif isinstance(msg, stm.ItemData):
+            self._on_item_data(sender, msg)
+        elif isinstance(msg, stm.RejectFetching):
+            self._on_reject(sender, msg)
+
+    # ------------------------------------------------------------------
+    # source side
+    # ------------------------------------------------------------------
+    def _on_ask_summaries(self, sender: int,
+                          msg: stm.AskForCheckpointSummaries) -> None:
+        if self._stable is None:
+            return
+        seq, digest, last_block = self._stable
+        if seq < msg.min_checkpoint_seq or last_block == 0:
+            return
+        try:
+            root = self.rvt.root(last_block)
+        except ValueError:
+            return
+        self._send(sender, stm.pack(stm.CheckpointSummary(
+            reply_to=msg.msg_id, checkpoint_seq=seq, state_digest=digest,
+            last_block=last_block, rvt_root=root)))
+
+    def _on_fetch_blocks(self, sender: int, msg: stm.FetchBlocks) -> None:
+        if (self._stable is None or msg.from_block > msg.to_block
+                or msg.from_block < 1
+                or msg.to_block > self._stable[2]
+                or msg.to_block - msg.from_block
+                >= 4 * self.cfg.fetch_batch_blocks):
+            self._send(sender, stm.pack(stm.RejectFetching(
+                reply_to=msg.msg_id, reason="range unavailable")))
+            return
+        if msg.from_block < self.bc.genesis_block_id:
+            self._send(sender, stm.pack(stm.RejectFetching(
+                reply_to=msg.msg_id, reason="pruned")))
+            return
+        rvt_leaves = self._stable[2]
+        for bid in range(msg.from_block, msg.to_block + 1):
+            raw = self.bc.get_raw_block(bid)
+            if raw is None:
+                self._send(sender, stm.pack(stm.RejectFetching(
+                    reply_to=msg.msg_id, reason=f"missing {bid}")))
+                return
+            proof = self.rvt.prove(bid - 1, rvt_leaves)
+            chunks = [raw[i:i + self.cfg.max_chunk_bytes]
+                      for i in range(0, len(raw), self.cfg.max_chunk_bytes)] \
+                or [b""]
+            for ci, chunk in enumerate(chunks):
+                self._send(sender, stm.pack(stm.ItemData(
+                    reply_to=msg.msg_id, block_id=bid, chunk_idx=ci,
+                    total_chunks=len(chunks), payload=chunk, proof=proof,
+                    last_in_response=(bid == msg.to_block
+                                      and ci == len(chunks) - 1))))
+
+    # ------------------------------------------------------------------
+    # destination side
+    # ------------------------------------------------------------------
+    def _ask_summaries(self) -> None:
+        self._msg_id += 1
+        self._last_activity = time.monotonic()
+        ask = stm.pack(stm.AskForCheckpointSummaries(
+            msg_id=self._msg_id, min_checkpoint_seq=self._min_seq))
+        for r in self._replica_ids:
+            self._send(r, ask)
+
+    def _on_summary(self, sender: int, msg: stm.CheckpointSummary) -> None:
+        if self.state != _SUMMARIES or msg.reply_to != self._msg_id:
+            return
+        if msg.checkpoint_seq < self._min_seq or msg.last_block == 0:
+            return
+        if sender not in self._replica_ids:
+            return
+        # only certificate-anchored targets are acceptable
+        if self._certified.get(msg.checkpoint_seq) != msg.state_digest:
+            return
+        self._summaries[sender] = msg
+        groups: Dict[tuple, List[int]] = {}
+        for r, s in self._summaries.items():
+            groups.setdefault(s.key(), []).append(r)
+        for key, senders in groups.items():
+            if len(senders) >= self._quorum:
+                self._agreed = next(s for s in self._summaries.values()
+                                    if s.key() == key)
+                self.sources.reset(sorted(senders))
+                self.state = _FETCHING
+                self._chunks.clear()
+                self._chunk_totals.clear()
+                self._proofs.clear()
+                self._request_next_batch()
+                return
+
+    def _request_next_batch(self) -> None:
+        assert self._agreed is not None
+        self._last_activity = time.monotonic()
+        nxt = self.bc.last_block_id + 1
+        if nxt > self._agreed.last_block:
+            self._finish()
+            return
+        src = self.sources.current()
+        if src is None:
+            # no usable sources left — start over from summaries
+            self.state = _SUMMARIES
+            self._summaries.clear()
+            self._agreed = None
+            self._ask_summaries()
+            return
+        self._msg_id += 1
+        self._fetch_from = nxt
+        to = min(nxt + self.cfg.fetch_batch_blocks - 1,
+                 self._agreed.last_block)
+        self._send(src, stm.pack(stm.FetchBlocks(
+            msg_id=self._msg_id, from_block=nxt, to_block=to)))
+
+    def _on_item_data(self, sender: int, msg: stm.ItemData) -> None:
+        if (self.state != _FETCHING or self._agreed is None
+                or sender != self.sources.current()
+                or msg.reply_to != self._msg_id):
+            return
+        if not (self._fetch_from <= msg.block_id
+                <= self._agreed.last_block):
+            return
+        if not 0 <= msg.chunk_idx < msg.total_chunks:
+            return
+        self._last_activity = time.monotonic()
+        parts = self._chunks.setdefault(msg.block_id, {})
+        parts[msg.chunk_idx] = msg.payload
+        self._chunk_totals[msg.block_id] = msg.total_chunks
+        self._proofs[msg.block_id] = msg.proof
+        if len(parts) == msg.total_chunks:
+            raw = b"".join(parts[i] for i in range(msg.total_chunks))
+            if not self._adopt_block(msg.block_id, raw):
+                return
+        if msg.last_in_response:
+            self._try_link_and_continue()
+
+    def _adopt_block(self, block_id: int, raw: bytes) -> bool:
+        """RVT-check one reassembled block and stage it."""
+        assert self._agreed is not None
+        leaf = hashlib.sha256(raw).digest()
+        proof = self._proofs.get(block_id)
+        if proof is None or not RangeValidationTree.verify(
+                self._agreed.rvt_root, block_id - 1,
+                self._agreed.last_block, leaf, proof):
+            self._punish_source()
+            return False
+        self.bc.add_raw_st_block(block_id, raw)
+        self._chunks.pop(block_id, None)
+        self._chunk_totals.pop(block_id, None)
+        self._proofs.pop(block_id, None)
+        return True
+
+    def _try_link_and_continue(self) -> None:
+        try:
+            self.bc.link_st_chain()
+        except Exception:
+            self._punish_source()
+            return
+        self._request_next_batch()
+
+    def _punish_source(self) -> None:
+        """Bad data: rotate away and retry the batch from the new source."""
+        self._chunks.clear()
+        self._chunk_totals.clear()
+        self._proofs.clear()
+        self.sources.rotate()
+        self._request_next_batch()
+
+    def _on_reject(self, sender: int, msg: stm.RejectFetching) -> None:
+        if self.state != _FETCHING or sender != self.sources.current():
+            return
+        if msg.reply_to != self._msg_id:
+            return
+        self._punish_source()
+
+    def _finish(self) -> None:
+        assert self._agreed is not None
+        agreed = self._agreed
+        if self.bc.state_digest() != agreed.state_digest:
+            # chain linked but digest mismatch — the agreed group lied or
+            # we hit a bug; restart from scratch
+            self.state = _SUMMARIES
+            self._summaries.clear()
+            self._agreed = None
+            self._ask_summaries()
+            return
+        self.state = _IDLE
+        self._agreed = None
+        self._summaries.clear()
+        self._certified = {s: d for s, d in self._certified.items()
+                           if s > agreed.checkpoint_seq}
+        # we are now a valid source for this checkpoint
+        self.on_checkpoint_stable(agreed.checkpoint_seq, agreed.state_digest)
+        self._complete(agreed.checkpoint_seq, agreed.state_digest)

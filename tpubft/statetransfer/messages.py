@@ -1,0 +1,84 @@
+"""State-transfer wire messages, carried inside the consensus-level
+StateTransferMsg envelope (reference: bcstatetransfer/Messages.hpp —
+AskForCheckpointSummariesMsg, CheckpointSummaryMsg, FetchBlocksMsg,
+ItemDataMsg, RejectFetchingMsg)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from tpubft.statetransfer.rvt import RvtProof
+from tpubft.utils import serialize as ser
+
+
+@dataclass
+class AskForCheckpointSummaries:
+    ID = 1
+    msg_id: int = 0              # nonce echoed in replies
+    min_checkpoint_seq: int = 0
+    SPEC = [("msg_id", "u64"), ("min_checkpoint_seq", "u64")]
+
+
+@dataclass
+class CheckpointSummary:
+    ID = 2
+    reply_to: int = 0
+    checkpoint_seq: int = 0
+    state_digest: bytes = b""
+    last_block: int = 0
+    rvt_root: bytes = b""
+    SPEC = [("reply_to", "u64"), ("checkpoint_seq", "u64"),
+            ("state_digest", "bytes"), ("last_block", "u64"),
+            ("rvt_root", "bytes")]
+
+    def key(self):
+        return (self.checkpoint_seq, self.state_digest, self.last_block,
+                self.rvt_root)
+
+
+@dataclass
+class FetchBlocks:
+    ID = 3
+    msg_id: int = 0
+    from_block: int = 0
+    to_block: int = 0
+    SPEC = [("msg_id", "u64"), ("from_block", "u64"), ("to_block", "u64")]
+
+
+@dataclass
+class ItemData:
+    ID = 4
+    reply_to: int = 0
+    block_id: int = 0
+    chunk_idx: int = 0
+    total_chunks: int = 1
+    payload: bytes = b""
+    # membership proof of the whole block's digest at the agreed rvt size
+    proof: RvtProof = field(default_factory=RvtProof)
+    last_in_response: bool = False
+    SPEC = [("reply_to", "u64"), ("block_id", "u64"), ("chunk_idx", "u32"),
+            ("total_chunks", "u32"), ("payload", "bytes"),
+            ("proof", ("msg", RvtProof)), ("last_in_response", "bool")]
+
+
+@dataclass
+class RejectFetching:
+    ID = 5
+    reply_to: int = 0
+    reason: str = ""
+    SPEC = [("reply_to", "u64"), ("reason", "str")]
+
+
+_TYPES = {cls.ID: cls for cls in
+          (AskForCheckpointSummaries, CheckpointSummary, FetchBlocks,
+           ItemData, RejectFetching)}
+
+
+def pack(msg) -> bytes:
+    return bytes([msg.ID]) + ser.encode_msg(msg)
+
+
+def unpack(data: bytes):
+    if not data or data[0] not in _TYPES:
+        raise ser.SerializeError(f"unknown ST msg id {data[:1]!r}")
+    return ser.decode_msg(data[1:], _TYPES[data[0]])

@@ -56,6 +56,14 @@ class InProcessCluster:
         storage = (self.storage_factory(r) if self.storage_factory else None)
         rep = Replica(cfg, self.keys.for_node(r), self.bus.create(r),
                       handler, storage=storage, aggregator=agg)
+        # KVBC-backed handlers get a state-transfer manager, mirroring
+        # KvbcReplica wiring (handlers expose .blockchain for this)
+        bc = getattr(handler, "blockchain", None)
+        if bc is not None:
+            from tpubft.statetransfer import StateTransferManager
+            from tpubft.statetransfer.manager import StConfig
+            rep.set_state_transfer(StateTransferManager(
+                r, bc, StConfig(retry_timeout_s=0.3)))
         self.replicas[r] = rep
         return rep
 

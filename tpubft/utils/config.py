@@ -38,6 +38,10 @@ class ReplicaConfig:
     work_window_size: int = 300         # in-flight seqnum window (2 checkpoints)
     max_reply_size_bytes: int = 1_048_576
 
+    # state transfer
+    st_stall_timeout_ms: int = 5000     # certified checkpoint ahead + no
+                                        # execution progress -> fetch state
+
     # commit paths
     fast_path_timeout_ms: int = 300     # demote in-flight seq to slow path
     auto_primary_rotation_enabled: bool = False
