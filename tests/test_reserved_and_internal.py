@@ -1,0 +1,168 @@
+"""Reserved pages, internal BFT client, key exchange, time service, and
+consensus-driven cron (reference test model: KeyExchangeManager tests,
+TimeServiceManager tests, ccron/test, ClientsManager_test reply cache)."""
+import time
+
+import pytest
+
+from tpubft.apps import counter, skvbc
+from tpubft.ccron.cron_table import CronTable
+from tpubft.consensus import messages as m
+from tpubft.consensus.internal import (KeyExchangeOp, TickOp,
+                                       TimeServiceManager, pack_op,
+                                       unpack_op)
+from tpubft.consensus.reserved_pages import (ReservedPages,
+                                             ReservedPagesClient)
+from tpubft.kvbc import KeyValueBlockchain
+from tpubft.storage import MemoryDB
+from tpubft.testing.cluster import InProcessCluster
+
+
+def test_reserved_pages_basics():
+    pages = ReservedPages(MemoryDB())
+    d0 = pages.digest()
+    pages.save("clients", 7, b"reply-bytes")
+    pages.save("time", 0, b"\x00" * 8)
+    assert pages.load("clients", 7) == b"reply-bytes"
+    assert pages.load("clients", 8) is None
+    d1 = pages.digest()
+    assert d1 != d0
+    # replace_all roundtrip preserves the digest
+    other = ReservedPages(MemoryDB())
+    other.replace_all(pages.all_pages())
+    assert other.digest() == d1
+    with pytest.raises(ValueError):
+        pages.save("big", 0, b"x" * 5000)
+    client = ReservedPagesClient(pages, "clients")
+    assert client.load(index=7) == b"reply-bytes"
+
+
+def test_internal_op_codec():
+    for op in (KeyExchangeOp(replica_id=2, pubkey=b"\x05" * 32,
+                             generation=3),
+               TickOp(component="pruner", tick_seq=9)):
+        assert unpack_op(pack_op(op)) == op
+
+
+def test_cron_table_dedupe_and_persistence():
+    pages = ReservedPages(MemoryDB())
+    table = CronTable(ReservedPagesClient(pages, CronTable.CATEGORY))
+    fired = []
+    table.register("pruner", fired.append)
+    table.on_tick(TickOp(component="pruner", tick_seq=1))
+    table.on_tick(TickOp(component="pruner", tick_seq=1))  # dup: ignored
+    table.on_tick(TickOp(component="pruner", tick_seq=2))
+    assert fired == [1, 2]
+    # a fresh table over the same pages resumes from the stored tick
+    table2 = CronTable(ReservedPagesClient(pages, CronTable.CATEGORY))
+    fired2 = []
+    table2.register("pruner", fired2.append)
+    table2.on_tick(TickOp(component="pruner", tick_seq=2))
+    assert fired2 == []
+    assert table2.last_tick("pruner") == 2
+
+
+def test_time_service_manager():
+    now = [1000.0]
+    pages = ReservedPagesClient(ReservedPages(MemoryDB()), "time")
+    ts = TimeServiceManager(pages, max_skew_ms=100, clock=lambda: now[0])
+    t1 = ts.primary_stamp()
+    assert t1 == 1000_000
+    assert ts.validate(t1)
+    assert not ts.validate(t1 + 200)     # beyond skew
+    ts.on_executed(t1)
+    assert not ts.validate(t1)           # not monotonic anymore
+    assert ts.primary_stamp() == t1 + 1  # stamps stay monotonic
+    ts2 = TimeServiceManager(pages, max_skew_ms=100, clock=lambda: now[0])
+    assert ts2.last_agreed_ms == t1      # persisted
+
+
+# ---------------- through consensus ----------------
+
+@pytest.mark.slow
+def test_key_exchange_through_consensus():
+    with InProcessCluster(f=1) as cluster:
+        rep1 = cluster.replicas[1]
+        old_pk = rep1.sig._replica_pubkeys[1]
+        gen = rep1.key_exchange.initiate()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            pks = {r: rep.sig._replica_pubkeys[1]
+                   for r, rep in cluster.replicas.items()}
+            if all(pk != old_pk for pk in pks.values()) \
+                    and len(set(pks.values())) == 1:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("key exchange never propagated")
+        # the owner activated its new private key: a message it signs now
+        # verifies under the new public key everywhere
+        payload = b"post-rotation"
+        sig = rep1.sig.sign(payload)
+        assert cluster.replicas[0].sig.verify(1, payload, sig)
+        # cluster still works end-to-end after rotation
+        client = cluster.client(0)
+        client.start()
+        from tpubft.apps.counter import encode_add
+        reply = client.send_write(encode_add(5))
+        assert counter.decode_reply(reply) == 5
+
+
+@pytest.mark.slow
+def test_cron_ticks_through_consensus():
+    fired = {}
+
+    def factory(r):
+        return counter.CounterHandler()
+
+    with InProcessCluster(f=1, handler_factory=factory) as cluster:
+        for r, rep in cluster.replicas.items():
+            fired[r] = []
+            rep.cron_table.register("heartbeat", fired[r].append)
+            rep.ticks_generator.schedule("heartbeat", period_s=0.3)
+        deadline = time.monotonic() + 12
+        while time.monotonic() < deadline:
+            if all(len(v) >= 2 for v in fired.values()):
+                break
+            time.sleep(0.1)
+        assert all(len(v) >= 2 for v in fired.values()), fired
+        # identical tick sequences on every replica (determinism)
+        seqs = {tuple(v[:2]) for v in fired.values()}
+        assert seqs == {(1, 2)}
+
+
+@pytest.mark.slow
+def test_time_service_through_consensus():
+    def factory(r):
+        return counter.CounterHandler()
+
+    with InProcessCluster(f=1, handler_factory=factory,
+                          cfg_overrides=dict(time_service_enabled=True)) \
+            as cluster:
+        client = cluster.client(0)
+        client.start()
+        from tpubft.apps.counter import encode_add
+        client.send_write(encode_add(1))
+        client.send_write(encode_add(2))
+        time.sleep(0.3)
+        times = [rep.time_service.last_agreed_ms
+                 for rep in cluster.replicas.values()]
+        assert max(times) > 0
+        # agreed clock equal on all replicas that executed both writes
+        assert len({t for t in times if t == max(times)}) == 1
+
+
+@pytest.mark.slow
+def test_client_reply_cache_in_reserved_pages():
+    with InProcessCluster(f=1) as cluster:
+        client = cluster.client(0)
+        client.start()
+        from tpubft.apps.counter import encode_add
+        client.send_write(encode_add(7))
+        time.sleep(0.2)
+        rep0 = cluster.replicas[0]
+        page = rep0.res_pages.load("clients", client.cfg.client_id)
+        assert page is not None and page[:1] == b"\x00"
+        reply = m.unpack(page[1:])
+        assert isinstance(reply, m.ClientReplyMsg)
+        assert counter.decode_reply(reply.reply) == 7

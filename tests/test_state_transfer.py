@@ -140,7 +140,7 @@ def test_manager_full_transfer():
     dest.start_collecting(10)
     assert dest.state != "idle" and done == []
     dest.state = "idle"
-    dest.start_collecting(10, {10: chain.state_digest()})
+    dest.start_collecting(10, {10: (chain.state_digest(), b"")})
     assert done == [(10, chain.state_digest())]
     assert dest_bc.last_block_id == 40
     assert dest_bc.state_digest() == chain.state_digest()
@@ -180,7 +180,7 @@ def test_manager_byzantine_source_rotation():
     done = []
     dest.bind(net.sender(3), lambda s, d: done.append((s, d)),
               replica_ids=[0, 1], f_val=1)
-    dest.start_collecting(5, {5: chain.state_digest()})
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
     assert done == [(5, chain.state_digest())]
     assert dest_bc.state_digest() == chain.state_digest()
 

@@ -62,6 +62,14 @@ class ClientsManager:
             info.pending_req_seq = None
             info.pending_cid = ""
 
+    def note_executed(self, client_id: int, req_seq: int) -> None:
+        """Advance at-most-once state without a cached reply (oversize
+        reply marker loaded from reserved pages)."""
+        info = self._clients.get(client_id)
+        if info is not None and req_seq > info.last_executed_req:
+            info.last_executed_req = req_seq
+            info.last_reply = None
+
     def cached_reply(self, client_id: int,
                      req_seq: int) -> Optional[ClientReplyMsg]:
         """Reply for a retransmitted already-executed request (reference

@@ -1,0 +1,175 @@
+"""Internal BFT client + the consensus-internal operations riding it.
+
+Rebuild of the reference's InternalBFTClient
+(/root/reference/bftengine/src/bftengine/InternalBFTClient.cpp) and the
+subsystems that submit requests through it: KeyExchangeManager
+(KeyExchangeManager.cpp — rotates a replica's signing key via an ordered,
+self-signed request) and the TimeServiceManager
+(TimeServiceManager.hpp — primary-stamped, replica-validated, consensus-
+agreed monotonic clock persisted in a reserved page).
+
+Every replica owns one internal client principal (id =
+first_client_id + num_clients + replica_id); its requests are signed with
+the replica's key and executed by the replica engine itself rather than
+the application handler.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from tpubft.consensus import messages as m
+from tpubft.consensus.reserved_pages import ReservedPagesClient
+from tpubft.crypto.cpu import Ed25519Signer
+from tpubft.utils import serialize as ser
+
+
+# ---------------- internal operation envelope ----------------
+
+@dataclass
+class KeyExchangeOp:
+    """Replica `replica_id` announces a new signing public key."""
+    ID = 1
+    replica_id: int = 0
+    pubkey: bytes = b""
+    generation: int = 0
+    SPEC = [("replica_id", "u32"), ("pubkey", "bytes"),
+            ("generation", "u64")]
+
+
+@dataclass
+class TickOp:
+    """Deterministic cron tick for one component (ccron TickInternalMsg)."""
+    ID = 2
+    component: str = ""
+    tick_seq: int = 0
+    SPEC = [("component", "str"), ("tick_seq", "u64")]
+
+
+_OPS = {cls.ID: cls for cls in (KeyExchangeOp, TickOp)}
+
+
+def pack_op(op) -> bytes:
+    return bytes([op.ID]) + ser.encode_msg(op)
+
+
+def unpack_op(data: bytes):
+    if not data or data[0] not in _OPS:
+        raise ser.SerializeError(f"unknown internal op {data[:1]!r}")
+    return ser.decode_msg(data[1:], _OPS[data[0]])
+
+
+# ---------------- internal client ----------------
+
+class InternalBFTClient:
+    """Lets the replica submit requests into its own consensus
+    (key exchange, cron ticks, reconfiguration)."""
+
+    def __init__(self, replica) -> None:
+        self._replica = replica
+        self.client_id = replica.info.internal_client_of(replica.id)
+        # req seqnums must survive restarts (at-most-once filtering);
+        # wall-clock ms + in-process counter is monotonic enough
+        self._req_seq = int(time.time() * 1000)
+
+    def submit(self, payload: bytes,
+               flags: int = int(m.RequestFlag.INTERNAL)) -> int:
+        self._req_seq += 1
+        req = m.ClientRequestMsg(
+            sender_id=self.client_id, req_seq_num=self._req_seq,
+            flags=flags | int(m.RequestFlag.INTERNAL), request=payload,
+            cid=f"int-{self._replica.id}-{self._req_seq}", signature=b"")
+        req.signature = self._replica.sig.sign(req.signed_payload())
+        raw = req.pack()
+        for r in self._replica.info.other_replicas(self._replica.id):
+            self._replica.comm.send(r, raw)
+        # self-delivery through the normal external queue
+        self._replica.incoming.push_external(self.client_id, raw)
+        return self._req_seq
+
+
+# ---------------- key exchange ----------------
+
+class KeyExchangeManager:
+    """Orders a replica's new signing key through consensus and swaps it
+    on execution; exchanged keys persist in reserved pages so state-
+    transferred replicas adopt them (reference KeyExchangeManager +
+    ClientsPubKeysStore roles)."""
+
+    CATEGORY = "keyex"
+
+    def __init__(self, replica, pages: ReservedPagesClient) -> None:
+        self._replica = replica
+        self._pages = pages
+        self._candidates: Dict[int, Ed25519Signer] = {}  # generation -> key
+        self._generation = 0
+
+    def initiate(self) -> int:
+        """Generate a candidate key and submit the exchange op
+        (sendInitialKey / sendKeyExchange)."""
+        signer = Ed25519Signer.generate(seed=os.urandom(32))
+        self._generation += 1
+        self._candidates[self._generation] = signer
+        op = KeyExchangeOp(replica_id=self._replica.id,
+                           pubkey=signer.public_bytes(),
+                           generation=self._generation)
+        self._replica.internal_client.submit(
+            pack_op(op), flags=int(m.RequestFlag.KEY_EXCHANGE))
+        return self._generation
+
+    def on_executed(self, op: KeyExchangeOp) -> None:
+        """Ordered on every replica: swap the principal's public key; the
+        owner additionally activates its private candidate."""
+        self._replica.sig.set_replica_key(op.replica_id, op.pubkey)
+        self._pages.save(op.pubkey, index=op.replica_id)
+        if op.replica_id == self._replica.id:
+            cand = self._candidates.pop(op.generation, None)
+            if cand is not None and cand.public_bytes() == op.pubkey:
+                self._replica.sig.set_my_signer(cand)
+
+    def load_from_pages(self) -> None:
+        """Startup / post-state-transfer: adopt previously exchanged keys."""
+        for r in self._replica.info.replica_ids:
+            pk = self._pages.load(index=r)
+            if pk:
+                self._replica.sig.set_replica_key(r, pk)
+
+
+# ---------------- time service ----------------
+
+class TimeServiceManager:
+    """Consensus-agreed monotonic clock (reference TimeServiceManager +
+    TimeServiceResPageClient): the primary stamps each PrePrepare; backups
+    bound it against their clock; execution advances the agreed time."""
+
+    CATEGORY = "time"
+
+    def __init__(self, pages: ReservedPagesClient,
+                 max_skew_ms: int = 1000,
+                 clock: Callable[[], float] = time.time) -> None:
+        self._pages = pages
+        self._clock = clock
+        self.max_skew_ms = max_skew_ms
+        raw = pages.load()
+        self.last_agreed_ms = int.from_bytes(raw, "big") if raw else 0
+
+    def primary_stamp(self) -> int:
+        return max(int(self._clock() * 1000), self.last_agreed_ms + 1)
+
+    def validate(self, t_ms: int) -> bool:
+        if t_ms <= self.last_agreed_ms:
+            return False
+        return t_ms <= int(self._clock() * 1000) + self.max_skew_ms
+
+    def on_executed(self, t_ms: int) -> None:
+        if t_ms > self.last_agreed_ms:
+            self.last_agreed_ms = t_ms
+            self._pages.save(t_ms.to_bytes(8, "big"))
+
+    def reload(self) -> None:
+        raw = self._pages.load()
+        if raw:
+            self.last_agreed_ms = max(self.last_agreed_ms,
+                                      int.from_bytes(raw, "big"))

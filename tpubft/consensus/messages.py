@@ -319,10 +319,13 @@ class CheckpointMsg(ConsensusMsg):
     seq_num: int
     state_digest: bytes
     is_stable: bool
-    signature: bytes
+    # reserved-pages digest is part of the signed certificate (reference
+    # CheckpointMsg carries stateDigest + reservedPagesDigest + rvbDigest)
+    res_pages_digest: bytes = b""
+    signature: bytes = b""
     SPEC = [("sender_id", "u32"), ("seq_num", "u64"),
             ("state_digest", "bytes"), ("is_stable", "bool"),
-            ("signature", "bytes")]
+            ("res_pages_digest", "bytes"), ("signature", "bytes")]
 
 
 @register

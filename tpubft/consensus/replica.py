@@ -83,7 +83,8 @@ class Replica(IReceiver):
     def __init__(self, cfg: ReplicaConfig, keys: ClusterKeys,
                  comm: ICommunication, handler: IRequestsHandler,
                  storage: Optional[PersistentStorage] = None,
-                 aggregator: Optional[Aggregator] = None):
+                 aggregator: Optional[Aggregator] = None,
+                 reserved_pages=None):
         cfg.validate()
         self.cfg = cfg
         self.id = cfg.replica_id
@@ -94,7 +95,10 @@ class Replica(IReceiver):
         self.storage = storage or InMemoryPersistentStorage()
         self.aggregator = aggregator or Aggregator()
 
-        self.sig = SigManager(keys, self.aggregator)
+        self.sig = SigManager(
+            keys, self.aggregator,
+            alias_fn=lambda p: (self.info.owner_of_internal_client(p)
+                                if self.info.is_internal_client(p) else p))
         # threshold machinery per commit path (CryptoManager.hpp:109-111):
         # slow = 2f+c+1, fast-with-threshold = 3f+c+1, optimistic = n
         self.slow_signer = keys.threshold_signer(keys.slow_path_system,
@@ -118,9 +122,7 @@ class Replica(IReceiver):
         self.window: ActiveWindow[SeqNumInfo] = ActiveWindow(
             cfg.work_window_size, SeqNumInfo)
         self.window.advance(st.last_stable_seq)
-        self.clients = ClientsManager(
-            range(self.info.first_client_id,
-                  self.info.first_client_id + self.info.num_clients))
+        self.clients = ClientsManager(self.info.all_client_ids())
         self.pending_requests: List[m.ClientRequestMsg] = []
         self.checkpoints: Dict[int, Dict[int, m.CheckpointMsg]] = {}
         # quorum-certified checkpoints ahead of us: seq -> state digest
@@ -179,8 +181,58 @@ class Replica(IReceiver):
         # reference: ReplicaForStateTransfer owning an IStateTransfer)
         self.state_transfer = None
 
+        # reserved pages + the subsystems riding them (internal client,
+        # key exchange, time service, cron)
+        from tpubft.ccron import CronTable, TicksGenerator
+        from tpubft.consensus.internal import (InternalBFTClient,
+                                               KeyExchangeManager,
+                                               TimeServiceManager)
+        from tpubft.consensus.reserved_pages import (ReservedPages,
+                                                     ReservedPagesClient)
+        if reserved_pages is None:
+            from tpubft.storage.memorydb import MemoryDB
+            reserved_pages = ReservedPages(MemoryDB())
+        self.res_pages = reserved_pages
+        self.internal_client = InternalBFTClient(self)
+        self.key_exchange = KeyExchangeManager(
+            self, ReservedPagesClient(self.res_pages,
+                                      KeyExchangeManager.CATEGORY))
+        self.time_service = TimeServiceManager(
+            ReservedPagesClient(self.res_pages, TimeServiceManager.CATEGORY),
+            max_skew_ms=cfg.time_max_skew_ms)
+        self.cron_table = CronTable(
+            ReservedPagesClient(self.res_pages, CronTable.CATEGORY))
+        self.ticks_generator = TicksGenerator(self, self.cron_table)
+        self.dispatcher.add_timer(0.25, self.ticks_generator.poll)
+        self.key_exchange.load_from_pages()
+        self._load_client_replies_from_pages()
+
         self._restore_window(window_msgs)
         self._running = False
+
+    def _load_client_replies_from_pages(self) -> None:
+        """Seed the at-most-once table + reply cache from reserved pages
+        (reference: ClientsManager loadInfoFromReservedPages)."""
+        from tpubft.consensus.reserved_pages import ReservedPagesClient
+        pages = ReservedPagesClient(self.res_pages, "clients")
+        for c in self.info.all_client_ids():
+            raw = pages.load(index=c)
+            if not raw:
+                continue
+            if raw[:1] == b"\x01":
+                # oversize-reply marker: at-most-once state only
+                self.clients.note_executed(c, int.from_bytes(raw[1:9],
+                                                             "big"))
+                continue
+            try:
+                reply = m.unpack(raw[1:])
+            except m.MsgError:
+                continue
+            if isinstance(reply, m.ClientReplyMsg):
+                # re-personalize the canonical page form
+                reply.sender_id = self.id
+                reply.current_primary = self.primary
+                self.clients.on_request_executed(c, reply.req_seq_num, reply)
 
     # ------------------------------------------------------------------
     # state transfer wiring (ReplicaForStateTransfer equivalent)
@@ -229,6 +281,10 @@ class Replica(IReceiver):
         with self._tran() as st:
             st.last_executed_seq = seq
         self._on_seq_stable(seq, state_digest)
+        # reserved pages were just installed: adopt everything riding them
+        self.key_exchange.load_from_pages()
+        self.time_service.reload()
+        self._load_client_replies_from_pages()
         self._last_progress = time.monotonic()
 
     # ------------------------------------------------------------------
@@ -251,6 +307,9 @@ class Replica(IReceiver):
         self.dispatcher.register_internal("repropose",
                                           lambda _: self._repropose())
         self.dispatcher.start()
+        if self.cfg.key_exchange_on_start:
+            # sendInitialKey (BFTEngine start path, ReplicaImp.cpp:4622)
+            self.key_exchange.initiate()
 
     def stop(self) -> None:
         self._running = False
@@ -337,6 +396,11 @@ class Replica(IReceiver):
         client = req.sender_id
         if not self.clients.is_valid_client(client):
             return
+        # INTERNAL flag and internal-client principals must correspond —
+        # external clients can't smuggle internal ops and vice versa
+        if bool(req.flags & m.RequestFlag.INTERNAL) \
+                != self.info.is_internal_client(client):
+            return
         if not self.sig.verify(client, req.signed_payload(), req.signature):
             return
         if req.flags & m.RequestFlag.READ_ONLY:
@@ -387,7 +451,9 @@ class Replica(IReceiver):
         pp = m.PrePrepareMsg(
             sender_id=self.id, view=self.view, seq_num=seq,
             first_path=int(self.controller.current_path),
-            time=int(time.time() * 1e6),
+            time=(self.time_service.primary_stamp()
+                  if self.cfg.time_service_enabled
+                  else int(time.time() * 1e6)),
             requests_digest=m.PrePrepareMsg.compute_requests_digest(raw_reqs),
             requests=raw_reqs, signature=b"")
         pp.signature = self.sig.sign(pp.signed_payload())
@@ -424,11 +490,23 @@ class Replica(IReceiver):
         for r in reqs:
             if not self.clients.is_valid_client(r.sender_id):
                 return
+            # a byzantine primary must not smuggle INTERNAL-flagged ops
+            # from external principals (or strip the flag from real ones)
+            if bool(r.flags & m.RequestFlag.INTERNAL) \
+                    != self.info.is_internal_client(r.sender_id):
+                return
         # view-change safety: a seqnum certified as possibly-committed in
         # an earlier view may ONLY be re-proposed with the same batch
         # (ViewChangeSafetyLogic restrictions)
         restr = self.restrictions.get(pp.seq_num)
         if restr is not None and pp.requests_digest != restr.requests_digest:
+            return
+        # time service: bound the primary's stamp (reference
+        # TimeServiceManager::hasTimeRequest). Gap-fill PrePrepares
+        # (empty, time=0) and restricted re-proposals (old stamp, content
+        # already certified) are exempt or view change could never finish.
+        if (self.cfg.time_service_enabled and reqs and restr is None
+                and not self.time_service.validate(pp.time)):
             return
         self._accept_pre_prepare(pp)
 
@@ -713,10 +791,16 @@ class Replica(IReceiver):
                     if cached is not None:
                         self.comm.send(req.sender_id, cached.pack())
                     continue
-                reply = self.handler.execute(req.sender_id, req.req_seq_num,
-                                             req.flags, req.request)
+                if req.flags & m.RequestFlag.INTERNAL:
+                    reply = self._execute_internal_request(req)
+                else:
+                    reply = self.handler.execute(req.sender_id,
+                                                 req.req_seq_num,
+                                                 req.flags, req.request)
                 self.m_executed.inc()
                 self._send_reply(req.sender_id, req.req_seq_num, reply)
+            if self.cfg.time_service_enabled and info.pre_prepare.time:
+                self.time_service.on_executed(info.pre_prepare.time)
             info.executed = True
             self.last_executed = nxt
             self.m_last_executed.set(nxt)
@@ -726,21 +810,62 @@ class Replica(IReceiver):
             if nxt % self.cfg.checkpoint_window_size == 0:
                 self._send_checkpoint(nxt)
 
+    def _execute_internal_request(self, req: m.ClientRequestMsg) -> bytes:
+        """Ordered consensus-internal operation (key exchange, cron tick)
+        — executed identically on every replica."""
+        from tpubft.consensus import internal as iops
+        try:
+            op = iops.unpack_op(req.request)
+        except Exception:
+            return b""
+        if isinstance(op, iops.KeyExchangeOp):
+            # only the replica owning the internal client may rotate its key
+            if self.info.internal_client_of(op.replica_id) == req.sender_id:
+                self.key_exchange.on_executed(op)
+                return b"ok"
+            return b""
+        if isinstance(op, iops.TickOp):
+            self.cron_table.on_tick(op)
+            return b"ok"
+        return b""
+
     def _send_reply(self, client: int, req_seq: int, payload: bytes) -> None:
         reply = m.ClientReplyMsg(sender_id=self.id, req_seq_num=req_seq,
                                  current_primary=self.primary, reply=payload,
                                  replica_specific_info=b"")
         self.clients.on_request_executed(client, req_seq, reply)
         self._forwarded.pop((client, req_seq), None)
-        self.comm.send(client, reply.pack())
+        # at-most-once state rides reserved pages so it survives crashes
+        # AND state transfer (reference keeps client replies in res pages).
+        # Persist a CANONICAL form — per-replica fields (sender, primary)
+        # zeroed — or the pages digest would differ across replicas and no
+        # checkpoint certificate could ever form.
+        canonical = b"\x00" + m.ClientReplyMsg(
+            sender_id=0, req_seq_num=req_seq, current_primary=0,
+            reply=payload, replica_specific_info=b"").pack()
+        from tpubft.consensus.reserved_pages import PAGE_SIZE
+        if len(canonical) > PAGE_SIZE:
+            # reply too big for its page: keep the at-most-once marker so a
+            # crash/ST never re-executes, even though the cached reply is
+            # lost (the client re-reads; reference paginates large replies)
+            canonical = b"\x01" + req_seq.to_bytes(8, "big")
+        self.res_pages.save("clients", client, canonical)
+        if not self.info.is_internal_client(client):
+            self.comm.send(client, reply.pack())
 
     # ------------------------------------------------------------------
     # checkpointing (ReplicaImp.cpp:2280,3274,3439)
     # ------------------------------------------------------------------
     def _send_checkpoint(self, seq: int) -> None:
+        state_digest = self.handler.state_digest()
+        if self.state_transfer is not None:
+            # snapshot NOW — this is the state the certificate will bind
+            self.state_transfer.on_checkpoint_created(seq, state_digest)
         ck = m.CheckpointMsg(sender_id=self.id, seq_num=seq,
-                             state_digest=self.handler.state_digest(),
-                             is_stable=False, signature=b"")
+                             state_digest=state_digest,
+                             is_stable=False,
+                             res_pages_digest=self.res_pages.digest(),
+                             signature=b"")
         ck.signature = self.sig.sign(ck.signed_payload())
         self._broadcast(ck)
         self._store_checkpoint(ck)
@@ -759,18 +884,20 @@ class Replica(IReceiver):
         slot = self.checkpoints.setdefault(ck.seq_num, {})
         slot[ck.sender_id] = ck
         matching = sum(1 for other in slot.values()
-                       if other.state_digest == ck.state_digest)
+                       if other.state_digest == ck.state_digest
+                       and other.res_pages_digest == ck.res_pages_digest)
         if matching < self.info.checkpoint_quorum:
             return
         if ck.seq_num <= self.last_executed:
             self._on_seq_stable(ck.seq_num, ck.state_digest)
             return
         # a certified checkpoint we haven't reached: remember the signed
-        # (seq, digest) — it is the ONLY trust anchor state transfer may
+        # digests — they are the ONLY trust anchor state transfer may
         # fetch toward (ST sub-messages are unauthenticated, like the
         # reference's; safety comes from the digest chain ending at a
         # certificate-backed digest)
-        self.certified_checkpoints[ck.seq_num] = ck.state_digest
+        self.certified_checkpoints[ck.seq_num] = (ck.state_digest,
+                                                  ck.res_pages_digest)
         if len(self.certified_checkpoints) > 8:
             del self.certified_checkpoints[min(self.certified_checkpoints)]
         if (self.state_transfer is not None

@@ -40,6 +40,27 @@ class ReplicasInfo:
     def is_client(self, node: int) -> bool:
         return node >= self.first_client_id
 
+    # ---- internal clients (reference InternalBFTClient principals) ----
+    @property
+    def first_internal_client_id(self) -> int:
+        return self.first_client_id + self.num_clients
+
+    def internal_client_of(self, replica_id: int) -> int:
+        return self.first_internal_client_id + replica_id
+
+    def is_internal_client(self, node: int) -> bool:
+        return (self.first_internal_client_id <= node
+                < self.first_internal_client_id + self.n)
+
+    def owner_of_internal_client(self, node: int) -> int:
+        return node - self.first_internal_client_id
+
+    def all_client_ids(self) -> list:
+        """External client principals + one internal client per replica."""
+        return (list(range(self.first_client_id,
+                           self.first_client_id + self.num_clients))
+                + [self.internal_client_of(r) for r in self.replica_ids])
+
     def other_replicas(self, me: int) -> list:
         return [r for r in self.replica_ids if r != me]
 

@@ -1,0 +1,83 @@
+"""Reserved pages — small mutable consensus-replicated page store.
+
+Rebuild of the reference's IReservedPages / ReservedPagesClient
+(/root/reference/bftengine/include/bftengine/IReservedPages.hpp,
+ReservedPagesClient.hpp): a fixed-size page store that travels with state
+transfer alongside the ledger, used by the clients reply cache, key
+exchange, time service, cron, and reconfiguration. Pages are namespaced
+per subsystem (the reference statically carves page-id ranges per
+registered client type; we key by (category, index) which gives the same
+isolation without a global allocation table).
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from tpubft.storage.interfaces import IDBClient, WriteBatch
+
+PAGE_SIZE = 4096
+_FAMILY = b"respages"
+
+
+class ReservedPages:
+    def __init__(self, db: IDBClient) -> None:
+        self._db = db
+
+    @staticmethod
+    def _key(category: str, index: int) -> bytes:
+        cb = category.encode()
+        return len(cb).to_bytes(2, "big") + cb + index.to_bytes(4, "big")
+
+    def load(self, category: str, index: int = 0) -> Optional[bytes]:
+        return self._db.get(self._key(category, index), _FAMILY)
+
+    def save(self, category: str, index: int, data: bytes) -> None:
+        if len(data) > PAGE_SIZE:
+            raise ValueError(f"page exceeds {PAGE_SIZE} bytes")
+        self._db.put(self._key(category, index), data, _FAMILY)
+
+    def delete(self, category: str, index: int) -> None:
+        self._db.delete(self._key(category, index), _FAMILY)
+
+    def all_pages(self) -> List[Tuple[bytes, bytes]]:
+        return list(self._db.range_iter(_FAMILY))
+
+    @staticmethod
+    def digest_of(pages: List[Tuple[bytes, bytes]]) -> bytes:
+        h = hashlib.sha256()
+        for k, v in sorted(pages):
+            h.update(len(k).to_bytes(4, "big") + k)
+            h.update(len(v).to_bytes(4, "big") + v)
+        return h.digest()
+
+    def digest(self) -> bytes:
+        """Digest over all pages — part of the checkpoint certificate
+        (reference: digestOfResPagesDescriptor)."""
+        return self.digest_of(list(self._db.range_iter(_FAMILY)))
+
+    def replace_all(self, pages: List[Tuple[bytes, bytes]]) -> None:
+        """State transfer install: swap the whole page set atomically."""
+        wb = WriteBatch()
+        for k, _ in self._db.range_iter(_FAMILY):
+            wb.delete(k, _FAMILY)
+        for k, v in pages:
+            wb.put(k, v, _FAMILY)
+        self._db.write(wb)
+
+
+class ReservedPagesClient:
+    """Subsystem-scoped view (reference ReservedPagesClient<T>)."""
+
+    def __init__(self, pages: ReservedPages, category: str) -> None:
+        self._pages = pages
+        self._category = category
+
+    def load(self, index: int = 0) -> Optional[bytes]:
+        return self._pages.load(self._category, index)
+
+    def save(self, data: bytes, index: int = 0) -> None:
+        self._pages.save(self._category, index, data)
+
+    def delete(self, index: int = 0) -> None:
+        self._pages.delete(self._category, index)

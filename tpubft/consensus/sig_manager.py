@@ -26,11 +26,22 @@ from tpubft.utils.metrics import Aggregator, Component
 class SigManager:
     def __init__(self, keys: ClusterKeys,
                  aggregator: Optional[Aggregator] = None,
-                 verifier_factory: Optional[Callable[[bytes], IVerifier]] = None):
+                 verifier_factory: Optional[Callable[[bytes], IVerifier]] = None,
+                 alias_fn: Optional[Callable[[int], int]] = None):
         self._keys = keys
+        # own copies: key exchange rotates keys per-replica-process, and the
+        # shared ClusterKeys dicts must not leak one node's view to others
+        self._replica_pubkeys: Dict[int, bytes] = dict(keys.replica_pubkeys)
+        self._client_pubkeys: Dict[int, bytes] = dict(keys.client_pubkeys)
+        # rotation grace keys: principal -> (old pubkey, rotated_at)
+        self._prev_pubkeys: Dict[int, Tuple[bytes, float]] = {}
         self._signer = keys.my_signer() if keys.my_sign_seed else None
         self._verifiers: Dict[int, IVerifier] = {}
+        self._prev_verifiers: Dict[int, IVerifier] = {}
         self._verifier_factory = verifier_factory
+        # maps alias principals (e.g. internal-client ids) onto the
+        # replica principal whose key signs for them
+        self._alias = alias_fn or (lambda p: p)
         self.metrics = Component("signature_manager", aggregator)
         self.sigs_verified = self.metrics.register_counter("sigs_verified")
         self.sig_failures = self.metrics.register_counter("sig_failures")
@@ -46,30 +57,76 @@ class SigManager:
     def my_id(self) -> Optional[int]:
         return self._keys.my_id
 
+    # ---- key rotation (KeyExchangeManager upcalls) ----
+    # how long a superseded key keeps verifying after rotation (covers
+    # in-flight messages; the reference scopes key lookup by seqnum)
+    GRACE_WINDOW_S = 30.0
+
+    def set_replica_key(self, replica_id: int, new_pubkey: bytes) -> None:
+        """Swap a replica's public key, keeping the previous one for a
+        bounded rotation grace window."""
+        old = self._replica_pubkeys.get(replica_id)
+        if old == new_pubkey:
+            return
+        if old is not None:
+            self._prev_pubkeys[replica_id] = (old, time.monotonic())
+            self._prev_verifiers.pop(replica_id, None)
+        self._replica_pubkeys[replica_id] = new_pubkey
+        self._verifiers.pop(replica_id, None)
+
+    def set_my_signer(self, signer) -> None:
+        self._signer = signer
+
     # ---- verification ----
+    def _make_verifier(self, pk: bytes) -> IVerifier:
+        if self._verifier_factory is not None:
+            return self._verifier_factory(pk)
+        from tpubft.crypto.cpu import Ed25519Verifier
+        return Ed25519Verifier(pk)
+
+    def _pubkey_of(self, principal: int) -> Optional[bytes]:
+        return (self._replica_pubkeys.get(principal)
+                or self._client_pubkeys.get(principal))
+
     def _verifier(self, principal: int) -> IVerifier:
+        principal = self._alias(principal)
         v = self._verifiers.get(principal)
         if v is None:
-            if self._verifier_factory is not None:
-                pk = (self._keys.replica_pubkeys.get(principal)
-                      or self._keys.client_pubkeys.get(principal))
-                if pk is None:
-                    raise KeyError(f"no public key for principal {principal}")
-                v = self._verifier_factory(pk)
-            else:
-                v = self._keys.verifier_of(principal)
-            self._verifiers[principal] = v
+            pk = self._pubkey_of(principal)
+            if pk is None:
+                raise KeyError(f"no public key for principal {principal}")
+            v = self._verifiers[principal] = self._make_verifier(pk)
+        return v
+
+    def _grace_verifier(self, principal: int) -> Optional[IVerifier]:
+        principal = self._alias(principal)
+        entry = self._prev_pubkeys.get(principal)
+        if entry is None:
+            return None
+        pk, rotated_at = entry
+        if time.monotonic() - rotated_at > self.GRACE_WINDOW_S:
+            # the leaked/old key must stop verifying — that's the point
+            # of rotating
+            del self._prev_pubkeys[principal]
+            self._prev_verifiers.pop(principal, None)
+            return None
+        v = self._prev_verifiers.get(principal)
+        if v is None:
+            v = self._prev_verifiers[principal] = self._make_verifier(pk)
         return v
 
     def has_principal(self, principal: int) -> bool:
-        return (principal in self._keys.replica_pubkeys
-                or principal in self._keys.client_pubkeys)
+        return self._pubkey_of(self._alias(principal)) is not None
 
     def verify(self, principal: int, data: bytes, sig: bytes) -> bool:
         try:
             ok = self._verifier(principal).verify(data, sig)
         except KeyError:
             ok = False
+        if not ok:
+            grace = self._grace_verifier(principal)
+            if grace is not None:
+                ok = grace.verify(data, sig)
         (self.sigs_verified if ok else self.sig_failures).inc()
         return ok
 
@@ -88,7 +145,10 @@ class SigManager:
                 continue
             results = verifier.verify_batch(
                 [(items[i][1], items[i][2]) for i in idxs])
+            grace = self._grace_verifier(p)
             for i, ok in zip(idxs, results):
+                if not ok and grace is not None:
+                    ok = grace.verify(items[i][1], items[i][2])
                 out[i] = ok
         for ok in out:
             (self.sigs_verified if ok else self.sig_failures).inc()

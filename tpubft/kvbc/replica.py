@@ -48,12 +48,16 @@ class KvbcReplica:
             from tpubft.apps.skvbc import SkvbcHandler
             handler_factory = SkvbcHandler
         self.handler: IRequestsHandler = handler_factory(self.blockchain)
+        from tpubft.consensus.reserved_pages import ReservedPages
+        pages = ReservedPages(self.db)
         self.replica = Replica(cfg, keys, comm, self.handler,
                                storage=DBPersistentStorage(self.db),
-                               aggregator=aggregator)
+                               aggregator=aggregator,
+                               reserved_pages=pages)
         from tpubft.statetransfer import StateTransferManager
         self.state_transfer = StateTransferManager(cfg.replica_id,
-                                                   self.blockchain)
+                                                   self.blockchain,
+                                                   reserved_pages=pages)
         self.replica.set_state_transfer(self.state_transfer)
 
     def start(self) -> None:

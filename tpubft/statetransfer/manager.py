@@ -35,6 +35,7 @@ _K_STABLE = b"stable"
 _IDLE = "idle"
 _SUMMARIES = "summaries"
 _FETCHING = "fetching"
+_RESPAGES = "respages"
 
 
 @dataclass
@@ -68,13 +69,15 @@ class SourceSelector:
 
 class StateTransferManager:
     def __init__(self, replica_id: int, blockchain: KeyValueBlockchain,
-                 cfg: Optional[StConfig] = None) -> None:
+                 cfg: Optional[StConfig] = None,
+                 reserved_pages=None) -> None:
         self.id = replica_id
         self.bc = blockchain
         self.cfg = cfg or StConfig()
         self._db = blockchain._db
         self.rvt = RangeValidationTree(self._db)
         self.sources = SourceSelector()
+        self.pages = reserved_pages  # ReservedPages (set via bind/replica)
 
         # wiring (bind() before start)
         self._send: Callable[[int, bytes], None] = lambda d, p: None
@@ -85,10 +88,14 @@ class StateTransferManager:
         # source-side stable checkpoint info, persisted across restarts
         raw = self._db.get(_K_STABLE, _META_FAMILY)
         self._stable: Optional[Tuple[int, bytes, int]] = None
+        self._serving_pages: list = []
         if raw:
             seq = int.from_bytes(raw[:8], "big")
             last_block = int.from_bytes(raw[8:16], "big")
             self._stable = (seq, raw[16:48], last_block)
+            snap = self._load_snapshot(seq)
+            if snap is not None and snap[1] == self._stable[1]:
+                self._serving_pages = snap[2]
 
         # destination-side state
         self.state = _IDLE
@@ -100,6 +107,8 @@ class StateTransferManager:
         self._chunks: Dict[int, Dict[int, bytes]] = {}  # block -> idx -> part
         self._chunk_totals: Dict[int, int] = {}
         self._proofs: Dict[int, RvtProof] = {}
+        self._page_chunks: Dict[int, list] = {}
+        self._page_total = 0
         self._last_activity = 0.0
         self._fetch_from = 0
 
@@ -121,17 +130,64 @@ class StateTransferManager:
     # ------------------------------------------------------------------
     # consensus upcalls (dispatcher thread)
     # ------------------------------------------------------------------
+    def on_checkpoint_created(self, seq: int, state_digest: bytes) -> None:
+        """Called at the moment the replica sends its CheckpointMsg for
+        `seq` — i.e. right after executing seq, when live state EQUALS the
+        digests being certified. Snapshot what a certificate would bind:
+        last_block and the reserved pages. The cluster keeps executing
+        while the certificate forms, so serving live state instead would
+        livelock every destination (digests never match the certificate)."""
+        pages = self.pages.all_pages() if self.pages is not None else []
+        buf = bytearray()
+        buf += self.bc.last_block_id.to_bytes(8, "big")
+        buf += state_digest
+        ser.write_uvarint(buf, len(pages))
+        for k, v in pages:
+            ser.write_bytes(buf, k)
+            ser.write_bytes(buf, v)
+        self._db.put(b"snap" + seq.to_bytes(8, "big"), bytes(buf),
+                     _META_FAMILY)
+        # GC old snapshots (keep the last few in-flight checkpoints)
+        for k, _ in list(self._db.range_iter(_META_FAMILY, start=b"snap")):
+            if k.startswith(b"snap") and len(k) == 12 \
+                    and int.from_bytes(k[4:], "big") + 4 < seq:
+                self._db.delete(k, _META_FAMILY)
+
+    def _load_snapshot(self, seq: int):
+        raw = self._db.get(b"snap" + seq.to_bytes(8, "big"), _META_FAMILY)
+        if raw is None:
+            return None
+        mv = memoryview(raw)
+        last_block = int.from_bytes(mv[:8], "big")
+        state_digest = bytes(mv[8:40])
+        n, off = ser.read_uvarint(mv, 40)
+        pages = []
+        for _ in range(n):
+            k, off = ser.read_bytes(mv, off)
+            v, off = ser.read_bytes(mv, off)
+            pages.append((k, v))
+        return last_block, state_digest, pages
+
     def on_checkpoint_stable(self, seq: int, state_digest: bytes) -> None:
-        """Record the latest stable checkpoint we can serve
+        """A certificate formed for checkpoint `seq`: promote the snapshot
+        taken at creation time to the serving point
         (RVBManager::setNewSourceCheckpoint duty) and grow the RVT."""
+        snap = self._load_snapshot(seq)
+        if snap is None or snap[1] != state_digest:
+            # no matching snapshot (e.g. we just state-transferred in):
+            # live state IS the certified state right now
+            snap = (self.bc.last_block_id, state_digest,
+                    self.pages.all_pages() if self.pages is not None else [])
+        last_block, _, pages = snap
         try:
             self.rvt.sync_to(self.bc)
         except BlockchainError:
             return  # digest gap (shouldn't happen); keep old serving point
-        self._stable = (seq, state_digest, self.bc.last_block_id)
+        self._stable = (seq, state_digest, last_block)
+        self._serving_pages = pages
         self._db.put(
             _K_STABLE,
-            seq.to_bytes(8, "big") + self.bc.last_block_id.to_bytes(8, "big")
+            seq.to_bytes(8, "big") + last_block.to_bytes(8, "big")
             + state_digest, _META_FAMILY)
 
     def start_collecting(self, min_checkpoint_seq: int,
@@ -166,6 +222,9 @@ class StateTransferManager:
             # stalled source: rotate and re-request the current batch
             self.sources.rotate()
             self._request_next_batch()
+        elif self.state == _RESPAGES:
+            self.sources.rotate()
+            self._request_res_pages()
 
     # ------------------------------------------------------------------
     # message dispatch
@@ -185,6 +244,10 @@ class StateTransferManager:
             self._on_item_data(sender, msg)
         elif isinstance(msg, stm.RejectFetching):
             self._on_reject(sender, msg)
+        elif isinstance(msg, stm.FetchResPages):
+            self._on_fetch_res_pages(sender, msg)
+        elif isinstance(msg, stm.ResPagesData):
+            self._on_res_pages_data(sender, msg)
 
     # ------------------------------------------------------------------
     # source side
@@ -200,9 +263,29 @@ class StateTransferManager:
             root = self.rvt.root(last_block)
         except ValueError:
             return
+        from tpubft.consensus.reserved_pages import ReservedPages
         self._send(sender, stm.pack(stm.CheckpointSummary(
             reply_to=msg.msg_id, checkpoint_seq=seq, state_digest=digest,
-            last_block=last_block, rvt_root=root)))
+            last_block=last_block, rvt_root=root,
+            res_pages_digest=(ReservedPages.digest_of(self._serving_pages)
+                              if self.pages is not None else b""))))
+
+    def _on_fetch_res_pages(self, sender: int,
+                            msg: stm.FetchResPages) -> None:
+        all_pages = self._serving_pages
+        groups: List[list] = [[]]
+        size = 0
+        for k, v in all_pages:
+            if size + len(k) + len(v) > self.cfg.max_chunk_bytes \
+                    and groups[-1]:
+                groups.append([])
+                size = 0
+            groups[-1].append((k, v))
+            size += len(k) + len(v)
+        for ci, group in enumerate(groups):
+            self._send(sender, stm.pack(stm.ResPagesData(
+                reply_to=msg.msg_id, chunk_idx=ci,
+                total_chunks=len(groups), pages=group)))
 
     def _on_fetch_blocks(self, sender: int, msg: stm.FetchBlocks) -> None:
         if (self._stable is None or msg.from_block > msg.to_block
@@ -254,7 +337,8 @@ class StateTransferManager:
         if sender not in self._replica_ids:
             return
         # only certificate-anchored targets are acceptable
-        if self._certified.get(msg.checkpoint_seq) != msg.state_digest:
+        if self._certified.get(msg.checkpoint_seq) \
+                != (msg.state_digest, msg.res_pages_digest):
             return
         self._summaries[sender] = msg
         groups: Dict[tuple, List[int]] = {}
@@ -366,9 +450,62 @@ class StateTransferManager:
             self._agreed = None
             self._ask_summaries()
             return
+        # reserved pages next (reference: FetchResPagesMsg after blocks)
+        if self.pages is not None \
+                and self.pages.digest() != agreed.res_pages_digest:
+            self.state = _RESPAGES
+            self._request_res_pages()
+            return
+        self._complete_transfer()
+
+    def _request_res_pages(self) -> None:
+        self._last_activity = time.monotonic()
+        src = self.sources.current()
+        if src is None:
+            self.state = _SUMMARIES
+            self._summaries.clear()
+            self._agreed = None
+            self._ask_summaries()
+            return
+        self._msg_id += 1
+        self._page_chunks.clear()
+        self._send(src, stm.pack(stm.FetchResPages(msg_id=self._msg_id)))
+
+    def _on_res_pages_data(self, sender: int, msg: stm.ResPagesData) -> None:
+        if (self.state != _RESPAGES or self._agreed is None
+                or sender != self.sources.current()
+                or msg.reply_to != self._msg_id
+                or not 0 <= msg.chunk_idx < msg.total_chunks):
+            return
+        # a source switching total_chunks mid-response is malformed
+        if self._page_chunks and msg.total_chunks != self._page_total:
+            self._page_chunks.clear()
+            self.sources.rotate()
+            self._request_res_pages()
+            return
+        self._page_total = msg.total_chunks
+        self._last_activity = time.monotonic()
+        self._page_chunks[msg.chunk_idx] = msg.pages
+        if any(ci not in self._page_chunks
+               for ci in range(msg.total_chunks)):
+            return
+        pages = [kv for ci in range(msg.total_chunks)
+                 for kv in self._page_chunks[ci]]
+        from tpubft.consensus.reserved_pages import ReservedPages
+        if ReservedPages.digest_of(pages) != self._agreed.res_pages_digest:
+            self._page_chunks.clear()
+            self.sources.rotate()
+            self._request_res_pages()
+            return
+        self.pages.replace_all(pages)
+        self._complete_transfer()
+
+    def _complete_transfer(self) -> None:
+        agreed = self._agreed
         self.state = _IDLE
         self._agreed = None
         self._summaries.clear()
+        self._page_chunks.clear()
         self._certified = {s: d for s, d in self._certified.items()
                            if s > agreed.checkpoint_seq}
         # we are now a valid source for this checkpoint

@@ -27,13 +27,14 @@ class CheckpointSummary:
     state_digest: bytes = b""
     last_block: int = 0
     rvt_root: bytes = b""
+    res_pages_digest: bytes = b""
     SPEC = [("reply_to", "u64"), ("checkpoint_seq", "u64"),
             ("state_digest", "bytes"), ("last_block", "u64"),
-            ("rvt_root", "bytes")]
+            ("rvt_root", "bytes"), ("res_pages_digest", "bytes")]
 
     def key(self):
         return (self.checkpoint_seq, self.state_digest, self.last_block,
-                self.rvt_root)
+                self.rvt_root, self.res_pages_digest)
 
 
 @dataclass
@@ -69,9 +70,30 @@ class RejectFetching:
     SPEC = [("reply_to", "u64"), ("reason", "str")]
 
 
+@dataclass
+class FetchResPages:
+    """Reserved-pages fetch, after blocks are linked (reference
+    FetchResPagesMsg)."""
+    ID = 6
+    msg_id: int = 0
+    SPEC = [("msg_id", "u64")]
+
+
+@dataclass
+class ResPagesData:
+    ID = 7
+    reply_to: int = 0
+    chunk_idx: int = 0
+    total_chunks: int = 1
+    pages: List = field(default_factory=list)  # [(page_key, page_bytes)]
+    SPEC = [("reply_to", "u64"), ("chunk_idx", "u32"),
+            ("total_chunks", "u32"),
+            ("pages", ("list", ("pair", "bytes", "bytes")))]
+
+
 _TYPES = {cls.ID: cls for cls in
           (AskForCheckpointSummaries, CheckpointSummary, FetchBlocks,
-           ItemData, RejectFetching)}
+           ItemData, RejectFetching, FetchResPages, ResPagesData)}
 
 
 def pack(msg) -> bytes:

@@ -63,7 +63,8 @@ class InProcessCluster:
             from tpubft.statetransfer import StateTransferManager
             from tpubft.statetransfer.manager import StConfig
             rep.set_state_transfer(StateTransferManager(
-                r, bc, StConfig(retry_timeout_s=0.3)))
+                r, bc, StConfig(retry_timeout_s=0.3),
+                reserved_pages=rep.res_pages))
         self.replicas[r] = rep
         return rep
 

@@ -48,6 +48,8 @@ class ReplicaConfig:
     view_change_protocol_enabled: bool = True
     pre_execution_enabled: bool = False
     time_service_enabled: bool = False
+    time_max_skew_ms: int = 1000
+    key_exchange_on_start: bool = False
 
     # crypto
     crypto_backend: str = "cpu"         # "cpu" | "tpu"
