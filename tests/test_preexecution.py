@@ -1,0 +1,123 @@
+"""Pre-execution tests: envelope validation, f+1 agreement, end-to-end
+pre-processed writes with conflict detection, fallback for unsupported
+handlers (reference model: preprocessor_test.cpp +
+apollo test_skvbc_preexecution.py)."""
+import time
+
+import pytest
+
+from tpubft.apps import counter, skvbc
+from tpubft.consensus import messages as m
+from tpubft.kvbc import KeyValueBlockchain
+from tpubft.preprocessor.preprocessor import (unpack_preprocessed,
+                                              validate_preprocessed_request)
+from tpubft.storage import MemoryDB
+from tpubft.testing.cluster import InProcessCluster
+from tpubft.utils import serialize as ser
+
+
+def _skvbc_factory(_r=None):
+    return skvbc.SkvbcHandler(
+        KeyValueBlockchain(MemoryDB(), use_device_hashing=False))
+
+
+PREEXEC = dict(pre_execution_enabled=True)
+
+
+def test_preexec_codec_and_digest():
+    env = m.PreProcessResult(original=b"orig", result=b"res",
+                             signatures=[(0, b"s0"), (2, b"s2")])
+    raw = ser.encode_msg(env)
+    back = ser.decode_msg(raw, m.PreProcessResult)
+    assert back == env
+    d1 = m.preexec_digest(5, 7, b"orig", b"res")
+    assert d1 != m.preexec_digest(5, 7, b"orig", b"res2")
+    assert d1 != m.preexec_digest(5, 8, b"orig", b"res")
+
+
+@pytest.mark.slow
+def test_preexec_end_to_end_and_conflicts():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                          cfg_overrides=PREEXEC) as cluster:
+        client = cluster.client(0)
+        client.start()
+        kv = skvbc.SkvbcClient(client)
+        w = kv.write([(b"a", b"1")], pre_process=True, timeout_ms=8000)
+        assert w.success and w.latest_block == 1
+        # stale-readset pre-executed write: conflict caught at commit
+        w2 = kv.write([(b"a", b"2")], pre_process=True, timeout_ms=8000)
+        assert w2.success
+        stale = kv.write([(b"b", b"x")], readset=[b"a"], read_version=1,
+                         pre_process=True, timeout_ms=8000)
+        assert not stale.success
+        assert kv.read([b"a"]) == {b"a": b"2"}
+        # replicas converge
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            heights = {h.blockchain.last_block_id
+                       for h in cluster.handlers.values()}
+            if heights == {2}:
+                break
+            time.sleep(0.1)
+        assert heights == {2}
+
+
+@pytest.mark.slow
+def test_preexec_unsupported_handler_falls_back():
+    with InProcessCluster(f=1, cfg_overrides=PREEXEC) as cluster:
+        client = cluster.client(0)
+        client.start()
+        # CounterHandler.pre_execute returns None -> normal ordering
+        reply = client.send_write(counter.encode_add(4), pre_process=True)
+        assert counter.decode_reply(reply) == 4
+
+
+@pytest.mark.slow
+def test_preexec_wrapper_validation_rejects_forgeries():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                          cfg_overrides=PREEXEC) as cluster:
+        rep = cluster.replicas[1]
+        client_id = cluster.n
+        orig = m.ClientRequestMsg(
+            sender_id=client_id, req_seq_num=50,
+            flags=int(m.RequestFlag.PRE_PROCESS),
+            request=skvbc.pack(skvbc.WriteRequest(writeset=[(b"k", b"v")])),
+            cid="x", signature=b"")
+        # properly client-signed original
+        from tpubft.crypto.cpu import Ed25519Signer
+        signer = Ed25519Signer.generate(
+            seed=cluster.keys.for_node(client_id).my_sign_seed)
+        orig.signature = signer.sign(orig.signed_payload())
+        result = orig.request
+        digest = m.preexec_digest(client_id, 50, orig.pack(), result)
+        sigs = [(r, cluster.replicas[r].sig.sign(digest)) for r in (0, 2)]
+
+        def wrapper(signatures):
+            env = m.PreProcessResult(original=orig.pack(), result=result,
+                                     signatures=signatures)
+            return m.ClientRequestMsg(
+                sender_id=client_id, req_seq_num=50,
+                flags=int(m.RequestFlag.HAS_PRE_PROCESSED),
+                request=ser.encode_msg(env), cid="x", signature=b"")
+
+        assert validate_preprocessed_request(rep, wrapper(sigs))
+        # too few signatures
+        assert not validate_preprocessed_request(rep, wrapper(sigs[:1]))
+        # duplicated signer doesn't count twice
+        assert not validate_preprocessed_request(rep, wrapper([sigs[0],
+                                                               sigs[0]]))
+        # signature over a different result
+        bad_digest_sig = cluster.replicas[0].sig.sign(b"\x00" * 32)
+        assert not validate_preprocessed_request(
+            rep, wrapper([(0, bad_digest_sig), sigs[1]]))
+        # tampered result: sigs no longer match
+        env = m.PreProcessResult(original=orig.pack(), result=b"evil",
+                                 signatures=sigs)
+        tampered = m.ClientRequestMsg(
+            sender_id=client_id, req_seq_num=50,
+            flags=int(m.RequestFlag.HAS_PRE_PROCESSED),
+            request=ser.encode_msg(env), cid="x", signature=b"")
+        assert not validate_preprocessed_request(rep, tampered)
+        # unpack roundtrip
+        o, res = unpack_preprocessed(wrapper(sigs).request)
+        assert o.req_seq_num == 50 and res == result

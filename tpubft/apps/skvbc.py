@@ -12,6 +12,7 @@ system tests and the linearizability tracker drive.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -179,6 +180,40 @@ class SkvbcHandler(IRequestsHandler):
         with self._lock:
             return self._execute_read(msg)
 
+    # ---- pre-execution (reference InternalCommandsHandler PRE_PROCESS) --
+    def pre_execute(self, client_id: int, req_seq: int,
+                    request: bytes) -> Optional[bytes]:
+        """Speculative phase: validate + canonicalize the write intent.
+        The result must not depend on this replica's block height (f+1
+        replicas at different heights must produce identical bytes), so
+        the conflict check stays in apply_pre_executed — matching the
+        reference, where verifyWriteCommand runs at commit."""
+        try:
+            msg = unpack(request)
+        except ser.SerializeError:
+            return None
+        if not isinstance(msg, WriteRequest):
+            return None
+        if msg.long_exec:
+            time.sleep(0.05)  # simulated heavy pre-processing
+        canonical = WriteRequest(read_version=msg.read_version,
+                                 long_exec=False,
+                                 readset=sorted(msg.readset),
+                                 writeset=sorted(msg.writeset))
+        return pack(canonical)
+
+    def apply_pre_executed(self, client_id: int, req_seq: int, flags: int,
+                           original_request: bytes,
+                           result: bytes) -> bytes:
+        try:
+            msg = unpack(result)
+        except ser.SerializeError:
+            return b""
+        if not isinstance(msg, WriteRequest):
+            return b""
+        with self._lock:
+            return self._execute_write(msg)
+
     def state_digest(self) -> bytes:
         with self._lock:
             return self._bc.state_digest()
@@ -194,10 +229,12 @@ class SkvbcClient:
     def write(self, writeset: List[Tuple[bytes, bytes]],
               readset: Optional[List[bytes]] = None,
               read_version: int = 0,
-              timeout_ms: Optional[int] = None) -> WriteReply:
+              timeout_ms: Optional[int] = None,
+              pre_process: bool = False) -> WriteReply:
         req = WriteRequest(read_version=read_version,
                            readset=readset or [], writeset=writeset)
-        reply = self._client.send_write(pack(req), timeout_ms=timeout_ms)
+        reply = self._client.send_write(pack(req), timeout_ms=timeout_ms,
+                                        pre_process=pre_process)
         return unpack(reply)
 
     def read(self, keys: List[bytes], read_version: int = READ_LATEST,

@@ -97,9 +97,12 @@ class BftClient(IReceiver):
 
     def send_write(self, request: bytes,
                    quorum: Quorum = Quorum.LINEARIZABLE,
-                   timeout_ms: Optional[int] = None) -> bytes:
-        return self._send(request, flags=0, quorum=quorum,
-                          timeout_ms=timeout_ms)
+                   timeout_ms: Optional[int] = None,
+                   pre_process: bool = False) -> bytes:
+        return self._send(request,
+                          flags=(int(m.RequestFlag.PRE_PROCESS)
+                                 if pre_process else 0),
+                          quorum=quorum, timeout_ms=timeout_ms)
 
     def send_read(self, request: bytes,
                   quorum: Quorum = Quorum.BYZANTINE_SAFE,

@@ -34,12 +34,17 @@ class CronTable:
                 return self._last_tick[component]
         return 0
 
+    def reload(self) -> None:
+        """Drop the in-memory tick cache so reads fall through to the
+        (possibly state-transfer-installed) reserved page."""
+        self._last_tick.clear()
+
     def _page_index(self, component: str) -> int:
-        # stable small index per component (registration order agnostic:
-        # hash-derived, 16-bit space is plenty for cron components)
+        # stable index per component, registration-order agnostic; 32-bit
+        # hash space makes accidental collisions negligible
         import hashlib
         return int.from_bytes(
-            hashlib.sha256(component.encode()).digest()[:2], "big")
+            hashlib.sha256(component.encode()).digest()[:4], "big")
 
     def on_tick(self, op: TickOp) -> None:
         """Executed on EVERY replica at the same consensus position."""

@@ -67,12 +67,18 @@ class InternalBFTClient:
     """Lets the replica submit requests into its own consensus
     (key exchange, cron ticks, reconfiguration)."""
 
+    RETRANSMIT_PERIOD_S = 1.0
+    MAX_RETRANSMITS = 30
+
     def __init__(self, replica) -> None:
         self._replica = replica
         self.client_id = replica.info.internal_client_of(replica.id)
         # req seqnums must survive restarts (at-most-once filtering);
         # wall-clock ms + in-process counter is monotonic enough
         self._req_seq = int(time.time() * 1000)
+        self._pending: Dict[int, tuple] = {}  # req_seq -> (raw, sent, tries)
+        replica.dispatcher.add_timer(self.RETRANSMIT_PERIOD_S,
+                                     self._retransmit_pending)
 
     def submit(self, payload: bytes,
                flags: int = int(m.RequestFlag.INTERNAL)) -> int:
@@ -83,11 +89,30 @@ class InternalBFTClient:
             cid=f"int-{self._replica.id}-{self._req_seq}", signature=b"")
         req.signature = self._replica.sig.sign(req.signed_payload())
         raw = req.pack()
+        self._pending[self._req_seq] = (raw, time.monotonic(), 0)
+        self._broadcast(raw)
+        return self._req_seq
+
+    def _broadcast(self, raw: bytes) -> None:
         for r in self._replica.info.other_replicas(self._replica.id):
             self._replica.comm.send(r, raw)
         # self-delivery through the normal external queue
         self._replica.incoming.push_external(self.client_id, raw)
-        return self._req_seq
+
+    def _retransmit_pending(self) -> None:
+        """Internal requests are not fire-and-forget: keep resending until
+        ordered+executed (a one-shot key exchange lost at startup would
+        otherwise never happen)."""
+        now = time.monotonic()
+        executed = self._replica.clients.last_executed(self.client_id)
+        for seq in sorted(self._pending):
+            raw, sent, tries = self._pending[seq]
+            if seq <= executed or tries >= self.MAX_RETRANSMITS:
+                del self._pending[seq]
+                continue
+            if now - sent >= self.RETRANSMIT_PERIOD_S:
+                self._pending[seq] = (raw, now, tries + 1)
+                self._broadcast(raw)
 
 
 # ---------------- key exchange ----------------
@@ -154,9 +179,16 @@ class TimeServiceManager:
         self.max_skew_ms = max_skew_ms
         raw = pages.load()
         self.last_agreed_ms = int.from_bytes(raw, "big") if raw else 0
+        self._last_stamp = 0
 
     def primary_stamp(self) -> int:
-        return max(int(self._clock() * 1000), self.last_agreed_ms + 1)
+        """Strictly increasing across PIPELINED proposals too — two
+        PrePrepares stamped in the same millisecond would make backups
+        that executed the first reject the second forever."""
+        self._last_stamp = max(int(self._clock() * 1000),
+                               self.last_agreed_ms + 1,
+                               self._last_stamp + 1)
+        return self._last_stamp
 
     def validate(self, t_ms: int) -> bool:
         if t_ms <= self.last_agreed_ms:

@@ -48,6 +48,8 @@ class MsgCode(enum.IntEnum):
     StateTransfer = 18
     ReplicaRestartReady = 19
     RestartProof = 20
+    PreProcessRequest = 21
+    PreProcessReply = 22
 
 
 class RequestFlag(enum.IntFlag):
@@ -339,6 +341,64 @@ class SimpleAckMsg(ConsensusMsg):
     acked_msg_code: int
     SPEC = [("sender_id", "u32"), ("seq_num", "u64"), ("view", "u64"),
             ("acked_msg_code", "u16")]
+
+
+# ---------------- pre-execution (reference src/preprocessor/messages) ----
+
+@register
+@dataclass
+class PreProcessRequestMsg(ConsensusMsg):
+    """Primary → all replicas: speculatively execute this client request
+    (reference PreProcessRequestMsg.hpp)."""
+    CODE = MsgCode.PreProcessRequest
+    sender_id: int              # the primary
+    client_id: int
+    req_seq_num: int
+    retry_id: int
+    request: bytes              # packed original ClientRequestMsg
+    SPEC = [("sender_id", "u32"), ("client_id", "u32"),
+            ("req_seq_num", "u64"), ("retry_id", "u64"),
+            ("request", "bytes")]
+
+
+@register
+@dataclass
+class PreProcessReplyMsg(ConsensusMsg):
+    """Replica → primary: signed digest of its speculative result
+    (reference PreProcessReplyMsg.hpp)."""
+    CODE = MsgCode.PreProcessReply
+    sender_id: int
+    client_id: int
+    req_seq_num: int
+    retry_id: int
+    result_digest: bytes
+    status: int                 # 0 = ok, 1 = rejected/unsupported
+    signature: bytes            # over preexec_digest binding below
+    SPEC = [("sender_id", "u32"), ("client_id", "u32"),
+            ("req_seq_num", "u64"), ("retry_id", "u64"),
+            ("result_digest", "bytes"), ("status", "u8"),
+            ("signature", "bytes")]
+
+
+@dataclass
+class PreProcessResult:
+    """The ordered artifact replacing the raw request: original request +
+    agreed speculative result + f+1 replica signatures (reference
+    PreProcessResultMsg.hpp — a ClientRequestMsg subclass on the wire;
+    here it is the wrapper request's payload)."""
+    original: bytes             # packed original ClientRequestMsg
+    result: bytes
+    signatures: list            # [(replica_id, sig)]
+    SPEC = [("original", "bytes"), ("result", "bytes"),
+            ("signatures", ("list", ("pair", "u32", "bytes")))]
+
+
+def preexec_digest(client_id: int, req_seq: int, original: bytes,
+                   result: bytes) -> bytes:
+    """What PreProcessReply signatures cover: the binding of a concrete
+    request to its speculative result."""
+    return sha256(b"preexec" + struct.pack("<IQ", client_id, req_seq)
+                  + sha256(original) + sha256(result))
 
 
 # ---------------- view change ----------------

@@ -78,6 +78,22 @@ class IRequestsHandler(abc.ABC):
         """Digest of app state for checkpoint agreement."""
         return b"\x00" * 32
 
+    # ---- pre-execution (reference IRequestsHandler PRE_PROCESS flag) ----
+    def pre_execute(self, client_id: int, req_seq: int,
+                    request: bytes) -> Optional[bytes]:
+        """Speculative, side-effect-free execution. The returned bytes
+        must be DETERMINISTIC across replicas regardless of their current
+        state height (they are hashed for f+1 agreement). None =
+        unsupported → the request falls back to normal ordering."""
+        return None
+
+    def apply_pre_executed(self, client_id: int, req_seq: int, flags: int,
+                           original_request: bytes,
+                           result: bytes) -> bytes:
+        """Commit a pre-executed result, re-checking conflicts against
+        current state. Default: execute the original normally."""
+        return self.execute(client_id, req_seq, flags, original_request)
+
 
 class Replica(IReceiver):
     def __init__(self, cfg: ReplicaConfig, keys: ClusterKeys,
@@ -180,6 +196,12 @@ class Replica(IReceiver):
         # state transfer (attached by the kvbc layer via set_state_transfer;
         # reference: ReplicaForStateTransfer owning an IStateTransfer)
         self.state_transfer = None
+
+        # pre-execution (reference src/preprocessor/, gated on config)
+        self.preprocessor = None
+        if cfg.pre_execution_enabled:
+            from tpubft.preprocessor import PreProcessor
+            self.preprocessor = PreProcessor(self)
 
         # reserved pages + the subsystems riding them (internal client,
         # key exchange, time service, cron)
@@ -284,6 +306,7 @@ class Replica(IReceiver):
         # reserved pages were just installed: adopt everything riding them
         self.key_exchange.load_from_pages()
         self.time_service.reload()
+        self.cron_table.reload()
         self._load_client_replies_from_pages()
         self._last_progress = time.monotonic()
 
@@ -315,6 +338,8 @@ class Replica(IReceiver):
         self._running = False
         self.dispatcher.stop()
         self.collector_pool.shutdown()
+        if self.preprocessor:
+            self.preprocessor.shutdown()
         self.comm.stop()
 
     @property
@@ -369,6 +394,14 @@ class Replica(IReceiver):
             if self.state_transfer is not None \
                     and self.info.is_replica(sender):
                 self.state_transfer.handle_message(sender, msg.payload)
+            return
+        if isinstance(msg, m.PreProcessRequestMsg):
+            if self.preprocessor and self.info.is_replica(sender):
+                self.preprocessor.on_preprocess_request(sender, msg)
+            return
+        if isinstance(msg, m.PreProcessReplyMsg):
+            if self.preprocessor and self.info.is_replica(sender):
+                self.preprocessor.on_preprocess_reply(sender, msg)
             return
         if self.in_view_change:
             return
@@ -429,9 +462,22 @@ class Replica(IReceiver):
             self._forwarded.setdefault((client, req.req_seq_num),
                                        time.monotonic())
             return
-        if not self.clients.can_become_pending(client, req.req_seq_num):
+        if req.flags & m.RequestFlag.PRE_PROCESS and self.preprocessor:
+            # optimistic pre-execution path (PreProcessor, SURVEY §3.5)
+            self.preprocessor.on_client_request(req)
             return
-        self.clients.add_pending(client, req.req_seq_num, req.cid)
+        # PRE_PROCESS without a preprocessor: order normally (the flag
+        # must stay — it is covered by the client's signature)
+        self._admit_request(req)
+
+    def _admit_request(self, req: m.ClientRequestMsg) -> None:
+        """Primary: queue a request for batching (tail of
+        onMessage<ClientRequestMsg>). Also the entry point for the
+        preprocessor's ordered PreProcessResult wrappers."""
+        if not self.clients.can_become_pending(req.sender_id,
+                                               req.req_seq_num):
+            return
+        self.clients.add_pending(req.sender_id, req.req_seq_num, req.cid)
         self.pending_requests.append(req)
         self._try_send_pre_prepare()
 
@@ -483,11 +529,20 @@ class Replica(IReceiver):
             reqs = pp.client_requests()
         except m.MsgError:
             return
+        # pre-executed wrappers carry their own proof set (original client
+        # sig + f+1 replica result sigs) instead of a wrapper signature
+        plain = [r for r in reqs
+                 if not r.flags & m.RequestFlag.HAS_PRE_PROCESSED]
         items = [(r.sender_id, r.signed_payload(), r.signature)
-                 for r in reqs]
+                 for r in plain]
         if items and not all(self.sig.verify_batch(items)):
             return
         for r in reqs:
+            if r.flags & m.RequestFlag.HAS_PRE_PROCESSED:
+                from tpubft.preprocessor.preprocessor import (
+                    validate_preprocessed_request)
+                if not validate_preprocessed_request(self, r):
+                    return
             if not self.clients.is_valid_client(r.sender_id):
                 return
             # a byzantine primary must not smuggle INTERNAL-flagged ops
@@ -793,6 +848,17 @@ class Replica(IReceiver):
                     continue
                 if req.flags & m.RequestFlag.INTERNAL:
                     reply = self._execute_internal_request(req)
+                elif req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
+                    from tpubft.preprocessor.preprocessor import (
+                        unpack_preprocessed)
+                    try:
+                        orig, result = unpack_preprocessed(req.request)
+                    except Exception:
+                        reply = b""
+                    else:
+                        reply = self.handler.apply_pre_executed(
+                            orig.sender_id, orig.req_seq_num, orig.flags,
+                            orig.request, result)
                 else:
                     reply = self.handler.execute(req.sender_id,
                                                  req.req_seq_num,

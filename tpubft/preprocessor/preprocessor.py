@@ -1,0 +1,236 @@
+"""PreProcessor protocol logic. See package docstring."""
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from tpubft.consensus import messages as m
+from tpubft.utils import serialize as ser
+
+
+@dataclass
+class _Session:
+    """Primary-side state for one in-flight pre-execution
+    (reference RequestProcessingState)."""
+    original: m.ClientRequestMsg
+    retry_id: int
+    started: float
+    last_broadcast: float = 0.0
+    my_result: Optional[bytes] = None
+    # replica -> (digest, sig) of agreeing replies
+    replies: Dict[int, Tuple[bytes, bytes]] = field(default_factory=dict)
+    done: bool = False
+
+
+class PreProcessor:
+    """Attached to a Replica when cfg.pre_execution_enabled. All methods
+    except the pool callbacks run on the dispatcher thread."""
+
+    SESSION_TIMEOUT_S = 10.0
+
+    def __init__(self, replica, num_threads: int = 4) -> None:
+        self.replica = replica
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix="preexec")
+        self._sessions: Dict[Tuple[int, int], _Session] = {}
+        self._retry_counter = 0
+        replica.dispatcher.register_internal("preexec", self._on_internal)
+        replica.dispatcher.add_timer(1.0, self._expire_sessions)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+    # primary side
+    # ------------------------------------------------------------------
+    REBROADCAST_PERIOD_S = 1.0
+
+    def on_client_request(self, req: m.ClientRequestMsg) -> None:
+        """Primary receives a PRE_PROCESS request
+        (onClientPreProcessRequestMsg)."""
+        key = (req.sender_id, req.req_seq_num)
+        sess = self._sessions.get(key)
+        if sess is not None:
+            # client retransmission: the original broadcast may have been
+            # lost — re-send the PreProcessRequest (bounded rate) so a
+            # stuck session can still reach its reply quorum
+            now = time.monotonic()
+            if now - sess.last_broadcast >= self.REBROADCAST_PERIOD_S:
+                sess.last_broadcast = now
+                self._broadcast_request(sess)
+            return
+        if not self.replica.clients.can_become_pending(*key):
+            return
+        self._retry_counter += 1
+        sess = _Session(original=req, retry_id=self._retry_counter,
+                        started=time.monotonic(),
+                        last_broadcast=time.monotonic())
+        self._sessions[key] = sess
+        self._broadcast_request(sess)
+        self._launch(req, sess.retry_id, primary=True)
+
+    def _broadcast_request(self, sess: _Session) -> None:
+        ppr = m.PreProcessRequestMsg(
+            sender_id=self.replica.id, client_id=sess.original.sender_id,
+            req_seq_num=sess.original.req_seq_num, retry_id=sess.retry_id,
+            request=sess.original.pack())
+        for r in self.replica.info.other_replicas(self.replica.id):
+            self.replica.comm.send(r, ppr.pack())
+
+    def _launch(self, req: m.ClientRequestMsg, retry_id: int,
+                primary: bool, reply_to: Optional[int] = None) -> None:
+        """Run handler.pre_execute on the pool; result re-enters the
+        dispatcher as an internal msg (launchAsyncReqPreProcessingJob)."""
+        handler = self.replica.handler
+
+        def job():
+            try:
+                result = handler.pre_execute(req.sender_id, req.req_seq_num,
+                                             req.request)
+            except Exception:
+                result = None
+            self.replica.incoming.push_internal(
+                "preexec", ("done", req, retry_id, primary, reply_to,
+                            result))
+        self._pool.submit(job)
+
+    def _on_internal(self, item) -> None:
+        kind, req, retry_id, primary, reply_to, result = item
+        key = (req.sender_id, req.req_seq_num)
+        if primary:
+            sess = self._sessions.get(key)
+            if sess is None or sess.retry_id != retry_id or sess.done:
+                return
+            if result is None:
+                # unsupported/failed: fall back to normal ordering with
+                # the request untouched (flags are client-signed)
+                sess.done = True
+                del self._sessions[key]
+                self.replica._admit_request(req)
+                return
+            sess.my_result = result
+            digest = m.preexec_digest(key[0], key[1], req.pack(), result)
+            sig = self.replica.sig.sign(digest)
+            sess.replies[self.replica.id] = (digest, sig)
+            self._maybe_finish(key)
+        else:
+            # backup: sign our digest and reply to the primary
+            if result is None:
+                status, digest, sig = 1, b"", b""
+            else:
+                digest = m.preexec_digest(key[0], key[1], req.pack(), result)
+                sig = self.replica.sig.sign(digest)
+                status = 0
+            reply = m.PreProcessReplyMsg(
+                sender_id=self.replica.id, client_id=key[0],
+                req_seq_num=key[1], retry_id=retry_id,
+                result_digest=digest, status=status, signature=sig)
+            self.replica.comm.send(reply_to, reply.pack())
+
+    # ------------------------------------------------------------------
+    # backup side
+    # ------------------------------------------------------------------
+    def on_preprocess_request(self, sender: int,
+                              msg: m.PreProcessRequestMsg) -> None:
+        if sender != self.replica.primary:
+            return
+        try:
+            req = m.unpack(msg.request)
+        except m.MsgError:
+            return
+        if not isinstance(req, m.ClientRequestMsg) \
+                or req.sender_id != msg.client_id \
+                or req.req_seq_num != msg.req_seq_num:
+            return
+        if not self.replica.sig.verify(req.sender_id, req.signed_payload(),
+                                       req.signature):
+            return
+        self._launch(req, msg.retry_id, primary=False, reply_to=sender)
+
+    def on_preprocess_reply(self, sender: int,
+                            msg: m.PreProcessReplyMsg) -> None:
+        key = (msg.client_id, msg.req_seq_num)
+        sess = self._sessions.get(key)
+        if sess is None or sess.retry_id != msg.retry_id or sess.done:
+            return
+        if msg.status != 0:
+            return
+        if not self.replica.sig.verify(sender, msg.result_digest,
+                                       msg.signature):
+            return
+        sess.replies[sender] = (msg.result_digest, msg.signature)
+        self._maybe_finish(key)
+
+    # ------------------------------------------------------------------
+    def _maybe_finish(self, key) -> None:
+        """f+1 matching digests (incl. our own) → order the result
+        (reference: RequestProcessingState::definePreProcessingConsensusResult)."""
+        sess = self._sessions.get(key)
+        if sess is None or sess.my_result is None or sess.done:
+            return
+        my_digest = sess.replies.get(self.replica.id, (None, None))[0]
+        agreeing = [(r, sig) for r, (d, sig) in sess.replies.items()
+                    if d == my_digest]
+        quorum = self.replica.info.f + 1
+        if len(agreeing) < quorum:
+            return
+        sess.done = True
+        del self._sessions[key]
+        envelope = m.PreProcessResult(
+            original=sess.original.pack(), result=sess.my_result,
+            signatures=sorted(agreeing)[:quorum])
+        wrapper = m.ClientRequestMsg(
+            sender_id=sess.original.sender_id,
+            req_seq_num=sess.original.req_seq_num,
+            flags=(sess.original.flags
+                   & ~int(m.RequestFlag.PRE_PROCESS))
+            | int(m.RequestFlag.HAS_PRE_PROCESSED),
+            request=ser.encode_msg(envelope),
+            cid=sess.original.cid, signature=b"")
+        self.replica._admit_request(wrapper)
+
+    def _expire_sessions(self) -> None:
+        now = time.monotonic()
+        for key in [k for k, s in self._sessions.items()
+                    if now - s.started > self.SESSION_TIMEOUT_S]:
+            del self._sessions[key]
+
+
+def validate_preprocessed_request(replica, req: m.ClientRequestMsg) -> bool:
+    """Validation of an ordered PreProcessResult wrapper, used by backups
+    inside PrePrepare batch validation (reference
+    PreProcessResultMsg::validatePreProcessResultSignatures): the embedded
+    original must carry a valid client signature, and f+1 distinct
+    replicas must have signed the (request, result) binding."""
+    try:
+        env = ser.decode_msg(req.request, m.PreProcessResult)
+        orig = m.unpack(env.original)
+    except Exception:
+        return False
+    if not isinstance(orig, m.ClientRequestMsg):
+        return False
+    if orig.sender_id != req.sender_id \
+            or orig.req_seq_num != req.req_seq_num:
+        return False
+    if not orig.flags & m.RequestFlag.PRE_PROCESS:
+        return False
+    if not replica.sig.verify(orig.sender_id, orig.signed_payload(),
+                              orig.signature):
+        return False
+    digest = m.preexec_digest(orig.sender_id, orig.req_seq_num,
+                              env.original, env.result)
+    seen = set()
+    for replica_id, sig in env.signatures:
+        if replica_id in seen or not replica.info.is_replica(replica_id):
+            continue
+        if replica.sig.verify(replica_id, digest, sig):
+            seen.add(replica_id)
+    return len(seen) >= replica.info.f + 1
+
+
+def unpack_preprocessed(request: bytes):
+    """-> (original ClientRequestMsg, result bytes)."""
+    env = ser.decode_msg(request, m.PreProcessResult)
+    return m.unpack(env.original), env.result
