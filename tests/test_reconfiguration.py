@@ -1,0 +1,136 @@
+"""Reconfiguration tests: command codec, operator authentication, wedge/
+unwedge semantics, consensus-coordinated pruning, key-exchange command,
+DB checkpoints (reference model: reconfiguration unit tests + apollo
+test_skvbc_reconfiguration.py)."""
+import os
+import time
+
+import pytest
+
+from tpubft.apps import skvbc
+from tpubft.consensus import messages as m
+from tpubft.kvbc import KeyValueBlockchain
+from tpubft.reconfiguration import messages as rm
+from tpubft.storage import MemoryDB
+from tpubft.testing.cluster import InProcessCluster
+
+SMALL = dict(checkpoint_window_size=5, work_window_size=10)
+
+
+def _skvbc_factory(_r=None):
+    return skvbc.SkvbcHandler(
+        KeyValueBlockchain(MemoryDB(), use_device_hashing=False))
+
+
+def test_command_codec():
+    cmds = [rm.WedgeCommand(stop_seq=7), rm.UnwedgeCommand(),
+            rm.PruneRequest(until_block=3),
+            rm.KeyExchangeCommand(targets=[0, 2]),
+            rm.AddRemoveWithWedgeCommand(config_descriptor="n=7"),
+            rm.RestartCommand(), rm.DbCheckpointCommand(checkpoint_id="c1"),
+            rm.GetStatusCommand()]
+    for cmd in cmds:
+        assert rm.unpack_command(rm.pack_command(cmd)) == cmd
+    r = rm.ReconfigReply(success=True, data="x")
+    assert rm.unpack_reply(rm.pack_reply(r)) == r
+
+
+@pytest.mark.slow
+def test_non_operator_reconfig_rejected():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory) as cluster:
+        client = cluster.client(0)
+        client.start()
+        # ordinary client sends a RECONFIG-flagged request: dropped at
+        # admission -> no quorum of replies -> timeout
+        from tpubft.bftclient.client import Quorum, TimeoutError_
+        with pytest.raises(TimeoutError_):
+            client._send(rm.pack_command(rm.WedgeCommand()),
+                         flags=int(m.RequestFlag.RECONFIG),
+                         quorum=Quorum.LINEARIZABLE, timeout_ms=1500)
+
+
+@pytest.mark.slow
+def test_wedge_unwedge_and_status():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                          cfg_overrides=SMALL) as cluster:
+        client = cluster.client(0)
+        client.start()
+        kv = skvbc.SkvbcClient(client)
+        op = cluster.operator_client()
+        assert kv.write([(b"a", b"1")]).success
+        reply = op.wedge(timeout_ms=8000)
+        assert reply.success
+        stop = int(reply.data)
+        # writes stall once execution reaches the wedge point
+        deadline = time.monotonic() + 10
+        wedged = False
+        while time.monotonic() < deadline and not wedged:
+            try:
+                kv.write([(b"w", b"x")], timeout_ms=1000)
+            except Exception:
+                wedged = all(rep.control.is_wedged(rep.last_executed)
+                             or rep.last_executed >= stop
+                             for rep in cluster.replicas.values())
+            time.sleep(0.05)
+        assert wedged, "cluster never wedged"
+        assert all(rep.last_executed <= stop
+                   for rep in cluster.replicas.values())
+        # unwedge resumes ordering
+        assert op.unwedge(timeout_ms=8000).success
+        assert kv.write([(b"after", b"1")], timeout_ms=8000).success
+
+
+@pytest.mark.slow
+def test_prune_through_consensus():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory) as cluster:
+        client = cluster.client(0)
+        client.start()
+        kv = skvbc.SkvbcClient(client)
+        for i in range(6):
+            kv.write([(b"k", str(i).encode())])
+        op = cluster.operator_client()
+        reply = op.prune(4, timeout_ms=8000)
+        assert reply.success and reply.data == "4"
+        time.sleep(0.3)
+        for rep in cluster.replicas.values():
+            bc = rep.handler.blockchain if hasattr(rep.handler, "blockchain") \
+                else None
+        gens = {h.blockchain.genesis_block_id
+                for h in cluster.handlers.values()}
+        assert gens == {4}
+        # latest state intact
+        assert kv.read([b"k"]) == {b"k": b"5"}
+
+
+@pytest.mark.slow
+def test_key_exchange_command():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory) as cluster:
+        old = {r: rep.sig._replica_pubkeys[2]
+               for r, rep in cluster.replicas.items()}
+        op = cluster.operator_client()
+        assert op.key_exchange(targets=[2], timeout_ms=8000).success
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            pks = {rep.sig._replica_pubkeys[2]
+                   for rep in cluster.replicas.values()}
+            if len(pks) == 1 and pks != {old[0]}:
+                break
+            time.sleep(0.05)
+        assert len(pks) == 1 and pks != {old[0]}
+
+
+def test_db_checkpoint_native(tmp_path):
+    """DbCheckpointHandler over the native engine produces an openable
+    snapshot (DbCheckpointManager role)."""
+    from tpubft.storage.native import NativeDB
+    db = NativeDB(str(tmp_path / "main.kvlog"))
+    db.put(b"k1", b"v1")
+    db.put(b"k2", b"v2")
+    db.checkpoint_to(str(tmp_path / "snap.kvlog"))
+    db.put(b"k3", b"v3")  # post-checkpoint write not in snapshot
+    snap = NativeDB(str(tmp_path / "snap.kvlog"))
+    assert snap.get(b"k1") == b"v1"
+    assert snap.get(b"k2") == b"v2"
+    assert snap.get(b"k3") is None
+    snap.close()
+    db.close()

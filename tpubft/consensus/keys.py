@@ -63,6 +63,14 @@ class ClusterKeys:
         for cl in range(first_client, first_client + num_clients):
             s = Ed25519Signer.generate(seed=_derive_seed(seed, "client", cl))
             ck.client_pubkeys[cl] = s.public_bytes()
+        # operator principal (reconfiguration commands): its id must match
+        # ReplicasInfo.operator_id, which derives from the CONFIG's client
+        # count — not this function's num_clients parameter (callers may
+        # generate extra client keys)
+        operator_id = first_client + cfg.num_of_client_proxies + n
+        s = Ed25519Signer.generate(seed=_derive_seed(seed, "client",
+                                                     operator_id))
+        ck.client_pubkeys[operator_id] = s.public_bytes()
         scheme = cfg.threshold_scheme
         ck.slow_path_system = Cryptosystem(
             scheme, 2 * f + c + 1, n, seed=_derive_seed(seed, "slow"))

@@ -222,6 +222,11 @@ class Replica(IReceiver):
         self.time_service = TimeServiceManager(
             ReservedPagesClient(self.res_pages, TimeServiceManager.CATEGORY),
             max_skew_ms=cfg.time_max_skew_ms)
+        from tpubft.consensus.control import ControlStateManager
+        self.control = ControlStateManager(
+            ReservedPagesClient(self.res_pages,
+                                ControlStateManager.CATEGORY))
+        self.reconfig = None  # ReconfigurationDispatcher (kvbc wiring)
         self.cron_table = CronTable(
             ReservedPagesClient(self.res_pages, CronTable.CATEGORY))
         self.ticks_generator = TicksGenerator(self, self.cron_table)
@@ -307,8 +312,13 @@ class Replica(IReceiver):
         self.key_exchange.load_from_pages()
         self.time_service.reload()
         self.cron_table.reload()
+        self.control.reload()
         self._load_client_replies_from_pages()
         self._last_progress = time.monotonic()
+
+    def set_reconfiguration(self, dispatcher) -> None:
+        """Attach the reconfiguration handler chain (kvbc wiring)."""
+        self.reconfig = dispatcher
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -434,16 +444,35 @@ class Replica(IReceiver):
         if bool(req.flags & m.RequestFlag.INTERNAL) \
                 != self.info.is_internal_client(client):
             return
+        # RECONFIG commands only from the operator principal
+        if req.flags & m.RequestFlag.RECONFIG \
+                and client != self.info.operator_id:
+            return
+        # HAS_PRE_PROCESSED may only be minted by the preprocessor (it
+        # enters via _admit_request); a client-signed one would poison
+        # every batch it lands in (backups reject the whole PrePrepare)
+        if req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
+            return
         if not self.sig.verify(client, req.signed_payload(), req.signature):
             return
         if req.flags & m.RequestFlag.READ_ONLY:
             # replied directly — MUST NOT advance the client's
             # last-executed counter (that would make _execute_committed
             # skip a committed write with a lower req_seq: divergence)
+            if req.flags & m.RequestFlag.RECONFIG:
+                # non-ordered operator command (reference: the operator's
+                # direct/bft=false path — how unwedge reaches a cluster
+                # that can no longer order anything)
+                if self.reconfig is None:
+                    return
+                payload = self.reconfig.execute(self, req,
+                                                self.last_executed,
+                                                direct=True)
+            else:
+                payload = self.handler.read(client, req.request)
             reply = m.ClientReplyMsg(
                 sender_id=self.id, req_seq_num=req.req_seq_num,
-                current_primary=self.primary,
-                reply=self.handler.read(client, req.request),
+                current_primary=self.primary, reply=payload,
                 replica_specific_info=b"")
             self.comm.send(client, reply.pack())
             return
@@ -491,6 +520,8 @@ class Replica(IReceiver):
         seq = self.primary_next_seq
         if seq > self.last_stable + self.cfg.work_window_size:
             return                              # window full: wait for stability
+        if self.control.blocks_ordering(seq):
+            return                              # wedged (ControlStateManager)
         batch = self.pending_requests[:self.cfg.max_num_of_requests_in_batch]
         self.pending_requests = self.pending_requests[len(batch):]
         raw_reqs = [r.pack() for r in batch]
@@ -519,6 +550,8 @@ class Replica(IReceiver):
         info = self.window.get(pp.seq_num)
         if info.pre_prepare is not None:
             return                              # already have it
+        if self.control.blocks_ordering(pp.seq_num):
+            return                              # wedged: nothing past stop
         if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature):
             return
         # Verify every embedded client request before signing shares over
@@ -549,6 +582,9 @@ class Replica(IReceiver):
             # from external principals (or strip the flag from real ones)
             if bool(r.flags & m.RequestFlag.INTERNAL) \
                     != self.info.is_internal_client(r.sender_id):
+                return
+            if r.flags & m.RequestFlag.RECONFIG \
+                    and r.sender_id != self.info.operator_id:
                 return
         # view-change safety: a seqnum certified as possibly-committed in
         # an earlier view may ONLY be re-proposed with the same batch
@@ -834,6 +870,8 @@ class Replica(IReceiver):
             nxt = self.last_executed + 1
             if not self.window.in_window(nxt):
                 return
+            if self.control.blocks_ordering(nxt):
+                return  # wedged: execution halts at the agreed cut
             info = self.window.peek(nxt)
             if info is None or not info.committed or info.executed:
                 return
@@ -848,6 +886,9 @@ class Replica(IReceiver):
                     continue
                 if req.flags & m.RequestFlag.INTERNAL:
                     reply = self._execute_internal_request(req)
+                elif req.flags & m.RequestFlag.RECONFIG:
+                    reply = (self.reconfig.execute(self, req, nxt)
+                             if self.reconfig is not None else b"")
                 elif req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
                     from tpubft.preprocessor.preprocessor import (
                         unpack_preprocessed)

@@ -55,11 +55,19 @@ class ReplicasInfo:
     def owner_of_internal_client(self, node: int) -> int:
         return node - self.first_internal_client_id
 
+    @property
+    def operator_id(self) -> int:
+        """The operator principal (reconfiguration commands must carry its
+        signature — reference: operator key validation in
+        reconfiguration/src/reconfiguration_handler.cpp)."""
+        return self.first_internal_client_id + self.n
+
     def all_client_ids(self) -> list:
-        """External client principals + one internal client per replica."""
+        """External clients + one internal client per replica + operator."""
         return (list(range(self.first_client_id,
                            self.first_client_id + self.num_clients))
-                + [self.internal_client_of(r) for r in self.replica_ids])
+                + [self.internal_client_of(r) for r in self.replica_ids]
+                + [self.operator_id])
 
     def other_replicas(self, me: int) -> list:
         return [r for r in self.replica_ids if r != me]

@@ -59,6 +59,12 @@ class KvbcReplica:
                                                    self.blockchain,
                                                    reserved_pages=pages)
         self.replica.set_state_transfer(self.state_transfer)
+        from tpubft.reconfiguration.dispatcher import standard_dispatcher
+        ckpt_dir = (os.path.join(os.path.dirname(db_path), "db_checkpoints")
+                    if db_path else "db_checkpoints")
+        self.replica.set_reconfiguration(standard_dispatcher(
+            blockchain=self.blockchain, db=self.db,
+            db_checkpoint_dir=ckpt_dir))
 
     def start(self) -> None:
         self.replica.start()

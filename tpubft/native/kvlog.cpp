@@ -275,4 +275,19 @@ int kvlog_sync(KvLog* db) {
   return fsync(db->fd) == 0 ? 0 : -1;
 }
 
+// Write a consistent snapshot of the live set to `path` (operator DB
+// checkpoints — the RocksDB-checkpoint role of DbCheckpointManager).
+int kvlog_checkpoint(KvLog* db, const char* path) {
+  std::lock_guard<std::mutex> g(db->mu);
+  auto payload = snapshot_payload(db);
+  std::string tmp = std::string(path) + ".tmp";
+  int fd = ::open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  bool ok = write_record(fd, payload.data(), (uint32_t)payload.size(), true);
+  ::close(fd);
+  if (!ok) return -1;
+  if (rename(tmp.c_str(), path) != 0) return -1;
+  return 0;
+}
+
 }  // extern "C"

@@ -35,6 +35,9 @@ class PreProcessor:
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="preexec")
         self._sessions: Dict[Tuple[int, int], _Session] = {}
+        # backup-side reply cache: (client, req_seq, retry_id) -> packed
+        # PreProcessReplyMsg — rebroadcasts must not re-execute the app
+        self._reply_cache: Dict[Tuple[int, int, int], bytes] = {}
         self._retry_counter = 0
         replica.dispatcher.register_internal("preexec", self._on_internal)
         replica.dispatcher.add_timer(1.0, self._expire_sessions)
@@ -127,7 +130,11 @@ class PreProcessor:
                 sender_id=self.replica.id, client_id=key[0],
                 req_seq_num=key[1], retry_id=retry_id,
                 result_digest=digest, status=status, signature=sig)
-            self.replica.comm.send(reply_to, reply.pack())
+            raw = reply.pack()
+            self._reply_cache[(key[0], key[1], retry_id)] = raw
+            if len(self._reply_cache) > 512:
+                self._reply_cache.pop(next(iter(self._reply_cache)))
+            self.replica.comm.send(reply_to, raw)
 
     # ------------------------------------------------------------------
     # backup side
@@ -135,6 +142,11 @@ class PreProcessor:
     def on_preprocess_request(self, sender: int,
                               msg: m.PreProcessRequestMsg) -> None:
         if sender != self.replica.primary:
+            return
+        cached = self._reply_cache.get((msg.client_id, msg.req_seq_num,
+                                        msg.retry_id))
+        if cached is not None:
+            self.replica.comm.send(sender, cached)
             return
         try:
             req = m.unpack(msg.request)

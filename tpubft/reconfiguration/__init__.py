@@ -1,0 +1,32 @@
+"""Reconfiguration — operator-driven cluster control through consensus.
+
+Rebuild of /root/reference/reconfiguration/ (dispatcher.cpp,
+reconfiguration_handler.cpp) + the control plumbing it drives:
+ControlStateManager/EpochManager wedging (include/bftengine/EpochManager.hpp),
+consensus-coordinated pruning (kvbc pruning_handler.cpp), operator DB
+checkpoints (DbCheckpointManager), targeted key exchange, and
+add/remove-with-wedge scale changes.
+
+Commands are ordered as RECONFIG-flagged client requests signed by the
+operator principal; execution dispatches through a handler chain, so the
+same command runs identically on every replica at the same sequence
+point.
+"""
+from tpubft.reconfiguration.dispatcher import (IReconfigurationHandler,
+                                               ReconfigurationDispatcher)
+from tpubft.reconfiguration.messages import (AddRemoveWithWedgeCommand,
+                                             DbCheckpointCommand,
+                                             GetStatusCommand,
+                                             KeyExchangeCommand,
+                                             PruneRequest, ReconfigReply,
+                                             RestartCommand, UnwedgeCommand,
+                                             WedgeCommand, pack_command,
+                                             unpack_command)
+from tpubft.reconfiguration.operator_client import OperatorClient
+
+__all__ = ["ReconfigurationDispatcher", "IReconfigurationHandler",
+           "WedgeCommand", "UnwedgeCommand", "PruneRequest",
+           "KeyExchangeCommand", "AddRemoveWithWedgeCommand",
+           "DbCheckpointCommand", "RestartCommand", "GetStatusCommand",
+           "ReconfigReply", "pack_command", "unpack_command",
+           "OperatorClient"]

@@ -1,0 +1,104 @@
+"""Reconfiguration command set (reference: CMF ReconfigurationRequest
+oneof in the reconfiguration .cmf definitions — WedgeCommand,
+PruneRequest, KeyExchangeCommand, AddRemoveWithWedgeCommand,
+RestartCommand, db_checkpoint_msg.cmf)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from tpubft.utils import serialize as ser
+
+
+@dataclass
+class WedgeCommand:
+    """Stop ordering at the next checkpoint boundary (reference
+    WedgeCommand → ControlStateManager stop point)."""
+    ID = 1
+    stop_seq: int = 0  # 0 = next checkpoint boundary after execution seq
+    SPEC = [("stop_seq", "u64")]
+
+
+@dataclass
+class UnwedgeCommand:
+    ID = 2
+    SPEC = []
+
+
+@dataclass
+class PruneRequest:
+    """Consensus-coordinated deletion of old blocks (kvbc pruning)."""
+    ID = 3
+    until_block: int = 0
+    SPEC = [("until_block", "u64")]
+
+
+@dataclass
+class KeyExchangeCommand:
+    """Ask target replicas to rotate their signing keys."""
+    ID = 4
+    targets: List[int] = field(default_factory=list)  # empty = all
+    SPEC = [("targets", ("list", "u32"))]
+
+
+@dataclass
+class AddRemoveWithWedgeCommand:
+    """Record a new cluster configuration and wedge; operators restart
+    replicas with the new config (reference AddRemoveWithWedgeCommand)."""
+    ID = 5
+    config_descriptor: str = ""
+    SPEC = [("config_descriptor", "str")]
+
+
+@dataclass
+class RestartCommand:
+    """Signal replicas to restart once wedged (reference RestartCommand /
+    ReplicaRestartReady flow)."""
+    ID = 6
+    SPEC = []
+
+
+@dataclass
+class DbCheckpointCommand:
+    """Operator-triggered DB snapshot (reference DbCheckpointManager)."""
+    ID = 7
+    checkpoint_id: str = ""
+    SPEC = [("checkpoint_id", "str")]
+
+
+@dataclass
+class GetStatusCommand:
+    """Read-only status query (wedge state, genesis, last block)."""
+    ID = 8
+    SPEC = []
+
+
+@dataclass
+class ReconfigReply:
+    success: bool = False
+    data: str = ""
+    SPEC = [("success", "bool"), ("data", "str")]
+
+
+_TYPES = {cls.ID: cls for cls in
+          (WedgeCommand, UnwedgeCommand, PruneRequest, KeyExchangeCommand,
+           AddRemoveWithWedgeCommand, RestartCommand, DbCheckpointCommand,
+           GetStatusCommand)}
+
+
+def pack_command(cmd) -> bytes:
+    return bytes([cmd.ID]) + ser.encode_msg(cmd)
+
+
+def unpack_command(data: bytes):
+    if not data or data[0] not in _TYPES:
+        raise ser.SerializeError(f"unknown reconfig command {data[:1]!r}")
+    return ser.decode_msg(data[1:], _TYPES[data[0]])
+
+
+def pack_reply(reply: ReconfigReply) -> bytes:
+    return ser.encode_msg(reply)
+
+
+def unpack_reply(data: bytes) -> ReconfigReply:
+    return ser.decode_msg(data, ReconfigReply)

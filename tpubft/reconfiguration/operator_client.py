@@ -1,0 +1,69 @@
+"""Operator client — submits reconfiguration commands through consensus
+(reference: the operator tooling driving reconfiguration requests, e.g.
+concord-ctl / apollo's operator helper)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from tpubft.consensus.messages import RequestFlag
+from tpubft.reconfiguration import messages as rm
+
+
+class OperatorClient:
+    """Wraps a BftClient whose client_id is the operator principal."""
+
+    def __init__(self, bft_client) -> None:
+        self._client = bft_client
+
+    def send(self, cmd, timeout_ms: Optional[int] = None,
+             quorum=None) -> rm.ReconfigReply:
+        kwargs = {"timeout_ms": timeout_ms}
+        if quorum is not None:
+            kwargs["quorum"] = quorum
+        raw = self._client._send(rm.pack_command(cmd),
+                                 flags=int(RequestFlag.RECONFIG),
+                                 quorum=kwargs.get("quorum")
+                                 or self._default_quorum(),
+                                 timeout_ms=timeout_ms)
+        return rm.unpack_reply(raw)
+
+    def _default_quorum(self):
+        from tpubft.bftclient.client import Quorum
+        return Quorum.LINEARIZABLE
+
+    def send_direct(self, cmd, timeout_ms: Optional[int] = None
+                    ) -> rm.ReconfigReply:
+        """Non-ordered operator command delivered to every replica
+        directly (READ_ONLY|RECONFIG) — required for unwedge/status on a
+        cluster that can no longer order requests."""
+        from tpubft.bftclient.client import Quorum
+        raw = self._client._send(
+            rm.pack_command(cmd),
+            flags=int(RequestFlag.RECONFIG) | int(RequestFlag.READ_ONLY),
+            quorum=Quorum.ALL, timeout_ms=timeout_ms)
+        return rm.unpack_reply(raw)
+
+    # conveniences
+    def wedge(self, stop_seq: int = 0, **kw) -> rm.ReconfigReply:
+        return self.send(rm.WedgeCommand(stop_seq=stop_seq), **kw)
+
+    def unwedge(self, timeout_ms: Optional[int] = None) -> rm.ReconfigReply:
+        return self.send_direct(rm.UnwedgeCommand(), timeout_ms=timeout_ms)
+
+    def prune(self, until_block: int, **kw) -> rm.ReconfigReply:
+        return self.send(rm.PruneRequest(until_block=until_block), **kw)
+
+    def key_exchange(self, targets=None, **kw) -> rm.ReconfigReply:
+        return self.send(rm.KeyExchangeCommand(targets=targets or []), **kw)
+
+    def db_checkpoint(self, checkpoint_id: str, **kw) -> rm.ReconfigReply:
+        return self.send(rm.DbCheckpointCommand(
+            checkpoint_id=checkpoint_id), **kw)
+
+    def add_remove_with_wedge(self, config_descriptor: str,
+                              **kw) -> rm.ReconfigReply:
+        return self.send(rm.AddRemoveWithWedgeCommand(
+            config_descriptor=config_descriptor), **kw)
+
+    def status(self, **kw) -> rm.ReconfigReply:
+        return self.send(rm.GetStatusCommand(), **kw)

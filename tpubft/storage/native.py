@@ -41,6 +41,8 @@ def _lib():
     lib.kvlog_compact.argtypes = [ctypes.c_void_p]
     lib.kvlog_sync.restype = ctypes.c_int
     lib.kvlog_sync.argtypes = [ctypes.c_void_p]
+    lib.kvlog_checkpoint.restype = ctypes.c_int
+    lib.kvlog_checkpoint.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib._kvlog_typed = True
     return lib
 
@@ -130,6 +132,14 @@ class NativeDB(IDBClient):
         rc = self._lib.kvlog_compact(self._handle())
         if rc != 0:
             raise StorageError(f"kvlog_compact rc={rc}")
+
+    def checkpoint_to(self, path: str) -> None:
+        """Consistent snapshot for operator backups (reference:
+        DbCheckpointManager RocksDB checkpoints). The snapshot file is a
+        valid kvlog — openable with NativeDB directly."""
+        rc = self._lib.kvlog_checkpoint(self._handle(), path.encode())
+        if rc != 0:
+            raise StorageError(f"kvlog_checkpoint rc={rc}")
 
     def count(self) -> int:
         return self._lib.kvlog_count(self._handle())

@@ -65,6 +65,8 @@ class InProcessCluster:
             rep.set_state_transfer(StateTransferManager(
                 r, bc, StConfig(retry_timeout_s=0.3),
                 reserved_pages=rep.res_pages))
+        from tpubft.reconfiguration.dispatcher import standard_dispatcher
+        rep.set_reconfiguration(standard_dispatcher(blockchain=bc))
         self.replicas[r] = rep
         return rep
 
@@ -79,6 +81,22 @@ class InProcessCluster:
         for rep in self.replicas.values():
             rep.stop()
         self.bus.shutdown()
+
+    def operator_client(self, **cfg_kw):
+        """BFT client bound to the operator principal + reconfiguration
+        command helpers."""
+        from tpubft.reconfiguration import OperatorClient
+        info = next(iter(self.replicas.values())).info
+        op_id = info.operator_id
+        cl = self.clients.get(op_id)
+        if cl is None:
+            cfg = ClientConfig(client_id=op_id, f_val=self.f,
+                               c_val=self.c, **cfg_kw)
+            cl = BftClient(cfg, self.keys.for_node(op_id),
+                           self.bus.create(op_id))
+            self.clients[op_id] = cl
+        cl.start()
+        return OperatorClient(cl)
 
     def client(self, idx: int = 0, **cfg_kw) -> BftClient:
         client_id = self.n + idx
