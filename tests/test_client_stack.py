@@ -1,0 +1,142 @@
+"""Client-stack tests: client pool concurrency, concord client facade,
+clientservice gateway, client reconfiguration engine polling
+(reference model: client_pool tests, concordclient tests, CRE tests)."""
+import socket
+import threading
+import time
+
+import pytest
+
+from tpubft.apps import counter, skvbc
+from tpubft.bftclient import BftClient, ClientConfig
+from tpubft.bftclient.pool import ClientPool, ClientPoolBusy
+from tpubft.client import ClientReconfigurationEngine, ConcordClient
+from tpubft.client import clientservice as cs
+from tpubft.kvbc import KeyValueBlockchain
+from tpubft.storage import MemoryDB
+from tpubft.testing.cluster import InProcessCluster
+
+
+def _skvbc_factory(_r=None):
+    return skvbc.SkvbcHandler(
+        KeyValueBlockchain(MemoryDB(), use_device_hashing=False))
+
+
+def _pool(cluster, count=2) -> ClientPool:
+    clients = [cluster.client(i) for i in range(count)]
+    return ClientPool(clients)
+
+
+@pytest.mark.slow
+def test_client_pool_concurrent_writes():
+    with InProcessCluster(f=1, num_clients=3) as cluster:
+        pool = _pool(cluster, count=3)
+        futures = [pool.submit_write(counter.encode_add(1))
+                   for _ in range(3)]
+        # all identities in flight -> busy
+        with pytest.raises(ClientPoolBusy):
+            pool.submit_write(counter.encode_add(1))
+        results = [counter.decode_reply(f.result(timeout=10))
+                   for f in futures]
+        assert sorted(results) == [1, 2, 3]
+        # identities returned to the pool: next write succeeds
+        assert counter.decode_reply(
+            pool.write(counter.encode_add(1))) == 4
+
+
+@pytest.mark.slow
+def test_cre_observes_wedge():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory) as cluster:
+        client = cluster.client(0)
+        client.start()
+        cre = ClientReconfigurationEngine(client)
+        seen = []
+        cre.register_handler(seen.append)
+        state = cre.poll_once()
+        assert state is not None and state.wedge_point is None
+        # second poll with unchanged state: no new dispatch
+        assert cre.poll_once() is None
+        op = cluster.operator_client()
+        reply = op.wedge(timeout_ms=8000)
+        assert reply.success
+        deadline = time.monotonic() + 5
+        state2 = None
+        while time.monotonic() < deadline and state2 is None:
+            state2 = cre.poll_once()
+            time.sleep(0.1)
+        assert state2 is not None
+        assert state2.wedge_point == int(reply.data)
+        assert len(seen) == 2
+
+
+@pytest.mark.slow
+def test_reconfig_commands_recorded_on_chain():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory) as cluster:
+        op = cluster.operator_client()
+        assert op.key_exchange(targets=[1], timeout_ms=8000).success
+        time.sleep(0.2)
+        from tpubft.kvbc.categories import get_tagged
+        for h in cluster.handlers.values():
+            recs = get_tagged(h.blockchain._db, "reconfig", "reconfig")
+            assert len(recs) == 1
+            from tpubft.reconfiguration import messages as rm
+            cmd = rm.unpack_command(recs[0][1])
+            assert isinstance(cmd, rm.KeyExchangeCommand)
+
+
+@pytest.mark.slow
+def test_clientservice_gateway():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                          num_clients=3) as cluster:
+        pool = _pool(cluster, count=2)
+        service = cs.ClientService(pool)
+        service.start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", service.port),
+                                            timeout=5)
+            sock.sendall(cs.pack(cs.WriteRequest(
+                payload=skvbc.pack(skvbc.WriteRequest(
+                    writeset=[(b"svc", b"1")])))))
+            body = cs.read_frame(sock)
+            reply = cs.unpack_body(body)
+            assert reply.success
+            w = skvbc.unpack(reply.payload)
+            assert w.success and w.latest_block == 1
+
+            sock.sendall(cs.pack(cs.ReadRequest(
+                payload=skvbc.pack(skvbc.ReadRequest(keys=[b"svc"])))))
+            reply = cs.unpack_body(cs.read_frame(sock))
+            assert dict(skvbc.unpack(reply.payload).reads) == {b"svc": b"1"}
+            sock.close()
+        finally:
+            service.stop()
+
+
+@pytest.mark.slow
+def test_concord_client_facade_with_events():
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory) as cluster:
+        # thin-replica servers over each replica's blockchain
+        from tpubft.thinreplica import FilterSpec, ThinReplicaServer
+        servers = []
+        for h in cluster.handlers.values():
+            s = ThinReplicaServer(h.blockchain, FilterSpec(category="kv"))
+            s.start()
+            servers.append(s)
+        try:
+            client = cluster.client(0)
+            client.start()
+            cc = ConcordClient(client,
+                               trs_endpoints=[("127.0.0.1", s.port)
+                                              for s in servers], f_val=1)
+            got = []
+            evt = threading.Event()
+            cc.subscribe(lambda b, kv: (got.append((b, dict(kv))),
+                                        evt.set()), start_block=1)
+            w = skvbc.unpack(cc.send_write(skvbc.pack(
+                skvbc.WriteRequest(writeset=[(b"ev", b"1")]))))
+            assert w.success
+            assert evt.wait(timeout=10)
+            assert got[0] == (1, {b"ev": b"1"})
+        finally:
+            for s in servers:
+                s.stop()

@@ -81,6 +81,27 @@ def test_wedge_unwedge_and_status():
 
 
 @pytest.mark.slow
+def test_wedge_completes_on_idle_cluster():
+    """No client traffic after the wedge command: the primary must fill
+    seqnums with empty batches so the cluster actually reaches the agreed
+    stop point."""
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                          cfg_overrides=SMALL) as cluster:
+        op = cluster.operator_client()
+        reply = op.wedge(timeout_ms=8000)
+        assert reply.success
+        stop = int(reply.data)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(rep.last_executed >= stop
+                   for rep in cluster.replicas.values()):
+                break
+            time.sleep(0.1)
+        assert all(rep.control.is_wedged(rep.last_executed)
+                   for rep in cluster.replicas.values())
+
+
+@pytest.mark.slow
 def test_prune_through_consensus():
     with InProcessCluster(f=1, handler_factory=_skvbc_factory) as cluster:
         client = cluster.client(0)
@@ -92,9 +113,6 @@ def test_prune_through_consensus():
         reply = op.prune(4, timeout_ms=8000)
         assert reply.success and reply.data == "4"
         time.sleep(0.3)
-        for rep in cluster.replicas.values():
-            bc = rep.handler.blockchain if hasattr(rep.handler, "blockchain") \
-                else None
         gens = {h.blockchain.genesis_block_id
                 for h in cluster.handlers.values()}
         assert gens == {4}

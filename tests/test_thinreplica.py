@@ -1,0 +1,127 @@
+"""Thin-replica streaming tests: state reads with hash quorum, live
+subscription with f-hash verification, catch-up from history, forged-
+server detection (reference model: thin-replica-server/test +
+thin-replica-client tests)."""
+import threading
+import time
+
+import pytest
+
+from tpubft.kvbc import VERSIONED_KV, BlockUpdates, KeyValueBlockchain
+from tpubft.storage import MemoryDB
+from tpubft.thinreplica import FilterSpec, ThinReplicaClient, ThinReplicaServer
+from tpubft.thinreplica import messages as tm
+
+
+def _chain_with(n_blocks: int) -> KeyValueBlockchain:
+    bc = KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+    for i in range(n_blocks):
+        bu = BlockUpdates().put("kv", f"key-{i}".encode(),
+                                f"val-{i}".encode())
+        bu.put("other", b"hidden", b"x")  # filtered out
+        bc.add_block(bu)
+    return bc
+
+
+def _servers(chains, n=3):
+    servers = []
+    for bc in chains:
+        s = ThinReplicaServer(bc, FilterSpec(category="kv"))
+        s.start()
+        servers.append(s)
+    return servers
+
+
+def test_update_hash_canonical():
+    kv = [(b"b", b"2"), (b"a", b"1")]
+    assert tm.update_hash(5, kv) == tm.update_hash(5, list(reversed(kv)))
+    assert tm.update_hash(5, kv) != tm.update_hash(6, kv)
+    assert tm.update_hash(5, kv) != tm.update_hash(5, [(b"a", b"1")])
+
+
+def test_read_state_with_hash_quorum():
+    chains = [_chain_with(4) for _ in range(3)]
+    servers = _servers(chains)
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        state = trc.read_state()
+        assert state == {f"key-{i}".encode(): f"val-{i}".encode()
+                         for i in range(4)}
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_read_state_detects_forged_data_server():
+    honest = [_chain_with(3) for _ in range(2)]
+    forged = _chain_with(3)
+    forged.add_block(BlockUpdates().put("kv", b"evil", b"1"))
+    servers = _servers([forged] + honest)
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        with pytest.raises(ValueError):
+            trc.read_state()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_live_subscription_and_catchup():
+    chains = [_chain_with(3) for _ in range(3)]
+    servers = _servers(chains)
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        got = []
+        evt = threading.Event()
+
+        def cb(block_id, kv):
+            got.append((block_id, dict(kv)))
+            if block_id >= 5:
+                evt.set()
+        trc.subscribe(cb, start_block=1)
+        # give catch-up a moment, then commit new blocks on every replica
+        time.sleep(0.5)
+        for i in (3, 4):
+            for bc in chains:
+                bc.add_block(BlockUpdates().put(
+                    "kv", f"live-{i}".encode(), str(i).encode()))
+        assert evt.wait(timeout=10), f"only got {got}"
+        blocks = [b for b, _ in got]
+        assert blocks == sorted(blocks)  # in-order delivery
+        assert (1, {b"key-0": b"val-0"}) == got[0]
+        assert got[-1][1] == {b"live-4": b"4"}
+        trc.stop()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_subscription_rejects_unconfirmed_updates():
+    """Data server diverges mid-stream: updates without f matching hashes
+    are never delivered."""
+    honest = [_chain_with(2) for _ in range(2)]
+    lying = _chain_with(2)
+    servers = _servers([lying] + honest)
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        got = []
+        trc.subscribe(lambda b, kv: got.append(b), start_block=1)
+        time.sleep(0.5)
+        assert got == [1, 2]  # agreed prefix delivered
+        # only the data server commits block 3
+        lying.add_block(BlockUpdates().put("kv", b"fake", b"x"))
+        time.sleep(0.8)
+        assert got == [1, 2]  # unconfirmed block withheld
+        # honest servers commit a DIFFERENT block 3: hashes never match
+        for bc in honest:
+            bc.add_block(BlockUpdates().put("kv", b"real", b"y"))
+        time.sleep(0.8)
+        assert got == [1, 2]
+        trc.stop()
+    finally:
+        for s in servers:
+            s.stop()

@@ -38,7 +38,8 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     db_path = (os.path.join(args.db_dir, f"replica-{args.replica}.kvlog")
                if args.db_dir else None)
     agg = Aggregator()
-    return KvbcReplica(cfg, keys, comm, db_path=db_path, aggregator=agg)
+    return KvbcReplica(cfg, keys, comm, db_path=db_path, aggregator=agg,
+                       thin_replica_port=args.trs_port)
 
 
 def make_parser() -> argparse.ArgumentParser:
@@ -49,6 +50,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--clients", type=int, default=4)
     p.add_argument("--base-port", type=int, default=3710)
     p.add_argument("--metrics-port", type=int, default=0)
+    p.add_argument("--trs-port", type=int, default=None,
+                   help="thin-replica streaming port (0 = ephemeral)")
     p.add_argument("--db-dir", default=None)
     p.add_argument("--seed", default="tpubft-skvbc")
     p.add_argument("--view-change-timeout-ms", type=int, default=4000)

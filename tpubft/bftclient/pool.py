@@ -1,0 +1,66 @@
+"""Client connection pool for high-throughput gateways.
+
+Rebuild of /root/reference/client/client_pool/ (concord_client_pool.cpp):
+a fixed set of BFT client identities checked out per request, so many
+application threads can have writes in flight concurrently (each BFT
+client identity allows one outstanding request at a time — the pool is
+how the reference scales past that).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+from tpubft.bftclient.client import BftClient
+
+
+class ClientPoolBusy(Exception):
+    pass
+
+
+class ClientPool:
+    def __init__(self, clients: List[BftClient],
+                 max_workers: Optional[int] = None) -> None:
+        if not clients:
+            raise ValueError("empty client pool")
+        self._clients: "queue.Queue[BftClient]" = queue.Queue()
+        for c in clients:
+            c.start()
+            self._clients.put(c)
+        self._all = clients
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers or len(clients),
+            thread_name_prefix="client-pool")
+
+    def submit_write(self, request: bytes, timeout_ms: Optional[int] = None,
+                     pre_process: bool = False) -> Future:
+        """Async write through the next free client identity; raises
+        ClientPoolBusy when all identities are in flight
+        (reference: SubmitRequest overload behavior)."""
+        try:
+            client = self._clients.get_nowait()
+        except queue.Empty:
+            raise ClientPoolBusy("all pool clients in flight") from None
+
+        def run():
+            try:
+                return client.send_write(request, timeout_ms=timeout_ms,
+                                         pre_process=pre_process)
+            finally:
+                self._clients.put(client)
+        return self._pool.submit(run)
+
+    def write(self, request: bytes,
+              timeout_ms: Optional[int] = None) -> bytes:
+        return self.submit_write(request, timeout_ms=timeout_ms).result()
+
+    @property
+    def size(self) -> int:
+        return len(self._all)
+
+    def stop(self) -> None:
+        self._pool.shutdown(wait=True)
+        for c in self._all:
+            c.stop()

@@ -1,0 +1,13 @@
+"""Unified client stack.
+
+Rebuild of /root/reference/client/concordclient + clientservice +
+client reconfiguration engine (CRE): one facade object combining the
+write path (BftClient/ClientPool) with the event-subscription path
+(ThinReplicaClient), a standalone TCP service exposing those to non-
+framework applications, and a polling engine reacting to on-chain
+reconfiguration state.
+"""
+from tpubft.client.concord_client import ConcordClient
+from tpubft.client.cre import ClientReconfigurationEngine
+
+__all__ = ["ConcordClient", "ClientReconfigurationEngine"]

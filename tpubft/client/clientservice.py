@@ -1,0 +1,196 @@
+"""Clientservice — standalone gateway exposing the BFT request + event
+API to non-framework applications over framed TCP.
+
+Rebuild of /root/reference/client/clientservice/ (client_service.cpp,
+request_service, event_service — gRPC there, the framework's framed-TCP
+codec here): applications that don't link tpubft connect to this service;
+writes go through a ClientPool, event subscriptions are proxied from the
+verified thin-replica client stream.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from tpubft.bftclient.pool import ClientPool
+from tpubft.thinreplica.client import ThinReplicaClient
+from tpubft.utils import serialize as ser
+
+
+# ---- service wire messages ----
+
+@dataclass
+class WriteRequest:
+    ID = 1
+    payload: bytes = b""
+    pre_process: bool = False
+    SPEC = [("payload", "bytes"), ("pre_process", "bool")]
+
+
+@dataclass
+class ReadRequest:
+    ID = 2
+    payload: bytes = b""
+    SPEC = [("payload", "bytes")]
+
+
+@dataclass
+class SubscribeRequest:
+    ID = 3
+    start_block: int = 1
+    key_prefix: bytes = b""
+    SPEC = [("start_block", "u64"), ("key_prefix", "bytes")]
+
+
+@dataclass
+class Reply:
+    ID = 4
+    success: bool = True
+    payload: bytes = b""
+    SPEC = [("success", "bool"), ("payload", "bytes")]
+
+
+@dataclass
+class Event:
+    ID = 5
+    block_id: int = 0
+    kv: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    SPEC = [("block_id", "u64"),
+            ("kv", ("list", ("pair", "bytes", "bytes")))]
+
+
+_TYPES = {cls.ID: cls for cls in
+          (WriteRequest, ReadRequest, SubscribeRequest, Reply, Event)}
+
+
+def pack(msg) -> bytes:
+    body = bytes([msg.ID]) + ser.encode_msg(msg)
+    return struct.pack("<I", len(body)) + body
+
+
+def unpack_body(body: bytes):
+    if not body or body[0] not in _TYPES:
+        raise ser.SerializeError(f"unknown service msg id {body[:1]!r}")
+    return ser.decode_msg(body[1:], _TYPES[body[0]])
+
+
+def read_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    if n > 1 << 22:
+        return None
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        if not chunk:
+            return None
+        body += chunk
+    return body
+
+
+class ClientService:
+    def __init__(self, pool: ClientPool,
+                 trs_endpoints: Optional[List[Tuple[str, int]]] = None,
+                 f_val: int = 1,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self._pool = pool
+        self._trs = trs_endpoints or []
+        self._f = f_val
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._running = False
+
+    def start(self) -> None:
+        self._running = True
+        self._sock.listen(32)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"clientservice-{self.port}").start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pool.stop()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while self._running:
+                body = read_frame(conn)
+                if body is None:
+                    return
+                req = unpack_body(body)
+                if isinstance(req, WriteRequest):
+                    try:
+                        reply = self._pool.write(req.payload)
+                        conn.sendall(pack(Reply(success=True,
+                                                payload=reply)))
+                    except Exception:  # noqa: BLE001
+                        conn.sendall(pack(Reply(success=False)))
+                elif isinstance(req, ReadRequest):
+                    try:
+                        client = self._pool._all[0]
+                        reply = client.send_read(req.payload)
+                        conn.sendall(pack(Reply(success=True,
+                                                payload=reply)))
+                    except Exception:  # noqa: BLE001
+                        conn.sendall(pack(Reply(success=False)))
+                elif isinstance(req, SubscribeRequest):
+                    self._serve_subscription(conn, req)
+                    return
+        except Exception:  # noqa: BLE001 — connection teardown
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_subscription(self, conn: socket.socket,
+                            req: SubscribeRequest) -> None:
+        if not self._trs:
+            conn.sendall(pack(Reply(success=False)))
+            return
+        trc = ThinReplicaClient(self._trs, self._f,
+                                key_prefix=req.key_prefix)
+        done = threading.Event()
+
+        def cb(block_id, kv):
+            try:
+                conn.sendall(pack(Event(block_id=block_id, kv=kv)))
+            except OSError:
+                done.set()
+        trc.subscribe(cb, start_block=req.start_block)
+        while self._running and not done.is_set():
+            done.wait(timeout=0.5)
+            # detect client hangup by probing the socket
+            try:
+                conn.settimeout(0.01)
+                probe = conn.recv(1)
+                if probe == b"":
+                    break
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+        trc.stop()

@@ -1,0 +1,45 @@
+"""ConcordClient — writes + verified event subscription in one facade
+(reference client/concordclient/concord_client.cpp)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpubft.bftclient.client import BftClient
+from tpubft.thinreplica.client import Endpoint, ThinReplicaClient
+
+
+class ConcordClient:
+    def __init__(self, bft_client: BftClient,
+                 trs_endpoints: Optional[List[Endpoint]] = None,
+                 f_val: int = 1) -> None:
+        self._client = bft_client
+        self._trc: Optional[ThinReplicaClient] = None
+        self._trs_endpoints = trs_endpoints or []
+        self._f = f_val
+
+    # ---- write path ----
+    def send_write(self, request: bytes, **kw) -> bytes:
+        return self._client.send_write(request, **kw)
+
+    def send_read(self, request: bytes, **kw) -> bytes:
+        return self._client.send_read(request, **kw)
+
+    # ---- event path ----
+    def subscribe(self, callback: Callable[[int, List[Tuple[bytes, bytes]]],
+                                           None],
+                  start_block: int = 1, key_prefix: bytes = b"") -> None:
+        if not self._trs_endpoints:
+            raise ValueError("no thin-replica endpoints configured")
+        self._trc = ThinReplicaClient(self._trs_endpoints, self._f,
+                                      key_prefix=key_prefix)
+        self._trc.subscribe(callback, start_block=start_block)
+
+    def read_state(self, key_prefix: bytes = b"") -> Dict[bytes, bytes]:
+        trc = ThinReplicaClient(self._trs_endpoints, self._f,
+                                key_prefix=key_prefix)
+        return trc.read_state()
+
+    def stop(self) -> None:
+        if self._trc is not None:
+            self._trc.stop()
+        self._client.stop()

@@ -1,0 +1,90 @@
+"""Client Reconfiguration Engine (CRE).
+
+Rebuild of /root/reference/client/reconfiguration/
+(client_reconfiguration_engine.cpp, poll_based_state_client.cpp): a
+client-side polling loop watching consensus state for operator commands
+that target clients (wedge status before restarts, config-descriptor
+changes from add/remove, key rotations), dispatching them to registered
+handlers exactly once per observed change.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from tpubft.consensus.messages import RequestFlag
+from tpubft.reconfiguration import messages as rm
+
+
+@dataclass
+class ClusterControlState:
+    wedge_point: Optional[int]
+    restart_ready: bool
+    raw: str
+
+
+def _parse_status(data: str) -> ClusterControlState:
+    fields = dict(part.split("=", 1) for part in data.split()
+                  if "=" in part)
+    wp = fields.get("wedge_point")
+    return ClusterControlState(
+        wedge_point=None if wp in (None, "None") else int(wp),
+        restart_ready=fields.get("restart_ready") == "True",
+        raw=data)
+
+
+class ClientReconfigurationEngine:
+    """Polls the cluster's control state through the read-only status
+    command (open to any client — reference poll_based_state_client); on
+    every observed change, handlers run once."""
+
+    def __init__(self, bft_client, poll_period_s: float = 1.0) -> None:
+        self._client = bft_client
+        self._period = poll_period_s
+        self._handlers: List[Callable[[ClusterControlState], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_raw: Optional[str] = None
+
+    def register_handler(self,
+                         fn: Callable[[ClusterControlState], None]) -> None:
+        self._handlers.append(fn)
+
+    def poll_once(self) -> Optional[ClusterControlState]:
+        from tpubft.bftclient.client import Quorum
+        try:
+            raw = self._client._send(
+                rm.pack_command(rm.GetStatusCommand()),
+                flags=int(RequestFlag.RECONFIG)
+                | int(RequestFlag.READ_ONLY),
+                quorum=Quorum.BYZANTINE_SAFE, timeout_ms=2000)
+            reply = rm.unpack_reply(raw)
+        except Exception:  # noqa: BLE001 — poll failures are retried
+            return None
+        if not reply.success:
+            return None
+        if reply.data == self._last_raw:
+            return None
+        self._last_raw = reply.data
+        state = _parse_status(reply.data)
+        for fn in self._handlers:
+            try:
+                fn(state)
+            except Exception:  # noqa: BLE001
+                pass
+        return state
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cre-poll")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._period):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()

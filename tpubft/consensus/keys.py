@@ -43,6 +43,7 @@ class ClusterKeys:
     # private: only for this node
     my_id: Optional[int] = None
     my_sign_seed: Optional[bytes] = None
+    operator_id: Optional[int] = None
     # threshold cryptosystems per commit path (shared public material;
     # secret shares live inside — prune for untrusted serialization)
     slow_path_system: Optional[Cryptosystem] = None
@@ -66,11 +67,13 @@ class ClusterKeys:
         # operator principal (reconfiguration commands): its id must match
         # ReplicasInfo.operator_id, which derives from the CONFIG's client
         # count — not this function's num_clients parameter (callers may
-        # generate extra client keys)
+        # generate extra client keys). Distinct seed label so no client
+        # enumeration can ever mint the operator's keypair.
         operator_id = first_client + cfg.num_of_client_proxies + n
-        s = Ed25519Signer.generate(seed=_derive_seed(seed, "client",
+        s = Ed25519Signer.generate(seed=_derive_seed(seed, "operator",
                                                      operator_id))
         ck.client_pubkeys[operator_id] = s.public_bytes()
+        ck.operator_id = operator_id
         scheme = cfg.threshold_scheme
         ck.slow_path_system = Cryptosystem(
             scheme, 2 * f + c + 1, n, seed=_derive_seed(seed, "slow"))
@@ -83,13 +86,18 @@ class ClusterKeys:
 
     def for_node(self, node_id: int) -> "ClusterKeys":
         """This node's private view (sign seed derivation)."""
-        kind = "replica" if node_id < self.n else "client"
+        if node_id == self.operator_id:
+            kind = "operator"
+        elif node_id < self.n:
+            kind = "replica"
+        else:
+            kind = "client"
         me = ClusterKeys(
             n=self.n, f=self.f, c=self.c,
             threshold_scheme=self.threshold_scheme,
             replica_pubkeys=self.replica_pubkeys,
             client_pubkeys=self.client_pubkeys,
-            my_id=node_id,
+            my_id=node_id, operator_id=self.operator_id,
             my_sign_seed=_derive_seed(self._seed, kind, node_id),
             slow_path_system=self.slow_path_system,
             commit_path_system=self.commit_path_system,

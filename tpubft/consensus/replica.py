@@ -444,8 +444,11 @@ class Replica(IReceiver):
         if bool(req.flags & m.RequestFlag.INTERNAL) \
                 != self.info.is_internal_client(client):
             return
-        # RECONFIG commands only from the operator principal
+        # RECONFIG: ordered (mutating) commands only from the operator;
+        # the read-only path is open to any valid client (status polling —
+        # the dispatcher enforces per-command authorization)
         if req.flags & m.RequestFlag.RECONFIG \
+                and not req.flags & m.RequestFlag.READ_ONLY \
                 and client != self.info.operator_id:
             return
         # HAS_PRE_PROCESSED may only be minted by the preprocessor (it
@@ -514,8 +517,14 @@ class Replica(IReceiver):
     # primary: batching + PrePrepare (ReplicaImp.cpp:657,865)
     # ------------------------------------------------------------------
     def _try_send_pre_prepare(self) -> None:
-        if not (self._running and self.is_primary and self.pending_requests) \
-                or self.in_view_change:
+        if not self._running or not self.is_primary or self.in_view_change:
+            return
+        # wedge fill: an idle cluster must still REACH the agreed stop
+        # point, so the primary proposes empty batches up to it
+        # (reference: noop fill toward the super-stable checkpoint)
+        wedge_fill = (self.control.wedge_point is not None
+                      and self.primary_next_seq <= self.control.wedge_point)
+        if not self.pending_requests and not wedge_fill:
             return
         seq = self.primary_next_seq
         if seq > self.last_stable + self.cfg.work_window_size:

@@ -56,6 +56,9 @@ class KeyValueBlockchain:
         self._db = db
         self._use_device = use_device_hashing
         self._trees: Dict[str, SparseMerkleTree] = {}
+        # block-commit listeners (thin-replica publishing; reference:
+        # kvbc Replica feeds SubUpdateBuffers from the commit path)
+        self._listeners: List[Callable[[int, "cat.BlockUpdates"], None]] = []
         last = db.get(_K_LAST, _MISC)
         self._last = int.from_bytes(last, "big") if last else 0
         gen = db.get(_K_GENESIS, _MISC)
@@ -79,6 +82,17 @@ class KeyValueBlockchain:
         return t
 
     # ---- write path ----
+    def add_listener(self,
+                     fn: Callable[[int, "cat.BlockUpdates"], None]) -> None:
+        self._listeners.append(fn)
+
+    def _notify(self, block_id: int, updates: cat.BlockUpdates) -> None:
+        for fn in self._listeners:
+            try:
+                fn(block_id, updates)
+            except Exception:  # noqa: BLE001 — listeners must not break commit
+                pass
+
     def add_block(self, updates: cat.BlockUpdates) -> int:
         block_id = self._last + 1
         wb = WriteBatch()
@@ -87,6 +101,7 @@ class KeyValueBlockchain:
         self._last = block_id
         if self._genesis == 0:
             self._genesis = 1
+        self._notify(block_id, updates)
         return block_id
 
     def _stage_block(self, wb: WriteBatch, block_id: int,
@@ -204,3 +219,4 @@ class KeyValueBlockchain:
             self._last = nxt
             if self._genesis == 0:
                 self._genesis = 1
+            self._notify(nxt, updates)

@@ -40,7 +40,8 @@ class KvbcReplica:
                  db_path: Optional[str] = None,
                  handler_factory=None,
                  aggregator: Optional[Aggregator] = None,
-                 use_device_hashing: bool = False) -> None:
+                 use_device_hashing: bool = False,
+                 thin_replica_port: Optional[int] = None) -> None:
         self.db = open_db(db_path)
         self.blockchain = KeyValueBlockchain(
             self.db, use_device_hashing=use_device_hashing)
@@ -66,9 +67,19 @@ class KvbcReplica:
             blockchain=self.blockchain, db=self.db,
             db_checkpoint_dir=ckpt_dir))
 
+        self.thin_replica_server = None
+        if thin_replica_port is not None:
+            from tpubft.thinreplica import ThinReplicaServer
+            self.thin_replica_server = ThinReplicaServer(
+                self.blockchain, port=thin_replica_port)
+
     def start(self) -> None:
         self.replica.start()
+        if self.thin_replica_server is not None:
+            self.thin_replica_server.start()
 
     def stop(self) -> None:
+        if self.thin_replica_server is not None:
+            self.thin_replica_server.stop()
         self.replica.stop()
         self.db.close()

@@ -285,8 +285,10 @@ int kvlog_checkpoint(KvLog* db, const char* path) {
   if (fd < 0) return -1;
   bool ok = write_record(fd, payload.data(), (uint32_t)payload.size(), true);
   ::close(fd);
-  if (!ok) return -1;
-  if (rename(tmp.c_str(), path) != 0) return -1;
+  if (!ok || rename(tmp.c_str(), path) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
   return 0;
 }
 

@@ -15,6 +15,17 @@ from tpubft.reconfiguration import messages as rm
 from tpubft.utils import serialize as ser
 
 
+def compute_stop_point(seq_num: int, cfg) -> int:
+    """Deterministic wedge stop point that clears the in-flight ordering
+    window: seqs up to last_stable + work_window may already be ordered,
+    and last_stable <= seq_num at execution time — so seq_num +
+    work_window (rounded up to a checkpoint boundary) is safely beyond
+    anything in flight."""
+    w = cfg.checkpoint_window_size
+    floor = seq_num + cfg.work_window_size
+    return ((floor // w) + 1) * w
+
+
 class IReconfigurationHandler:
     """Handler chain element (reference IReconfigurationHandler)."""
 
@@ -38,17 +49,20 @@ class ReconfigurationDispatcher:
     def execute(self, replica, req, seq_num: int,
                 direct: bool = False) -> bytes:
         """Called from the replica execution path for RECONFIG requests.
-        The operator's signature was verified on admission AND in
-        PrePrepare batch validation (client-sig checks); here we enforce
-        the principal."""
-        if req.sender_id != replica.info.operator_id:
-            return rm.pack_reply(rm.ReconfigReply(
-                success=False, data="not the operator"))
+        The sender's signature was verified on admission AND in PrePrepare
+        batch validation (client-sig checks); here we enforce the
+        principal: everything requires the operator except the read-only
+        status query, which any client may poll (the CRE's
+        poll_based_state_client does exactly that in the reference)."""
         try:
             cmd = rm.unpack_command(req.request)
         except ser.SerializeError:
             return rm.pack_reply(rm.ReconfigReply(
                 success=False, data="bad command"))
+        if not isinstance(cmd, rm.GetStatusCommand) \
+                and req.sender_id != replica.info.operator_id:
+            return rm.pack_reply(rm.ReconfigReply(
+                success=False, data="not the operator"))
         if direct and not isinstance(cmd, self.DIRECT_ALLOWED):
             # mutating commands on the unordered path would diverge state
             # (each replica would execute at its own height)
@@ -69,14 +83,8 @@ class WedgeHandler(IReconfigurationHandler):
 
     def handle(self, cmd, seq_num, replica):
         if isinstance(cmd, rm.WedgeCommand):
-            # the stop point must clear the in-flight ordering window:
-            # seqs up to last_stable + work_window may already be ordered,
-            # and last_stable <= seq_num at execution time — so
-            # seq_num + work_window (rounded to a checkpoint boundary) is
-            # both deterministic and safely beyond anything in flight
-            w = replica.cfg.checkpoint_window_size
-            floor = seq_num + replica.cfg.work_window_size
-            stop = max(cmd.stop_seq, ((floor // w) + 1) * w)
+            stop = max(cmd.stop_seq, compute_stop_point(seq_num,
+                                                        replica.cfg))
             replica.control.set_wedge_point(stop)
             return rm.ReconfigReply(success=True, data=str(stop))
         if isinstance(cmd, rm.UnwedgeCommand):
@@ -144,9 +152,7 @@ class AddRemoveWithWedgeHandler(IReconfigurationHandler):
             return None
         replica.res_pages.save(self.CATEGORY, 0,
                                cmd.config_descriptor.encode())
-        w = replica.cfg.checkpoint_window_size
-        floor = seq_num + replica.cfg.work_window_size
-        stop = ((floor // w) + 1) * w
+        stop = compute_stop_point(seq_num, replica.cfg)
         replica.control.set_wedge_point(stop)
         return rm.ReconfigReply(success=True, data=str(stop))
 
@@ -186,8 +192,35 @@ class DbCheckpointHandler(IReconfigurationHandler):
     def _try_checkpoint(fn, path: str) -> None:
         try:
             fn(path)
-        except Exception:  # noqa: BLE001 — best-effort operator backup
-            pass
+        except Exception as e:  # noqa: BLE001 — async: report, don't crash
+            import sys
+            print(f"[tpubft] DB checkpoint to {path} FAILED: {e}",
+                  file=sys.stderr, flush=True)
+
+
+class KvbcRecorderHandler(IReconfigurationHandler):
+    """Records ordered reconfiguration commands on-chain in an immutable
+    category (reference reconfiguration_kvbc_handler.cpp) so clients and
+    late joiners can observe the command history through normal reads /
+    thin-replica streams. Never claims a command — the functional handler
+    further down the chain produces the reply."""
+
+    CATEGORY = "reconfig"
+
+    def __init__(self, blockchain) -> None:
+        self._bc = blockchain
+
+    def handle(self, cmd, seq_num, replica):
+        from tpubft.kvbc import IMMUTABLE, BlockUpdates
+        if isinstance(cmd, (rm.GetStatusCommand, rm.UnwedgeCommand)):
+            return None  # direct-path/read commands are not on-chain
+        bu = BlockUpdates().put(
+            self.CATEGORY, f"cmd-{seq_num}".encode(), rm.pack_command(cmd),
+            cat_type=IMMUTABLE, tags=["reconfig"])
+        # no exception swallowing: a replica whose chain diverges from the
+        # ordered history must fail-stop, not keep running silently wrong
+        self._bc.add_block(bu)
+        return None
 
 
 def standard_dispatcher(blockchain=None, db=None,
@@ -196,6 +229,8 @@ def standard_dispatcher(blockchain=None, db=None,
     """The default handler chain (reference Dispatcher construction in
     kvbc Replica wiring)."""
     d = ReconfigurationDispatcher()
+    if blockchain is not None:
+        d.register(KvbcRecorderHandler(blockchain))
     d.register(WedgeHandler())
     d.register(KeyExchangeHandler())
     d.register(RestartHandler())

@@ -17,19 +17,12 @@ class OperatorClient:
 
     def send(self, cmd, timeout_ms: Optional[int] = None,
              quorum=None) -> rm.ReconfigReply:
-        kwargs = {"timeout_ms": timeout_ms}
-        if quorum is not None:
-            kwargs["quorum"] = quorum
+        from tpubft.bftclient.client import Quorum
         raw = self._client._send(rm.pack_command(cmd),
                                  flags=int(RequestFlag.RECONFIG),
-                                 quorum=kwargs.get("quorum")
-                                 or self._default_quorum(),
+                                 quorum=quorum or Quorum.LINEARIZABLE,
                                  timeout_ms=timeout_ms)
         return rm.unpack_reply(raw)
-
-    def _default_quorum(self):
-        from tpubft.bftclient.client import Quorum
-        return Quorum.LINEARIZABLE
 
     def send_direct(self, cmd, timeout_ms: Optional[int] = None
                     ) -> rm.ReconfigReply:

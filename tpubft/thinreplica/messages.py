@@ -1,0 +1,102 @@
+"""Thin-replica wire protocol (reference proto/thin_replica.proto),
+length-framed over TCP: u32le frame length + id byte + codec body."""
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+from tpubft.utils import serialize as ser
+
+
+@dataclass
+class ReadStateRequest:
+    ID = 1
+    key_prefix: bytes = b""
+    SPEC = [("key_prefix", "bytes")]
+
+
+@dataclass
+class ReadStateHashRequest:
+    ID = 2
+    block_id: int = 0
+    key_prefix: bytes = b""
+    SPEC = [("block_id", "u64"), ("key_prefix", "bytes")]
+
+
+@dataclass
+class SubscribeRequest:
+    ID = 3
+    block_id: int = 1           # first block wanted
+    key_prefix: bytes = b""
+    hashes_only: bool = False
+    SPEC = [("block_id", "u64"), ("key_prefix", "bytes"),
+            ("hashes_only", "bool")]
+
+
+@dataclass
+class UnsubscribeRequest:
+    ID = 4
+    SPEC = []
+
+
+@dataclass
+class Update:
+    ID = 5
+    block_id: int = 0
+    kv: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    SPEC = [("block_id", "u64"),
+            ("kv", ("list", ("pair", "bytes", "bytes")))]
+
+
+@dataclass
+class UpdateHash:
+    ID = 6
+    block_id: int = 0
+    digest: bytes = b""
+    SPEC = [("block_id", "u64"), ("digest", "bytes")]
+
+
+@dataclass
+class StateDone:
+    """End of the ReadState snapshot stream; carries the state hash."""
+    ID = 7
+    block_id: int = 0
+    digest: bytes = b""
+    SPEC = [("block_id", "u64"), ("digest", "bytes")]
+
+
+@dataclass
+class ProtocolError:
+    ID = 8
+    reason: str = ""
+    SPEC = [("reason", "str")]
+
+
+_TYPES = {cls.ID: cls for cls in
+          (ReadStateRequest, ReadStateHashRequest, SubscribeRequest,
+           UnsubscribeRequest, Update, UpdateHash, StateDone,
+           ProtocolError)}
+
+
+def pack(msg) -> bytes:
+    body = bytes([msg.ID]) + ser.encode_msg(msg)
+    return struct.pack("<I", len(body)) + body
+
+
+def unpack_body(body: bytes):
+    if not body or body[0] not in _TYPES:
+        raise ser.SerializeError(f"unknown TRS msg id {body[:1]!r}")
+    return ser.decode_msg(body[1:], _TYPES[body[0]])
+
+
+def update_hash(block_id: int, kv: List[Tuple[bytes, bytes]]) -> bytes:
+    """Canonical per-block update digest (reference kvbc_app_filter
+    event-group hashing): order-independent over the kv set."""
+    h = hashlib.sha256()
+    h.update(struct.pack("<Q", block_id))
+    for k, v in sorted(kv):
+        h.update(struct.pack("<I", len(k)) + k)
+        h.update(struct.pack("<I", len(v)) + v)
+    return h.digest()

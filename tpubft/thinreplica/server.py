@@ -1,0 +1,234 @@
+"""ThinReplicaServer — serves state reads + live update subscriptions.
+
+Rebuild of the reference's ThinReplicaImpl
+(/root/reference/thin-replica-server/include/thin-replica-server/
+thin_replica_impl.hpp:98) + subscription_buffer.hpp: one TCP listener,
+one handler thread per connection; live updates arrive from the
+blockchain's commit listener into per-subscriber bounded buffers; history
+is read from the chain so a subscriber can start at any block and roll
+forward into the live stream without gaps.
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from tpubft.kvbc import categories as cat
+from tpubft.kvbc.blockchain import KeyValueBlockchain
+from tpubft.thinreplica import messages as tm
+
+
+@dataclass
+class FilterSpec:
+    """kvbc_app_filter equivalent: which updates are client-visible."""
+    category: str = "kv"
+    key_prefix: bytes = b""
+
+    def filter_updates(self, updates: cat.BlockUpdates
+                       ) -> List[Tuple[bytes, bytes]]:
+        out = []
+        hit = updates.categories.get(self.category)
+        if hit is None:
+            return out
+        _type, cu = hit
+        for k in sorted(cu.kv):
+            v = cu.kv[k]
+            if v is not None and k.startswith(self.key_prefix):
+                out.append((k, v))
+        return out
+
+
+class _Subscriber:
+    """SubUpdateBuffer: bounded queue; overflow drops the subscriber
+    (it re-subscribes and catches up from history)."""
+
+    def __init__(self, start_block: int, maxsize: int = 1024) -> None:
+        self.q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+        self.next_block = start_block
+        self.dead = False
+
+    def push(self, item) -> None:
+        try:
+            self.q.put_nowait(item)
+        except queue.Full:
+            self.dead = True
+
+
+class ThinReplicaServer:
+    def __init__(self, blockchain: KeyValueBlockchain,
+                 filter_spec: Optional[FilterSpec] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.bc = blockchain
+        self.filter = filter_spec or FilterSpec()
+        self._subs: List[_Subscriber] = []
+        self._subs_lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._running = False
+        self._accept_thread: Optional[threading.Thread] = None
+        blockchain.add_listener(self._on_block)
+
+    # ---- lifecycle ----
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._sock.listen(16)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"trs-accept-{self.port}")
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ---- commit-path feed ----
+    def _on_block(self, block_id: int, updates: cat.BlockUpdates) -> None:
+        kv = self.filter.filter_updates(updates)
+        with self._subs_lock:
+            self._subs = [s for s in self._subs if not s.dead]
+            for sub in self._subs:
+                sub.push((block_id, kv))
+
+    # ---- connection handling ----
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True, name="trs-conn").start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            body = self._read_frame(conn)
+            if body is None:
+                return
+            req = tm.unpack_body(body)
+            if isinstance(req, tm.ReadStateRequest):
+                self._serve_read_state(conn, req.key_prefix)
+            elif isinstance(req, tm.ReadStateHashRequest):
+                self._serve_state_hash(conn, req)
+            elif isinstance(req, tm.SubscribeRequest):
+                self._serve_subscription(conn, req)
+            else:
+                conn.sendall(tm.pack(tm.ProtocolError(reason="bad request")))
+        except Exception:  # noqa: BLE001 — connection teardown
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_frame(conn: socket.socket) -> Optional[bytes]:
+        hdr = b""
+        while len(hdr) < 4:
+            chunk = conn.recv(4 - len(hdr))
+            if not chunk:
+                return None
+            hdr += chunk
+        (n,) = struct.unpack("<I", hdr)
+        if n > 1 << 22:
+            return None
+        body = b""
+        while len(body) < n:
+            chunk = conn.recv(n - len(body))
+            if not chunk:
+                return None
+            body += chunk
+        return body
+
+    # ---- ReadState / ReadStateHash ----
+    def _state_snapshot(self, key_prefix: bytes
+                        ) -> Tuple[int, List[Tuple[bytes, bytes]]]:
+        block_id = self.bc.last_block_id
+        fam_hits = []
+        db = self.bc._db
+        fam = cat._fam(self.filter.category, "latest")
+        for k, raw in db.range_iter(fam, start=key_prefix):
+            if not k.startswith(key_prefix):
+                break
+            fam_hits.append((k, raw[8:]))
+        return block_id, fam_hits
+
+    def _serve_read_state(self, conn: socket.socket,
+                          key_prefix: bytes) -> None:
+        block_id, kv = self._state_snapshot(key_prefix)
+        for pair in kv:
+            conn.sendall(tm.pack(tm.Update(block_id=block_id, kv=[pair])))
+        conn.sendall(tm.pack(tm.StateDone(
+            block_id=block_id, digest=tm.update_hash(block_id, kv))))
+
+    def _serve_state_hash(self, conn: socket.socket,
+                          req: tm.ReadStateHashRequest) -> None:
+        block_id, kv = self._state_snapshot(req.key_prefix)
+        conn.sendall(tm.pack(tm.StateDone(
+            block_id=block_id, digest=tm.update_hash(block_id, kv))))
+
+    # ---- subscriptions ----
+    def _block_kv(self, block_id: int,
+                  key_prefix: bytes) -> Optional[List[Tuple[bytes, bytes]]]:
+        blk = self.bc.get_block(block_id)
+        if blk is None:
+            return None
+        updates = cat.decode_block_updates(blk.updates_blob)
+        kv = self.filter.filter_updates(updates)
+        return [(k, v) for k, v in kv if k.startswith(key_prefix)]
+
+    def _serve_subscription(self, conn: socket.socket,
+                            req: tm.SubscribeRequest) -> None:
+        sub = _Subscriber(start_block=max(req.block_id, 1))
+        with self._subs_lock:
+            self._subs.append(sub)
+        try:
+            next_block = sub.next_block
+            # history first (catch-up), then drain the live buffer;
+            # blocks older than genesis are gone (pruned) — resume at it
+            next_block = max(next_block, self.bc.genesis_block_id or 1)
+            while self._running and not sub.dead:
+                if next_block <= self.bc.last_block_id:
+                    kv = self._block_kv(next_block, req.key_prefix)
+                    if kv is None:
+                        break
+                    self._emit(conn, req, next_block, kv)
+                    next_block += 1
+                    continue
+                try:
+                    block_id, kv = sub.q.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                if block_id < next_block:
+                    continue   # already served from history
+                if block_id > next_block:
+                    # gap (buffer overflowed earlier): fall back to history
+                    continue
+                kv = [(k, v) for k, v in kv
+                      if k.startswith(req.key_prefix)]
+                self._emit(conn, req, block_id, kv)
+                next_block += 1
+        finally:
+            sub.dead = True
+            with self._subs_lock:
+                if sub in self._subs:
+                    self._subs.remove(sub)
+
+    def _emit(self, conn: socket.socket, req: tm.SubscribeRequest,
+              block_id: int, kv: List[Tuple[bytes, bytes]]) -> None:
+        if req.hashes_only:
+            conn.sendall(tm.pack(tm.UpdateHash(
+                block_id=block_id, digest=tm.update_hash(block_id, kv))))
+        else:
+            conn.sendall(tm.pack(tm.Update(block_id=block_id, kv=kv)))
