@@ -1,0 +1,150 @@
+"""Apollo-style system tests: real replica processes, random concurrent
+workload with the linearizability tracker, primary partition → view
+change, crash-recovery, lagging-replica catch-up observed via metrics
+(reference model: tests/apollo/test_skvbc*.py over BftTestNetwork)."""
+import random
+import threading
+import time
+
+import pytest
+
+from tpubft.testing.network import BftTestNetwork
+from tpubft.testing.tracker import LinearizabilityError, SkvbcTracker
+
+
+# ---------------- tracker unit tests ----------------
+
+class _Reply:
+    def __init__(self, success, latest_block):
+        self.success = success
+        self.latest_block = latest_block
+
+
+def test_tracker_accepts_valid_history():
+    t = SkvbcTracker()
+    s = t.start_op()
+    t.log_write(s, [(b"a", b"1")], _Reply(True, 1))
+    s = t.start_op()
+    t.log_read(s, [b"a"], {b"a": b"1"})
+    s = t.start_op()
+    t.log_write(s, [(b"a", b"2")], _Reply(True, 2))
+    s = t.start_op()
+    t.log_read(s, [b"a"], {b"a": b"2"})
+    t.verify()
+
+
+def test_tracker_catches_stale_read():
+    t = SkvbcTracker()
+    s = t.start_op()
+    t.log_write(s, [(b"a", b"1")], _Reply(True, 1))
+    time.sleep(0.01)
+    # this read STARTS after the write completed but returns the old state
+    s = t.start_op()
+    t.log_read(s, [b"a"], {})
+    with pytest.raises(LinearizabilityError):
+        t.verify()
+
+
+def test_tracker_catches_phantom_value():
+    t = SkvbcTracker()
+    s = t.start_op()
+    t.log_write(s, [(b"a", b"1")], _Reply(True, 1))
+    s = t.start_op()
+    t.log_read(s, [b"a"], {b"a": b"99"})  # value nobody wrote
+    with pytest.raises(LinearizabilityError):
+        t.verify()
+
+
+def test_tracker_catches_bogus_conflict():
+    t = SkvbcTracker()
+    s = t.start_op()
+    # a conditional write failed although nothing ever touched its readset
+    t.log_write(s, [(b"b", b"x")], _Reply(False, 0),
+                readset=[b"lonely"], read_version=0)
+    with pytest.raises(LinearizabilityError):
+        t.verify()
+
+
+# ---------------- system tests over real processes ----------------
+
+@pytest.mark.slow
+def test_random_workload_linearizable(tmp_path):
+    """Concurrent clients, random conditional writes + reads, verified
+    against the tracker (apollo test_skvbc.py基本 flow)."""
+    tracker = SkvbcTracker()
+    keys = [f"wk-{i}".encode() for i in range(5)]
+
+    def worker(net, idx, stop_at):
+        rng = random.Random(1000 + idx)
+        kv = net.skvbc_client(idx)
+        while time.monotonic() < stop_at:
+            try:
+                if rng.random() < 0.6:
+                    ws = [(rng.choice(keys),
+                           f"v{idx}-{rng.randrange(1000)}".encode())]
+                    s = tracker.start_op()
+                    reply = kv.write(ws, timeout_ms=6000)
+                    tracker.log_write(s, ws, reply)
+                else:
+                    ks = rng.sample(keys, 2)
+                    s = tracker.start_op()
+                    vals = kv.read(ks, timeout_ms=6000)
+                    tracker.log_read(s, ks, vals)
+            except Exception:
+                continue  # timeouts are fine under contention
+
+    with BftTestNetwork(f=1, num_clients=4,
+                        db_dir=str(tmp_path)) as net:
+        stop_at = time.monotonic() + 8
+        threads = [threading.Thread(target=worker, args=(net, i, stop_at))
+                   for i in range(3)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        tracker.verify()
+        committed = sum(1 for w in tracker.writes if w.success)
+        assert committed >= 10, tracker.summary()
+        assert len(tracker.reads) >= 5, tracker.summary()
+
+
+@pytest.mark.slow
+def test_primary_partition_triggers_view_change(tmp_path):
+    with BftTestNetwork(f=1, num_clients=4, db_dir=str(tmp_path),
+                        view_change_timeout_ms=1500) as net:
+        kv = net.skvbc_client(0)
+        assert kv.write([(b"pre", b"1")], timeout_ms=6000).success
+        assert net.current_view(1) == 0
+        net.pause_replica(0)  # partition the primary
+        # the cluster must elect a new view and keep serving writes
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline and not ok:
+            try:
+                ok = kv.write([(b"post-vc", b"2")],
+                              timeout_ms=4000).success
+            except Exception:
+                time.sleep(0.3)
+        assert ok, "no progress after primary partition"
+        views = {net.current_view(r) for r in (1, 2, 3)}
+        assert views and all(v and v >= 1 for v in views)
+        # heal: the old primary returns as a backup and catches up
+        net.resume_replica(0)
+        net.wait_for(lambda: (net.last_executed(0) or 0) >= 2, timeout=30)
+        assert kv.read([b"pre", b"post-vc"]) == {b"pre": b"1",
+                                                b"post-vc": b"2"}
+
+
+@pytest.mark.slow
+def test_crash_recovery_with_metrics(tmp_path):
+    with BftTestNetwork(f=1, num_clients=4, db_dir=str(tmp_path)) as net:
+        kv = net.skvbc_client(0)
+        for i in range(3):
+            assert kv.write([(f"c-{i}".encode(), b"x")],
+                            timeout_ms=6000).success
+        net.kill_replica(3)
+        assert kv.write([(b"while-down", b"1")], timeout_ms=8000).success
+        net.restart_replica(3)
+        net.wait_for_replicas_up(replicas=[3], timeout=20)
+        net.wait_for(lambda: (net.last_executed(3) or 0) >= 4, timeout=30)
+        assert kv.read([b"while-down"]) == {b"while-down": b"1"}

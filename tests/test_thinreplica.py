@@ -99,6 +99,31 @@ def test_live_subscription_and_catchup():
             s.stop()
 
 
+def test_subscription_rotates_away_from_dead_data_server():
+    chains = [_chain_with(2) for _ in range(3)]
+    servers = _servers(chains)
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        trc.STALL_TIMEOUT_S = 1.0
+        got = []
+        trc.subscribe(lambda b, kv: got.append(b), start_block=1)
+        time.sleep(0.5)
+        assert got == [1, 2]
+        # kill the data server mid-stream; commit new blocks on survivors
+        servers[0].stop()
+        for bc in chains:
+            bc.add_block(BlockUpdates().put("kv", b"k3", b"3"))
+        deadline = time.time() + 10
+        while time.time() < deadline and 3 not in got:
+            time.sleep(0.2)
+        assert 3 in got, f"rotation never recovered: {got}"
+        trc.stop()
+    finally:
+        for s in servers:
+            s.stop()
+
+
 def test_subscription_rejects_unconfirmed_updates():
     """Data server diverges mid-stream: updates without f matching hashes
     are never delivered."""

@@ -52,9 +52,23 @@ class ClientPool:
                 self._clients.put(client)
         return self._pool.submit(run)
 
-    def write(self, request: bytes,
-              timeout_ms: Optional[int] = None) -> bytes:
-        return self.submit_write(request, timeout_ms=timeout_ms).result()
+    def write(self, request: bytes, timeout_ms: Optional[int] = None,
+              pre_process: bool = False) -> bytes:
+        return self.submit_write(request, timeout_ms=timeout_ms,
+                                 pre_process=pre_process).result()
+
+    def read(self, request: bytes,
+             timeout_ms: Optional[int] = None) -> bytes:
+        """Read through a checked-out identity (same discipline as
+        writes — reads also occupy the identity's in-flight slot)."""
+        try:
+            client = self._clients.get_nowait()
+        except queue.Empty:
+            raise ClientPoolBusy("all pool clients in flight") from None
+        try:
+            return client.send_read(request, timeout_ms=timeout_ms)
+        finally:
+            self._clients.put(client)
 
     @property
     def size(self) -> int:

@@ -142,15 +142,15 @@ class ClientService:
                 req = unpack_body(body)
                 if isinstance(req, WriteRequest):
                     try:
-                        reply = self._pool.write(req.payload)
+                        reply = self._pool.write(
+                            req.payload, pre_process=req.pre_process)
                         conn.sendall(pack(Reply(success=True,
                                                 payload=reply)))
                     except Exception:  # noqa: BLE001
                         conn.sendall(pack(Reply(success=False)))
                 elif isinstance(req, ReadRequest):
                     try:
-                        client = self._pool._all[0]
-                        reply = client.send_read(req.payload)
+                        reply = self._pool.read(req.payload)
                         conn.sendall(pack(Reply(success=True,
                                                 payload=reply)))
                     except Exception:  # noqa: BLE001
@@ -174,6 +174,9 @@ class ClientService:
         trc = ThinReplicaClient(self._trs, self._f,
                                 key_prefix=req.key_prefix)
         done = threading.Event()
+        # the verified-event callback is the ONLY writer on this socket
+        # (blocking sendall = natural backpressure for slow consumers);
+        # hangup surfaces as a send error
 
         def cb(block_id, kv):
             try:
@@ -183,14 +186,4 @@ class ClientService:
         trc.subscribe(cb, start_block=req.start_block)
         while self._running and not done.is_set():
             done.wait(timeout=0.5)
-            # detect client hangup by probing the socket
-            try:
-                conn.settimeout(0.01)
-                probe = conn.recv(1)
-                if probe == b"":
-                    break
-            except socket.timeout:
-                continue
-            except OSError:
-                break
         trc.stop()

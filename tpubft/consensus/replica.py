@@ -163,6 +163,9 @@ class Replica(IReceiver):
             cert = unpack_cert(raw)
             self.carried_certs[(cert.seq_num, cert.kind == CERT_SIGNED)] = cert
         self._my_vc_msg: Optional[m.ViewChangeMsg] = None
+        # proof of the view we're in, kept for status-driven retransmission
+        # to lagging peers (reference: RetransmissionsManager + status)
+        self._entered_view_proof: Optional[tuple] = None
         self._complained_views: set = set()
         self._vc_started_at = 0.0
         self._last_progress = time.monotonic()
@@ -179,6 +182,8 @@ class Replica(IReceiver):
                                   self._check_fast_path_timeouts)
         self.dispatcher.add_timer(cfg.view_change_timer_ms / 1000.0 / 4,
                                   self._check_view_change_timer)
+        self.dispatcher.add_timer(cfg.status_report_timer_ms / 1000.0,
+                                  self._send_status)
         self.collector_pool = CollectorPool(
             lambda res: self.incoming.push_internal("combine", res))
 
@@ -397,6 +402,10 @@ class Replica(IReceiver):
             return
         if isinstance(msg, m.CheckpointMsg):
             self._on_checkpoint(msg)
+            return
+        if isinstance(msg, m.ReplicaStatusMsg):
+            if self.info.is_replica(sender):
+                self._on_replica_status(msg)
             return
         if isinstance(msg, m.StateTransferMsg):
             # ST flows even mid-view-change (reference handles it in
@@ -970,6 +979,58 @@ class Replica(IReceiver):
             self.comm.send(client, reply.pack())
 
     # ------------------------------------------------------------------
+    # status beacons + gap retransmission (reference ReplicaStatusMsg +
+    # RetransmissionsManager / ReqMissingData duties)
+    # ------------------------------------------------------------------
+    def _send_status(self) -> None:
+        if not self._running:
+            return
+        status = m.ReplicaStatusMsg(
+            sender_id=self.id, view=self.view,
+            last_stable_seq=self.last_stable,
+            last_executed_seq=self.last_executed,
+            in_view_change=self.in_view_change)
+        self._broadcast(status)
+
+    MAX_GAP_RESEND = 8
+
+    def _on_replica_status(self, msg: m.ReplicaStatusMsg) -> None:
+        """A peer is behind: push it what it's missing. Status is
+        advisory/unsigned — worst case a spoofed one costs a bounded
+        retransmission, never state."""
+        peer = msg.sender_id
+        if peer == self.id:
+            return
+        # (a) peer in an older view: resend the proof of ours so it can
+        # enter (NewViewMsg + the ViewChangeMsgs it references)
+        if msg.view < self.view and self._entered_view_proof is not None:
+            nv, vcs = self._entered_view_proof
+            for vc in vcs:
+                self.comm.send(peer, vc.pack())
+            self.comm.send(peer, nv.pack())
+            return
+        if msg.view != self.view:
+            return
+        # (b) same view, peer's execution lags inside our window: resend
+        # PrePrepare + commit certificate from persisted state
+        if msg.last_executed_seq >= self.last_executed:
+            return
+        st = self.storage.load()
+        first = msg.last_executed_seq + 1
+        for seq in range(first, min(self.last_executed,
+                                    first + self.MAX_GAP_RESEND - 1) + 1):
+            entry = st.seq_states.get(seq)
+            if entry is None or entry.pre_prepare is None:
+                continue
+            self.comm.send(peer, entry.pre_prepare)
+            if entry.full_commit_proof is not None:
+                self.comm.send(peer, entry.full_commit_proof)
+            elif entry.commit_full is not None:
+                if entry.prepare_full is not None:
+                    self.comm.send(peer, entry.prepare_full)
+                self.comm.send(peer, entry.commit_full)
+
+    # ------------------------------------------------------------------
     # checkpointing (ReplicaImp.cpp:2280,3274,3439)
     # ------------------------------------------------------------------
     def _send_checkpoint(self, seq: int) -> None:
@@ -1208,6 +1269,7 @@ class Replica(IReceiver):
             restrictions = compute_restrictions(
                 quorum, share_digest, self._verifier_for_cert_kind,
                 self.info.f + self.info.c + 1)
+            self._entered_view_proof = (nv, list(quorum))
             self._enter_view(new_view, restrictions)
         else:
             nv = self.vc.pending_new_view
@@ -1219,6 +1281,7 @@ class Replica(IReceiver):
             restrictions = compute_restrictions(
                 matched, share_digest, self._verifier_for_cert_kind,
                 self.info.f + self.info.c + 1)
+            self._entered_view_proof = (nv, list(matched))
             self._enter_view(new_view, restrictions)
 
     def _on_new_view(self, msg: m.NewViewMsg) -> None:

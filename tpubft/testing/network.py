@@ -1,0 +1,212 @@
+"""BftTestNetwork — the system-test harness running REAL replica
+processes.
+
+Rebuild of the reference's Apollo core (/root/reference/tests/apollo/
+util/bft.py:233 BftTestNetwork): each replica is an OS subprocess of the
+actual SKVBC tester replica (subprocess.Popen, bft.py:818), driven from
+the test through real UDP clients, observed through each replica's UDP
+metrics server (bft_metrics.py), and fault-injected by killing/restarting
+processes and by pausing them with SIGSTOP/SIGCONT (the portable stand-in
+for Apollo's iptables partitioning — a stopped process neither sends nor
+receives, which is exactly a partition from the cluster's viewpoint).
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from tpubft.apps.simple_test import endpoint_table
+from tpubft.apps.skvbc import SkvbcClient
+from tpubft.bftclient import BftClient, ClientConfig
+from tpubft.comm import CommConfig, PlainUdpCommunication
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.utils.config import ReplicaConfig
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+class MetricsClient:
+    """Polls a replica's UDP metrics server (reference bft_metrics.py)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1") -> None:
+        self.addr = (host, port)
+
+    def snapshot(self, timeout: float = 1.0) -> Optional[dict]:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(timeout)
+        try:
+            s.sendto(b"metrics", self.addr)
+            data, _ = s.recvfrom(1 << 20)
+            return json.loads(data.decode())
+        except (OSError, json.JSONDecodeError):
+            return None
+        finally:
+            s.close()
+
+    def get(self, component: str, kind: str, name: str,
+            timeout: float = 1.0):
+        snap = self.snapshot(timeout)
+        if snap is None:
+            return None
+        try:
+            return snap["components"][component][kind][name]
+        except KeyError:
+            return None
+
+
+class BftTestNetwork:
+    def __init__(self, f: int = 1, c: int = 0, num_clients: int = 4,
+                 base_port: Optional[int] = None,
+                 db_dir: Optional[str] = None,
+                 seed: str = "apollo-net",
+                 view_change_timeout_ms: int = 3000) -> None:
+        self.f, self.c = f, c
+        self.n = 3 * f + 2 * c + 1
+        self.num_clients = num_clients
+        self.seed = seed
+        self.base_port = base_port or random.randint(20000, 50000)
+        self.metrics_base = self.base_port + 1000
+        self.db_dir = db_dir
+        self.view_change_timeout_ms = view_change_timeout_ms
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.paused: set = set()
+        self._clients: Dict[int, BftClient] = {}
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start_all(self) -> "BftTestNetwork":
+        for r in range(self.n):
+            self.start_replica(r)
+        self.wait_for_replicas_up(timeout=30)
+        return self
+
+    def start_replica(self, r: int) -> None:
+        assert r not in self.procs or self.procs[r].poll() is not None
+        env = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu")
+        args = [sys.executable, "-m", "tpubft.apps.skvbc_replica",
+                "--replica", str(r), "--f", str(self.f), "--c", str(self.c),
+                "--clients", str(self.num_clients),
+                "--base-port", str(self.base_port),
+                "--metrics-port", str(self.metrics_base + r),
+                "--seed", self.seed,
+                "--view-change-timeout-ms",
+                str(self.view_change_timeout_ms)]
+        if self.db_dir:
+            args += ["--db-dir", self.db_dir]
+        self.procs[r] = subprocess.Popen(args, env=env,
+                                         stdout=subprocess.DEVNULL,
+                                         stderr=subprocess.DEVNULL)
+
+    def stop_all(self) -> None:
+        for r, p in self.procs.items():
+            if p.poll() is None:
+                if r in self.paused:
+                    p.send_signal(signal.SIGCONT)
+                p.send_signal(signal.SIGTERM)
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for cl in self._clients.values():
+            cl.stop()
+
+    # ------------------------------------------------------------------
+    # fault injection (Apollo kill/restart + partition analogs)
+    # ------------------------------------------------------------------
+    def kill_replica(self, r: int) -> None:
+        """Hard crash (SIGKILL) — Apollo bft.py stop_replica."""
+        p = self.procs[r]
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait()
+
+    def restart_replica(self, r: int) -> None:
+        self.kill_replica(r)
+        self.start_replica(r)
+
+    def pause_replica(self, r: int) -> None:
+        """SIGSTOP: the replica is partitioned from the cluster (alive,
+        silent) — analog of Apollo's iptables isolation."""
+        self.procs[r].send_signal(signal.SIGSTOP)
+        self.paused.add(r)
+
+    def resume_replica(self, r: int) -> None:
+        self.procs[r].send_signal(signal.SIGCONT)
+        self.paused.discard(r)
+
+    # ------------------------------------------------------------------
+    # observation
+    # ------------------------------------------------------------------
+    def metrics(self, r: int) -> MetricsClient:
+        return MetricsClient(self.metrics_base + r)
+
+    def wait_for_replicas_up(self, timeout: float = 30.0,
+                             replicas: Optional[List[int]] = None) -> None:
+        pending = set(replicas if replicas is not None
+                      else range(self.n)) - self.paused
+        deadline = time.monotonic() + timeout
+        while pending and time.monotonic() < deadline:
+            for r in list(pending):
+                if self.metrics(r).snapshot(timeout=0.3) is not None:
+                    pending.discard(r)
+            if pending:
+                time.sleep(0.2)
+        if pending:
+            raise TimeoutError(f"replicas never came up: {sorted(pending)}")
+
+    def wait_for(self, predicate, timeout: float = 30.0,
+                 poll: float = 0.2):
+        """Apollo-style polling assertion helper."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = predicate()
+            if v:
+                return v
+            time.sleep(poll)
+        raise TimeoutError("condition never satisfied")
+
+    def last_executed(self, r: int) -> Optional[int]:
+        return self.metrics(r).get("replica", "gauges", "last_executed_seq")
+
+    def current_view(self, r: int) -> Optional[int]:
+        return self.metrics(r).get("replica", "gauges", "view")
+
+    # ------------------------------------------------------------------
+    # clients
+    # ------------------------------------------------------------------
+    def client(self, idx: int = 0, **cfg_kw) -> BftClient:
+        client_id = self.n + idx
+        cl = self._clients.get(client_id)
+        if cl is None:
+            cfg = ReplicaConfig(f_val=self.f, c_val=self.c,
+                                num_of_client_proxies=self.num_clients)
+            keys = ClusterKeys.generate(
+                cfg, self.num_clients,
+                seed=self.seed.encode()).for_node(client_id)
+            eps = endpoint_table(self.base_port, self.n, self.num_clients)
+            comm = PlainUdpCommunication(CommConfig(self_id=client_id,
+                                                    endpoints=eps))
+            cl = BftClient(ClientConfig(client_id=client_id, f_val=self.f,
+                                        c_val=self.c, **cfg_kw), keys, comm)
+            cl.start()
+            self._clients[client_id] = cl
+        return cl
+
+    def skvbc_client(self, idx: int = 0, **cfg_kw) -> SkvbcClient:
+        return SkvbcClient(self.client(idx, **cfg_kw))
+
+    def __enter__(self) -> "BftTestNetwork":
+        return self.start_all()
+
+    def __exit__(self, *exc) -> None:
+        self.stop_all()

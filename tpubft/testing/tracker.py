@@ -1,0 +1,157 @@
+"""SkvbcTracker — linearizability oracle for concurrent KV histories.
+
+Rebuild of the reference's correctness oracle
+(/root/reference/tests/apollo/util/skvbc_history_tracker.py, 852 LoC):
+clients log every operation with its real-time window; verification
+exploits SKVBC's structure — every successful write reports the block id
+it created, giving the ground-truth total order — and checks that
+
+  1. block ids are unique and writes are consistent with them,
+  2. every read returns a state reachable at SOME block within the
+     read's real-time window (reads must not see the future, nor miss
+     writes that completed before they started),
+  3. conditional writes that failed really had a conflict (some readset
+     key was written after the stated read_version).
+
+Thread-safe: many client workers log concurrently.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class LinearizabilityError(AssertionError):
+    pass
+
+
+@dataclass
+class _WriteOp:
+    start: float
+    end: float
+    writeset: Dict[bytes, bytes]
+    readset: List[bytes]
+    read_version: int
+    success: bool
+    block_id: Optional[int]   # reported by the reply (success only)
+
+
+@dataclass
+class _ReadOp:
+    start: float
+    end: float
+    values: Dict[bytes, bytes]   # key -> value (missing = absent)
+    keys: List[bytes]
+
+
+class SkvbcTracker:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.writes: List[_WriteOp] = []
+        self.reads: List[_ReadOp] = []
+
+    # ---- client-side logging ----
+    def start_op(self) -> float:
+        return time.monotonic()
+
+    def log_write(self, start: float, writeset: Sequence[Tuple[bytes, bytes]],
+                  reply, readset: Sequence[bytes] = (),
+                  read_version: int = 0) -> None:
+        op = _WriteOp(start=start, end=time.monotonic(),
+                      writeset=dict(writeset), readset=list(readset),
+                      read_version=read_version, success=reply.success,
+                      block_id=reply.latest_block if reply.success else None)
+        with self._lock:
+            self.writes.append(op)
+
+    def log_read(self, start: float, keys: Sequence[bytes],
+                 values: Dict[bytes, bytes]) -> None:
+        op = _ReadOp(start=start, end=time.monotonic(),
+                     values=dict(values), keys=list(keys))
+        with self._lock:
+            self.reads.append(op)
+
+    # ---- verification ----
+    def verify(self) -> None:
+        with self._lock:
+            writes = list(self.writes)
+            reads = list(self.reads)
+
+        # empty-writeset writes succeed without creating a block — their
+        # reported latest_block belongs to someone else
+        committed = [w for w in writes if w.success and w.writeset]
+        by_block: Dict[int, _WriteOp] = {}
+        for w in committed:
+            if w.block_id in by_block:
+                # two successful writes reporting the same created block
+                other = by_block[w.block_id]
+                if other.writeset != w.writeset:
+                    raise LinearizabilityError(
+                        f"two distinct writes claim block {w.block_id}")
+            else:
+                by_block[w.block_id] = w
+
+        # ground-truth state history from the block order
+        blocks = sorted(by_block)
+        state_at: Dict[int, Dict[bytes, bytes]] = {}
+        last_written: Dict[bytes, List[Tuple[int, bytes]]] = {}
+        state: Dict[bytes, bytes] = {}
+        prev = 0
+        for b in blocks:
+            state = dict(state)
+            for k, v in by_block[b].writeset.items():
+                state[k] = v
+                last_written.setdefault(k, []).append((b, v))
+            state_at[b] = state
+            prev = b
+        state_at[0] = {}
+
+        def state_at_or_before(b: int) -> Dict[bytes, bytes]:
+            candidates = [x for x in blocks if x <= b]
+            return state_at[candidates[-1]] if candidates else {}
+
+        # real-time bounds: a read starting after write w completed must
+        # observe a block >= w.block_id; a read must not observe blocks
+        # created after it finished
+        for r in reads:
+            lower = 0
+            for w in committed:
+                if w.end < r.start and w.block_id is not None:
+                    lower = max(lower, w.block_id)
+            upper = max([b for b in blocks
+                         if by_block[b].start <= r.end] + [0])
+            ok = False
+            for b in range(lower, upper + 1):
+                snap = state_at_or_before(b)
+                if all(snap.get(k) == r.values.get(k) for k in r.keys):
+                    ok = True
+                    break
+            if not ok:
+                raise LinearizabilityError(
+                    f"read {r.keys} -> {r.values} matches no state in "
+                    f"blocks [{lower}, {upper}]")
+
+        # failed conditional writes must have had a real conflict window:
+        # some readset key was written in a block > read_version by an op
+        # overlapping or preceding the failed write
+        for w in writes:
+            if w.success or not w.readset:
+                continue
+            conflict = any(
+                any(b > w.read_version and ow.start <= w.end
+                    for b, _v in last_written.get(k, [])
+                    for ow in [by_block[b]])
+                for k in w.readset)
+            if not conflict:
+                raise LinearizabilityError(
+                    f"write conditioned on v{w.read_version} "
+                    f"readset={w.readset} failed without any conflicting "
+                    f"write")
+
+    def summary(self) -> str:
+        ok_writes = sum(1 for w in self.writes if w.success)
+        return (f"{len(self.writes)} writes ({ok_writes} committed, "
+                f"{len(self.writes) - ok_writes} rejected), "
+                f"{len(self.reads)} reads")

@@ -12,6 +12,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpubft.thinreplica import messages as tm
@@ -27,16 +28,27 @@ class _Conn:
         self.sock.sendall(tm.pack(msg))
 
     def recv(self):
+        """Read one frame. A socket timeout with NO bytes read raises
+        socket.timeout (idle poll); a timeout mid-frame keeps reading so
+        framing never desyncs."""
         hdr = b""
         while len(hdr) < 4:
-            chunk = self.sock.recv(4 - len(hdr))
+            try:
+                chunk = self.sock.recv(4 - len(hdr))
+            except socket.timeout:
+                if hdr:
+                    continue
+                raise
             if not chunk:
                 return None
             hdr += chunk
         (n,) = struct.unpack("<I", hdr)
         body = b""
         while len(body) < n:
-            chunk = self.sock.recv(n - len(body))
+            try:
+                chunk = self.sock.recv(n - len(body))
+            except socket.timeout:
+                continue
             if not chunk:
                 return None
             body += chunk
@@ -65,6 +77,8 @@ class ThinReplicaClient:
         self._hash_votes: Dict[int, Dict[bytes, set]] = {}
         self._delivered_up_to = 0
         self._callback: Optional[Callable] = None
+        self._generation = 0
+        self._last_progress = 0.0
 
     # ---- one-shot state read with hash verification ----
     def read_state(self) -> Dict[bytes, bytes]:
@@ -86,10 +100,14 @@ class ThinReplicaClient:
             else:
                 raise ConnectionError(f"bad state msg {msg!r}")
         data_conn.close()
+        # hash what we RECEIVED — the data server's self-reported digest
+        # proves nothing (a forger would ship honest digest + fake data)
+        local_digest = tm.update_hash(done.block_id, list(state.items()))
         votes = 0
-        for ep in self.endpoints[1:]:
-            if votes >= self.f:
-                break
+        deadline = time.monotonic() + 10
+        pending = list(self.endpoints[1:])
+        while votes < self.f and pending and time.monotonic() < deadline:
+            ep = pending.pop(0)
             try:
                 c = _Conn(ep)
                 c.send(tm.ReadStateHashRequest(block_id=done.block_id,
@@ -98,44 +116,88 @@ class ThinReplicaClient:
                 c.close()
             except OSError:
                 continue
-            if isinstance(h, tm.StateDone) and h.digest == done.digest \
+            if isinstance(h, tm.StateDone) and h.digest == local_digest \
                     and h.block_id == done.block_id:
                 votes += 1
+            elif isinstance(h, tm.ProtocolError) and h.reason == "ahead":
+                # hash server still catching up to our snapshot height
+                pending.append(ep)
+                time.sleep(0.2)
         if votes < self.f:
             raise ValueError("state hash quorum not reached")
         self._delivered_up_to = done.block_id
         return state
 
     # ---- live subscription ----
+    STALL_TIMEOUT_S = 5.0
+
     def subscribe(self, callback: Callable[[int, List[Tuple[bytes, bytes]]],
                                            None],
                   start_block: int = 1) -> None:
-        """Deliver verified (block_id, kv) updates in order."""
+        """Deliver verified (block_id, kv) updates in order. A stalled or
+        lying data source is rotated out by the supervisor (the module's
+        trust-but-verify contract)."""
         self._callback = callback
         self._delivered_up_to = max(self._delivered_up_to, start_block - 1)
-        data_ep = self.endpoints[0]
-        hash_eps = self.endpoints[1:1 + self.f]
-        t = threading.Thread(target=self._data_loop, args=(data_ep,),
-                             daemon=True, name="trc-data")
+        self._generation = 0
+        self._last_progress = time.monotonic()
+        t = threading.Thread(target=self._supervise, daemon=True,
+                             name="trc-supervisor")
         t.start()
         self._threads.append(t)
-        for i, ep in enumerate(hash_eps):
-            t = threading.Thread(target=self._hash_loop, args=(ep, i),
-                                 daemon=True, name=f"trc-hash-{i}")
-            t.start()
-            self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
 
-    def _data_loop(self, ep: Endpoint) -> None:
+    def _supervise(self) -> None:
+        """Start a generation of stream threads; rotate the data source
+        and restart whenever delivery stalls (mismatch, overflow
+        disconnect, dead server)."""
+        rotation = 0
+        while not self._stop.is_set():
+            gen = self._generation
+            with self._lock:
+                self._pending_data.clear()
+                self._hash_votes.clear()
+            n = len(self.endpoints)
+            data_ep = self.endpoints[rotation % n]
+            hash_eps = [self.endpoints[(rotation + 1 + i) % n]
+                        for i in range(self.f)]
+            threads = [threading.Thread(
+                target=self._data_loop, args=(data_ep, gen),
+                daemon=True, name="trc-data")]
+            threads += [threading.Thread(
+                target=self._hash_loop, args=(ep, i, gen),
+                daemon=True, name=f"trc-hash-{i}")
+                for i, ep in enumerate(hash_eps)]
+            for t in threads:
+                t.start()
+            self._last_progress = time.monotonic()
+            while not self._stop.is_set():
+                time.sleep(0.25)
+                if time.monotonic() - self._last_progress \
+                        > self.STALL_TIMEOUT_S:
+                    with self._lock:
+                        stuck = bool(self._pending_data) \
+                            or bool(self._hash_votes)
+                    if stuck:
+                        break  # rotate away from the current data source
+                    self._last_progress = time.monotonic()
+            self._generation += 1  # retire this generation's threads
+            rotation += 1
+
+    def _data_loop(self, ep: Endpoint, gen: int) -> None:
         try:
             conn = _Conn(ep)
             conn.send(tm.SubscribeRequest(
                 block_id=self._delivered_up_to + 1,
                 key_prefix=self.key_prefix, hashes_only=False))
-            while not self._stop.is_set():
-                msg = conn.recv()
+            conn.sock.settimeout(1.0)
+            while not self._stop.is_set() and self._generation == gen:
+                try:
+                    msg = conn.recv()
+                except socket.timeout:
+                    continue
                 if msg is None:
                     return
                 if isinstance(msg, tm.Update):
@@ -145,14 +207,18 @@ class ThinReplicaClient:
         except OSError:
             return
 
-    def _hash_loop(self, ep: Endpoint, idx: int) -> None:
+    def _hash_loop(self, ep: Endpoint, idx: int, gen: int) -> None:
         try:
             conn = _Conn(ep)
             conn.send(tm.SubscribeRequest(
                 block_id=self._delivered_up_to + 1,
                 key_prefix=self.key_prefix, hashes_only=True))
-            while not self._stop.is_set():
-                msg = conn.recv()
+            conn.sock.settimeout(1.0)
+            while not self._stop.is_set() and self._generation == gen:
+                try:
+                    msg = conn.recv()
+                except socket.timeout:
+                    continue
                 if msg is None:
                     return
                 if isinstance(msg, tm.UpdateHash):
@@ -178,5 +244,6 @@ class ThinReplicaClient:
                 self._hash_votes.pop(nxt, None)
                 self._delivered_up_to = nxt
                 cb = self._callback
+            self._last_progress = time.monotonic()
             if cb is not None:
                 cb(nxt, kv)

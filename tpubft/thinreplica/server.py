@@ -164,6 +164,27 @@ class ThinReplicaServer:
             fam_hits.append((k, raw[8:]))
         return block_id, fam_hits
 
+    def _state_at_block(self, key_prefix: bytes, at_block: int
+                        ) -> List[Tuple[bytes, bytes]]:
+        """Historical state from the versioned_kv history family — lets a
+        hash server answer for the DATA server's snapshot height even
+        while the cluster keeps committing (reference: block-id'd state
+        reads)."""
+        db = self.bc._db
+        fam = cat._fam(self.filter.category, "hist")
+        best: dict = {}
+        for k, raw in db.range_iter(fam):
+            klen = int.from_bytes(k[:2], "big")
+            key = k[2:2 + klen]
+            if not key.startswith(key_prefix):
+                continue
+            block = ~int.from_bytes(k[2 + klen:2 + klen + 8],
+                                    "big") & 0xFFFFFFFFFFFFFFFF
+            if block > at_block or key in best:
+                continue  # hist keys are newest-first per key
+            best[key] = None if raw[:1] == b"\x00" else raw[1:]
+        return sorted((k, v) for k, v in best.items() if v is not None)
+
     def _serve_read_state(self, conn: socket.socket,
                           key_prefix: bytes) -> None:
         block_id, kv = self._state_snapshot(key_prefix)
@@ -174,6 +195,15 @@ class ThinReplicaServer:
 
     def _serve_state_hash(self, conn: socket.socket,
                           req: tm.ReadStateHashRequest) -> None:
+        if req.block_id and req.block_id != self.bc.last_block_id:
+            if req.block_id > self.bc.last_block_id:
+                conn.sendall(tm.pack(tm.ProtocolError(reason="ahead")))
+                return
+            kv = self._state_at_block(req.key_prefix, req.block_id)
+            conn.sendall(tm.pack(tm.StateDone(
+                block_id=req.block_id,
+                digest=tm.update_hash(req.block_id, kv))))
+            return
         block_id, kv = self._state_snapshot(req.key_prefix)
         conn.sendall(tm.pack(tm.StateDone(
             block_id=block_id, digest=tm.update_hash(block_id, kv))))
