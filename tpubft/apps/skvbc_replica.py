@@ -52,6 +52,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=0)
     p.add_argument("--trs-port", type=int, default=None,
                    help="thin-replica streaming port (0 = ephemeral)")
+    p.add_argument("--diag-port", type=int, default=None,
+                   help="diagnostics admin server port (0 = ephemeral)")
     p.add_argument("--db-dir", default=None)
     p.add_argument("--seed", default="tpubft-skvbc")
     p.add_argument("--view-change-timeout-ms", type=int, default=4000)
@@ -70,9 +72,15 @@ def main() -> None:
     metrics = UdpMetricsServer(kr.replica.aggregator,
                                port=args.metrics_port)
     metrics.start()
+    diag = None
+    if args.diag_port is not None:
+        from tpubft.diagnostics import DiagnosticsServer
+        diag = DiagnosticsServer(port=args.diag_port)
+        diag.start()
     kr.start()
-    print(f"skvbc replica {args.replica} up (metrics {metrics.port})",
-          flush=True)
+    diag_note = f", diag {diag.port}" if diag is not None else ""
+    print(f"skvbc replica {args.replica} up (metrics {metrics.port}"
+          f"{diag_note})", flush=True)
     try:
         while True:
             time.sleep(1)
@@ -81,6 +89,8 @@ def main() -> None:
     finally:
         kr.stop()
         metrics.stop()
+        if diag is not None:
+            diag.stop()
 
 
 if __name__ == "__main__":

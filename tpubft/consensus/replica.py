@@ -239,6 +239,21 @@ class Replica(IReceiver):
         self.key_exchange.load_from_pages()
         self._load_client_replies_from_pages()
 
+        # diagnostics (reference: Registrar status handlers + per-stage
+        # histograms, diagnostics.h / performance_handler.h)
+        from tpubft.diagnostics import get_registrar
+        self._diag = get_registrar()
+        self._h_execute = self._diag.histogram(f"replica{self.id}.execute")
+        self._h_verify = self._diag.histogram(f"replica{self.id}.verify")
+        self._diag.register_status(
+            f"replica{self.id}",
+            lambda: (f"view={self.view} last_executed={self.last_executed} "
+                     f"last_stable={self.last_stable} "
+                     f"in_view_change={self.in_view_change} "
+                     f"{self.control.status()}"))
+        from tpubft.testing.slowdown import get_slowdown_manager
+        self._slowdown = get_slowdown_manager()
+
         self._restore_window(window_msgs)
         self._running = False
 
@@ -586,8 +601,12 @@ class Replica(IReceiver):
                  if not r.flags & m.RequestFlag.HAS_PRE_PROCESSED]
         items = [(r.sender_id, r.signed_payload(), r.signature)
                  for r in plain]
-        if items and not all(self.sig.verify_batch(items)):
-            return
+        if items:
+            from tpubft.diagnostics import TimeRecorder
+            with TimeRecorder(self._h_verify):
+                ok = all(self.sig.verify_batch(items))
+            if not ok:
+                return
         for r in reqs:
             if r.flags & m.RequestFlag.HAS_PRE_PROCESSED:
                 from tpubft.preprocessor.preprocessor import (
@@ -902,6 +921,10 @@ class Replica(IReceiver):
                     if cached is not None:
                         self.comm.send(req.sender_id, cached.pack())
                     continue
+                from tpubft.diagnostics import TimeRecorder
+                from tpubft.testing.slowdown import PHASE_EXECUTE
+                if self._slowdown.enabled:
+                    self._slowdown.delay(PHASE_EXECUTE)
                 if req.flags & m.RequestFlag.INTERNAL:
                     reply = self._execute_internal_request(req)
                 elif req.flags & m.RequestFlag.RECONFIG:
@@ -919,9 +942,10 @@ class Replica(IReceiver):
                             orig.sender_id, orig.req_seq_num, orig.flags,
                             orig.request, result)
                 else:
-                    reply = self.handler.execute(req.sender_id,
-                                                 req.req_seq_num,
-                                                 req.flags, req.request)
+                    with TimeRecorder(self._h_execute):
+                        reply = self.handler.execute(req.sender_id,
+                                                     req.req_seq_num,
+                                                     req.flags, req.request)
                 self.m_executed.inc()
                 self._send_reply(req.sender_id, req.req_seq_num, reply)
             if self.cfg.time_service_enabled and info.pre_prepare.time:

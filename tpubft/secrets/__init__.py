@@ -1,0 +1,12 @@
+"""Secrets manager — private key material encrypted at rest.
+
+Rebuild of /root/reference/secretsmanager/ (secrets_manager_enc.h,
+secrets_manager_plain.h, aes.cpp, base64.cpp): AES-256-CBC (native C++
+engine, tpubft/native/aescbc.cpp) with PBKDF2-HMAC-SHA256 key derivation,
+PKCS#7 padding, and encrypt-then-MAC integrity; plus the plaintext
+variant for tests.
+"""
+from tpubft.secrets.manager import (SecretsManagerEnc, SecretsManagerPlain,
+                                    SecretsError)
+
+__all__ = ["SecretsManagerEnc", "SecretsManagerPlain", "SecretsError"]

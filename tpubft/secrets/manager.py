@@ -1,0 +1,125 @@
+"""Secrets managers. File format (versioned, self-describing):
+
+  b"TPUBFTSEC1" | salt(16) | iv(16) | ciphertext | hmac-sha256(32)
+
+where the hmac covers salt|iv|ciphertext under a key derived separately
+from the same password (encrypt-then-MAC).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import hmac as hmac_mod
+import os
+from typing import Optional
+
+from tpubft.native.build import load
+
+_MAGIC = b"TPUBFTSEC1"
+_PBKDF2_ITERS = 100_000
+
+
+class SecretsError(Exception):
+    pass
+
+
+def _lib():
+    lib = load("aescbc")
+    if getattr(lib, "_aes_typed", False):
+        return lib
+    for fn in (lib.aes256_cbc_encrypt, lib.aes256_cbc_decrypt):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                       ctypes.c_char_p, ctypes.c_uint32]
+    lib._aes_typed = True
+    return lib
+
+
+def _derive_keys(password: bytes, salt: bytes) -> tuple:
+    material = hashlib.pbkdf2_hmac("sha256", password, salt, _PBKDF2_ITERS,
+                                   dklen=64)
+    return material[:32], material[32:]  # (aes key, hmac key)
+
+
+def _pad(data: bytes) -> bytes:
+    n = 16 - len(data) % 16
+    return data + bytes([n]) * n
+
+
+def _unpad(data: bytes) -> bytes:
+    if not data or data[-1] < 1 or data[-1] > 16 \
+            or data[-data[-1]:] != bytes([data[-1]]) * data[-1]:
+        raise SecretsError("bad padding")
+    return data[:-data[-1]]
+
+
+class SecretsManagerEnc:
+    """Encrypted secrets at rest (reference secrets_manager_enc.h)."""
+
+    def __init__(self, password: bytes) -> None:
+        if not password:
+            raise SecretsError("empty password")
+        self._password = password
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        salt = os.urandom(16)
+        iv = os.urandom(16)
+        aes_key, mac_key = _derive_keys(self._password, salt)
+        padded = _pad(plaintext)
+        out = ctypes.create_string_buffer(len(padded))
+        rc = _lib().aes256_cbc_encrypt(aes_key, iv, padded, out,
+                                       len(padded))
+        if rc != 0:
+            raise SecretsError("encryption failed")
+        body = salt + iv + out.raw
+        tag = hmac_mod.new(mac_key, body, hashlib.sha256).digest()
+        return _MAGIC + body + tag
+
+    def decrypt(self, blob: bytes) -> bytes:
+        if not blob.startswith(_MAGIC) or len(blob) < len(_MAGIC) + 64:
+            raise SecretsError("not a tpubft secret blob")
+        body, tag = blob[len(_MAGIC):-32], blob[-32:]
+        salt, iv, ct = body[:16], body[16:32], body[32:]
+        aes_key, mac_key = _derive_keys(self._password, salt)
+        expect = hmac_mod.new(mac_key, body, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(tag, expect):
+            raise SecretsError("integrity check failed (wrong password "
+                               "or tampered file)")
+        if len(ct) % 16:
+            raise SecretsError("truncated ciphertext")
+        out = ctypes.create_string_buffer(len(ct))
+        rc = _lib().aes256_cbc_decrypt(aes_key, iv, ct, out, len(ct))
+        if rc != 0:
+            raise SecretsError("decryption failed")
+        return _unpad(out.raw)
+
+    # file helpers (reference encryptFile/decryptFile)
+    def encrypt_file(self, path: str, plaintext: bytes) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(self.encrypt(plaintext))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def decrypt_file(self, path: str) -> bytes:
+        with open(path, "rb") as fh:
+            return self.decrypt(fh.read())
+
+
+class SecretsManagerPlain:
+    """Plaintext variant for tests (reference secrets_manager_plain.h)."""
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        return plaintext
+
+    def decrypt(self, blob: bytes) -> bytes:
+        return blob
+
+    def encrypt_file(self, path: str, plaintext: bytes) -> None:
+        with open(path, "wb") as fh:
+            fh.write(plaintext)
+
+    def decrypt_file(self, path: str) -> bytes:
+        with open(path, "rb") as fh:
+            return fh.read()

@@ -1,0 +1,3 @@
+"""Operator/developer tools (reference /root/reference/tools/:
+GenerateConcordKeys, TestGeneratedKeys, DBEditor; diagnostics/concord-ctl).
+"""

@@ -1,0 +1,38 @@
+"""tpubft-ctl — CLI for the diagnostics admin server (reference
+diagnostics/concord-ctl).
+
+Usage: python -m tpubft.tools.ctl <port> <command...>
+  e.g. python -m tpubft.tools.ctl 6888 status list
+       python -m tpubft.tools.ctl 6888 perf show execute
+"""
+from __future__ import annotations
+
+import socket
+import sys
+
+
+def query(port: int, command: str, host: str = "127.0.0.1",
+          timeout: float = 3.0) -> str:
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        fh = s.makefile("rw", encoding="utf-8", newline="\n")
+        fh.write(command + "\n")
+        fh.flush()
+        lines = []
+        for line in fh:
+            if line.rstrip("\n") == ".":
+                break
+            lines.append(line.rstrip("\n"))
+        return "\n".join(lines)
+
+
+def main() -> int:
+    if len(sys.argv) < 3:
+        print(__doc__)
+        return 2
+    port = int(sys.argv[1])
+    print(query(port, " ".join(sys.argv[2:])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""DB editor — inspect/patch kvlog database files offline.
+
+Rebuild of /root/reference/kvbc/tools/db_editor/: operators poke at a
+replica's storage without the replica running.
+
+Usage:
+  python -m tpubft.tools.db_editor <db.kvlog> families
+  python -m tpubft.tools.db_editor <db.kvlog> scan <family> [limit]
+  python -m tpubft.tools.db_editor <db.kvlog> get <family> <key-hex>
+  python -m tpubft.tools.db_editor <db.kvlog> put <family> <key-hex> <val-hex>
+  python -m tpubft.tools.db_editor <db.kvlog> delete <family> <key-hex>
+  python -m tpubft.tools.db_editor <db.kvlog> stats
+"""
+from __future__ import annotations
+
+import sys
+from collections import Counter
+
+from tpubft.storage.interfaces import split_fkey
+from tpubft.storage.native import NativeDB
+
+
+def _families(db: NativeDB):
+    counts: Counter = Counter()
+    for fam in _all_physical(db):
+        counts[fam] += 1
+    return counts
+
+
+def _all_physical(db: NativeDB):
+    # scan the whole physical keyspace by iterating family prefixes we see
+    out = db._lib  # intentional low-level: whole-space scan
+    import ctypes
+    from tpubft.storage.native import _U8P, _decode_scan
+    buf = _U8P()
+    n = ctypes.c_uint32()
+    rc = out.kvlog_scan(db._handle(), b"", 0, b"", 0xFFFFFFFF,
+                        ctypes.byref(buf), ctypes.byref(n))
+    if rc != 0:
+        raise SystemExit(f"scan failed rc={rc}")
+    try:
+        raw = ctypes.string_at(buf, n.value)
+    finally:
+        out.kvlog_free(buf)
+    for k, _v in _decode_scan(raw):
+        yield split_fkey(k)[0]
+
+
+def main() -> int:
+    if len(sys.argv) < 3:
+        print(__doc__)
+        return 2
+    path, cmd = sys.argv[1], sys.argv[2]
+    db = NativeDB(path)
+    try:
+        if cmd == "families":
+            for fam, count in sorted(_families(db).items()):
+                print(f"{fam.decode(errors='replace'):30s} {count}")
+        elif cmd == "stats":
+            print(f"entries: {db.count()}")
+            print(f"families: {len(_families(db))}")
+        elif cmd == "scan":
+            fam = sys.argv[3].encode()
+            limit = int(sys.argv[4]) if len(sys.argv) > 4 else 50
+            for i, (k, v) in enumerate(db.range_iter(fam)):
+                if i >= limit:
+                    print("...")
+                    break
+                print(f"{k.hex()} = {v.hex()[:96]}"
+                      + ("..." if len(v) > 48 else ""))
+        elif cmd == "get":
+            v = db.get(bytes.fromhex(sys.argv[4]), sys.argv[3].encode())
+            print(v.hex() if v is not None else "(not found)")
+        elif cmd == "put":
+            db.put(bytes.fromhex(sys.argv[4]), bytes.fromhex(sys.argv[5]),
+                   sys.argv[3].encode())
+            print("ok")
+        elif cmd == "delete":
+            db.delete(bytes.fromhex(sys.argv[4]), sys.argv[3].encode())
+            print("ok")
+        else:
+            print(__doc__)
+            return 2
+    finally:
+        db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
