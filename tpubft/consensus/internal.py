@@ -144,10 +144,13 @@ class KeyExchangeManager:
             pack_op(op), flags=int(m.RequestFlag.KEY_EXCHANGE))
         return self._generation
 
-    def on_executed(self, op: KeyExchangeOp) -> None:
+    def on_executed(self, op: KeyExchangeOp, seq: int = 0) -> None:
         """Ordered on every replica: swap the principal's public key; the
-        owner additionally activates its private candidate."""
-        self._replica.sig.set_replica_key(op.replica_id, op.pubkey)
+        owner additionally activates its private candidate. `seq` is the
+        consensus seqnum the exchange executed at — it scopes the old
+        key's grace window (SigManager seq-scoped grace)."""
+        self._replica.sig.set_replica_key(op.replica_id, op.pubkey,
+                                          rotation_seq=seq)
         self._pages.save(op.pubkey, index=op.replica_id)
         if op.replica_id == self._replica.id:
             cand = self._candidates.pop(op.generation, None)

@@ -114,7 +114,8 @@ class Replica(IReceiver):
         self.sig = SigManager(
             keys, self.aggregator,
             alias_fn=lambda p: (self.info.owner_of_internal_client(p)
-                                if self.info.is_internal_client(p) else p))
+                                if self.info.is_internal_client(p) else p),
+            grace_seq_window=cfg.work_window_size)
         # threshold machinery per commit path (CryptoManager.hpp:109-111):
         # slow = 2f+c+1, fast-with-threshold = 3f+c+1, optimistic = n
         self.slow_signer = keys.threshold_signer(keys.slow_path_system,
@@ -141,6 +142,9 @@ class Replica(IReceiver):
         self.clients = ClientsManager(self.info.all_client_ids())
         self.pending_requests: List[m.ClientRequestMsg] = []
         self.checkpoints: Dict[int, Dict[int, m.CheckpointMsg]] = {}
+        # highest checkpoint seq stored per sender (memory bound: the
+        # checkpoints dict holds at most one message per replica)
+        self._ck_latest_seq: Dict[int, int] = {}
         # quorum-certified checkpoints ahead of us: seq -> state digest
         # (the trust anchor handed to state transfer)
         self.certified_checkpoints: Dict[int, bytes] = {}
@@ -585,7 +589,8 @@ class Replica(IReceiver):
             return                              # already have it
         if self.control.blocks_ordering(pp.seq_num):
             return                              # wedged: nothing past stop
-        if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature):
+        if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature,
+                               seq=pp.seq_num):
             return
         # Verify every embedded client request before signing shares over
         # the batch — a byzantine primary must not be able to smuggle
@@ -604,7 +609,7 @@ class Replica(IReceiver):
         if items:
             from tpubft.diagnostics import TimeRecorder
             with TimeRecorder(self._h_verify):
-                ok = all(self.sig.verify_batch(items))
+                ok = all(self.sig.verify_batch(items, seq=pp.seq_num))
             if not ok:
                 return
         for r in reqs:
@@ -926,7 +931,7 @@ class Replica(IReceiver):
                 if self._slowdown.enabled:
                     self._slowdown.delay(PHASE_EXECUTE)
                 if req.flags & m.RequestFlag.INTERNAL:
-                    reply = self._execute_internal_request(req)
+                    reply = self._execute_internal_request(req, nxt)
                 elif req.flags & m.RequestFlag.RECONFIG:
                     reply = (self.reconfig.execute(self, req, nxt)
                              if self.reconfig is not None else b"")
@@ -959,7 +964,8 @@ class Replica(IReceiver):
             if nxt % self.cfg.checkpoint_window_size == 0:
                 self._send_checkpoint(nxt)
 
-    def _execute_internal_request(self, req: m.ClientRequestMsg) -> bytes:
+    def _execute_internal_request(self, req: m.ClientRequestMsg,
+                                  seq: int = 0) -> bytes:
         """Ordered consensus-internal operation (key exchange, cron tick)
         — executed identically on every replica."""
         from tpubft.consensus import internal as iops
@@ -970,7 +976,7 @@ class Replica(IReceiver):
         if isinstance(op, iops.KeyExchangeOp):
             # only the replica owning the internal client may rotate its key
             if self.info.internal_client_of(op.replica_id) == req.sender_id:
-                self.key_exchange.on_executed(op)
+                self.key_exchange.on_executed(op, seq)
                 return b"ok"
             return b""
         if isinstance(op, iops.TickOp):
@@ -1076,38 +1082,64 @@ class Replica(IReceiver):
             return
         if ck.seq_num <= self.last_stable:
             return
+        # only checkpoint-window multiples are real checkpoints (honest
+        # replicas checkpoint exactly there); arbitrary seq_nums would let
+        # one key mint unbounded distinct slots
+        if ck.seq_num % self.cfg.checkpoint_window_size != 0:
+            return
+        # monotone per sender: we keep each replica's HIGHEST checkpoint
+        # only, so total storage is bounded at n messages — no horizon
+        # needed, and a replica arbitrarily far behind still learns about
+        # far-future checkpoints (its state-transfer trigger)
+        if ck.seq_num < self._ck_latest_seq.get(ck.sender_id, 0):
+            return
         if not self.sig.verify(ck.sender_id, ck.signed_payload(),
-                               ck.signature):
+                               ck.signature, seq=ck.seq_num):
             return
         self._store_checkpoint(ck)
 
     def _store_checkpoint(self, ck: m.CheckpointMsg) -> None:
+        # evict the sender's previous (lower) checkpoint: one live slot
+        # per sender bounds memory; honest replicas only move forward
+        prev = self._ck_latest_seq.get(ck.sender_id)
+        if prev is not None and prev != ck.seq_num:
+            old_slot = self.checkpoints.get(prev)
+            if old_slot is not None:
+                old_slot.pop(ck.sender_id, None)
+                if not old_slot:
+                    self.checkpoints.pop(prev, None)
+        self._ck_latest_seq[ck.sender_id] = ck.seq_num
         slot = self.checkpoints.setdefault(ck.seq_num, {})
         slot[ck.sender_id] = ck
         matching = sum(1 for other in slot.values()
                        if other.state_digest == ck.state_digest
                        and other.res_pages_digest == ck.res_pages_digest)
+        if matching >= self.info.st_anchor_quorum \
+                and ck.seq_num > self.last_executed:
+            # f+1 matching signed digests = at least one honest vouches:
+            # a valid trust anchor state transfer may fetch toward (ST
+            # sub-messages are unauthenticated; safety comes from the
+            # digest chain ending at a certificate-backed digest)
+            self.certified_checkpoints[ck.seq_num] = (ck.state_digest,
+                                                      ck.res_pages_digest)
+            if len(self.certified_checkpoints) > 32:
+                del self.certified_checkpoints[
+                    min(self.certified_checkpoints)]
+            if (self.state_transfer is not None
+                    and ck.seq_num >= self.last_executed
+                    + self.cfg.work_window_size):
+                # hopelessly behind: fetch state now (BCStateTran trigger,
+                # reference startCollectingState on checkpoint beyond
+                # window)
+                self.state_transfer.start_collecting(
+                    ck.seq_num, dict(self.certified_checkpoints))
+        # stability needs the full 2f+c+1 certificate (reference
+        # CheckpointInfo.hpp): guarantees f+1 honest replicas hold this
+        # checkpoint before we GC the window behind it
         if matching < self.info.checkpoint_quorum:
             return
         if ck.seq_num <= self.last_executed:
             self._on_seq_stable(ck.seq_num, ck.state_digest)
-            return
-        # a certified checkpoint we haven't reached: remember the signed
-        # digests — they are the ONLY trust anchor state transfer may
-        # fetch toward (ST sub-messages are unauthenticated, like the
-        # reference's; safety comes from the digest chain ending at a
-        # certificate-backed digest)
-        self.certified_checkpoints[ck.seq_num] = (ck.state_digest,
-                                                  ck.res_pages_digest)
-        if len(self.certified_checkpoints) > 8:
-            del self.certified_checkpoints[min(self.certified_checkpoints)]
-        if (self.state_transfer is not None
-                and ck.seq_num >= self.last_executed
-                + self.cfg.work_window_size):
-            # hopelessly behind: fetch state now (BCStateTran trigger,
-            # reference startCollectingState on checkpoint beyond window)
-            self.state_transfer.start_collecting(
-                ck.seq_num, dict(self.certified_checkpoints))
 
     def _on_seq_stable(self, seq: int,
                        state_digest: Optional[bytes] = None) -> None:
@@ -1123,6 +1155,8 @@ class Replica(IReceiver):
         self.window.advance(seq)
         for s in [s for s in self.checkpoints if s <= seq]:
             del self.checkpoints[s]
+        for r in [r for r, s in self._ck_latest_seq.items() if s <= seq]:
+            del self._ck_latest_seq[r]
         for s in [s for s in self.certified_checkpoints if s <= seq]:
             del self.certified_checkpoints[s]
         for key in [k for k in self.carried_certs if k[0] <= seq]:
@@ -1205,7 +1239,7 @@ class Replica(IReceiver):
         if not self.info.is_replica(msg.sender_id) or msg.view < self.view:
             return
         if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature):
+                               msg.signature, view_scoped=True):
             return
         self.vc.add_complaint(msg)
         # adopt: quorum-minus-me complaints for a view I'm stuck in too
@@ -1258,7 +1292,7 @@ class Replica(IReceiver):
                 or msg.new_view <= self.view:
             return
         if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature):
+                               msg.signature, view_scoped=True):
             return
         self.vc.add_view_change(msg)
         # f+1 replicas already moving to a higher view ⇒ join them
@@ -1314,7 +1348,7 @@ class Replica(IReceiver):
         if msg.sender_id != self.info.primary_of_view(msg.new_view):
             return
         if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature):
+                               msg.signature, view_scoped=True):
             return
         self.vc.pending_new_view = msg
         self._try_complete_view_change(msg.new_view)

@@ -97,6 +97,16 @@ class ReplicasInfo:
 
     @property
     def checkpoint_quorum(self) -> int:
+        """2f + c + 1 matching signed CheckpointMsgs make a checkpoint
+        STABLE (reference CheckpointInfo.hpp MsgsCertificate): with at most
+        f Byzantine confirmers, stability implies f+1 honest replicas hold
+        the state, so the window can be GC'd safely. f+1 matching digests
+        (st_anchor_quorum) are enough only as a state-transfer trust
+        anchor — at least one honest signer vouches for the digest."""
+        return 2 * self.f + self.c + 1
+
+    @property
+    def st_anchor_quorum(self) -> int:
         return self.f + 1
 
     @property

@@ -27,8 +27,14 @@ class SigManager:
     def __init__(self, keys: ClusterKeys,
                  aggregator: Optional[Aggregator] = None,
                  verifier_factory: Optional[Callable[[bytes], IVerifier]] = None,
-                 alias_fn: Optional[Callable[[int], int]] = None):
+                 alias_fn: Optional[Callable[[int], int]] = None,
+                 grace_seq_window: int = 300):
         self._keys = keys
+        # a superseded key only verifies messages whose consensus seqnum
+        # is at most rotation_seq + this window (callers pass the
+        # config's work_window_size: everything deeper in flight than the
+        # work window cannot order anyway)
+        self.grace_seq_window = grace_seq_window
         # own copies: key exchange rotates keys per-replica-process, and the
         # shared ClusterKeys dicts must not leak one node's view to others
         self._replica_pubkeys: Dict[int, bytes] = dict(keys.replica_pubkeys)
@@ -58,18 +64,23 @@ class SigManager:
         return self._keys.my_id
 
     # ---- key rotation (KeyExchangeManager upcalls) ----
-    # how long a superseded key keeps verifying after rotation (covers
-    # in-flight messages; the reference scopes key lookup by seqnum)
+    # wall-clock upper bound on how long a superseded key is retained at
+    # all (cleanup backstop; the real scope is by seqnum below, like the
+    # reference's per-checkpoint-era CryptoManager key lookup)
     GRACE_WINDOW_S = 30.0
 
-    def set_replica_key(self, replica_id: int, new_pubkey: bytes) -> None:
-        """Swap a replica's public key, keeping the previous one for a
-        bounded rotation grace window."""
+    def set_replica_key(self, replica_id: int, new_pubkey: bytes,
+                        rotation_seq: Optional[int] = None) -> None:
+        """Swap a replica's public key. The previous key is kept only for
+        verifying messages at seqnums ordered before (or immediately
+        around) the exchange at `rotation_seq`; verifications that carry
+        no seqnum context never fall back to it."""
         old = self._replica_pubkeys.get(replica_id)
         if old == new_pubkey:
             return
         if old is not None:
-            self._prev_pubkeys[replica_id] = (old, time.monotonic())
+            self._prev_pubkeys[replica_id] = (old, time.monotonic(),
+                                              rotation_seq)
             self._prev_verifiers.pop(replica_id, None)
         self._replica_pubkeys[replica_id] = new_pubkey
         self._verifiers.pop(replica_id, None)
@@ -98,17 +109,30 @@ class SigManager:
             v = self._verifiers[principal] = self._make_verifier(pk)
         return v
 
-    def _grace_verifier(self, principal: int) -> Optional[IVerifier]:
+    def _grace_verifier(self, principal: int, seq: Optional[int],
+                        view_scoped: bool = False) -> Optional[IVerifier]:
+        """Old-key verifier for in-flight consensus messages only: scoped
+        to seqnums at most rotation_seq + grace_seq_window, or (for
+        view-change-family messages, which carry views not seqnums) to the
+        wall-clock window. Verifications with neither context — e.g.
+        client requests — never accept a rotated-away key (a compromised
+        pre-rotation key must not keep authenticating arbitrary traffic)."""
         principal = self._alias(principal)
         entry = self._prev_pubkeys.get(principal)
         if entry is None:
             return None
-        pk, rotated_at = entry
+        pk, rotated_at, rotation_seq = entry
         if time.monotonic() - rotated_at > self.GRACE_WINDOW_S:
             # the leaked/old key must stop verifying — that's the point
             # of rotating
             del self._prev_pubkeys[principal]
             self._prev_verifiers.pop(principal, None)
+            return None
+        if seq is None:
+            if not view_scoped:
+                return None
+        elif rotation_seq is not None \
+                and seq > rotation_seq + self.grace_seq_window:
             return None
         v = self._prev_verifiers.get(principal)
         if v is None:
@@ -118,19 +142,28 @@ class SigManager:
     def has_principal(self, principal: int) -> bool:
         return self._pubkey_of(self._alias(principal)) is not None
 
-    def verify(self, principal: int, data: bytes, sig: bytes) -> bool:
+    def verify(self, principal: int, data: bytes, sig: bytes,
+               seq: Optional[int] = None,
+               view_scoped: bool = False) -> bool:
+        """Verify one signature. `seq` is the consensus seqnum the message
+        belongs to, when it has one; `view_scoped` marks view-change-family
+        messages (no seqnum, still in-flight protocol traffic). One of the
+        two is required for the post-rotation grace fallback —
+        verifications without protocol context never accept a rotated-away
+        key."""
         try:
             ok = self._verifier(principal).verify(data, sig)
         except KeyError:
             ok = False
         if not ok:
-            grace = self._grace_verifier(principal)
+            grace = self._grace_verifier(principal, seq, view_scoped)
             if grace is not None:
                 ok = grace.verify(data, sig)
         (self.sigs_verified if ok else self.sig_failures).inc()
         return ok
 
-    def verify_batch(self, items: Sequence[Tuple[int, bytes, bytes]]) -> List[bool]:
+    def verify_batch(self, items: Sequence[Tuple[int, bytes, bytes]],
+                     seq: Optional[int] = None) -> List[bool]:
         """Verify [(principal, data, sig)] — grouped per principal so a
         backend can vectorize. CPU backends loop; the TPU backend receives
         the whole batch at once."""
@@ -145,7 +178,7 @@ class SigManager:
                 continue
             results = verifier.verify_batch(
                 [(items[i][1], items[i][2]) for i in idxs])
-            grace = self._grace_verifier(p)
+            grace = self._grace_verifier(p, seq)
             for i, ok in zip(idxs, results):
                 if not ok and grace is not None:
                     ok = grace.verify(items[i][1], items[i][2])

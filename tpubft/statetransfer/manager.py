@@ -46,15 +46,21 @@ class StConfig:
 
 
 class SourceSelector:
-    """Rotates through candidate sources, abandoning ones that failed
-    (reference: bcstatetransfer/SourceSelector.hpp)."""
+    """Rotates through candidate sources, abandoning ones that exhaust a
+    per-source retry budget (reference: bcstatetransfer/SourceSelector.hpp).
+    Once every candidate is abandoned, current() returns None and the
+    manager restarts from checkpoint summaries."""
+
+    RETRY_BUDGET = 3
 
     def __init__(self) -> None:
         self._candidates: List[int] = []
+        self._failures: Dict[int, int] = {}
         self._idx = 0
 
     def reset(self, candidates: List[int]) -> None:
         self._candidates = list(candidates)
+        self._failures = {c: 0 for c in candidates}
         self._idx = 0
 
     def current(self) -> Optional[int]:
@@ -62,8 +68,29 @@ class SourceSelector:
             return None
         return self._candidates[self._idx % len(self._candidates)]
 
-    def rotate(self) -> Optional[int]:
-        self._idx += 1
+    def note_success(self) -> None:
+        """A batch from the current source verified and linked: clear its
+        failure count so sporadic timeouts across a long transfer don't
+        accumulate into abandonment (reference SourceSelector resets the
+        retry counter on successful replies)."""
+        cur = self.current()
+        if cur is not None:
+            self._failures[cur] = 0
+
+    def fail_current(self) -> Optional[int]:
+        """Charge the current source one failure; drop it once its budget
+        is spent, then move to the next (None when all are exhausted)."""
+        cur = self.current()
+        if cur is None:
+            return None
+        self._failures[cur] = self._failures.get(cur, 0) + 1
+        if self._failures[cur] >= self.RETRY_BUDGET:
+            pos = self._candidates.index(cur)
+            self._candidates.pop(pos)
+            if self._candidates:
+                self._idx = pos % len(self._candidates)
+        else:
+            self._idx += 1
         return self.current()
 
 
@@ -219,11 +246,13 @@ class StateTransferManager:
         if self.state == _SUMMARIES:
             self._ask_summaries()
         elif self.state == _FETCHING:
-            # stalled source: rotate and re-request the current batch
-            self.sources.rotate()
+            # stalled source: charge it a failure and re-request; when every
+            # candidate's budget is spent, _request_next_batch restarts from
+            # summaries
+            self.sources.fail_current()
             self._request_next_batch()
         elif self.state == _RESPAGES:
-            self.sources.rotate()
+            self.sources.fail_current()
             self._request_res_pages()
 
     # ------------------------------------------------------------------
@@ -290,7 +319,8 @@ class StateTransferManager:
     def _on_fetch_blocks(self, sender: int, msg: stm.FetchBlocks) -> None:
         if (self._stable is None or msg.from_block > msg.to_block
                 or msg.from_block < 1
-                or msg.to_block > self._stable[2]
+                or msg.to_block > msg.target_last_block
+                or msg.target_last_block > self._stable[2]
                 or msg.to_block - msg.from_block
                 >= 4 * self.cfg.fetch_batch_blocks):
             self._send(sender, stm.pack(stm.RejectFetching(
@@ -300,7 +330,9 @@ class StateTransferManager:
             self._send(sender, stm.pack(stm.RejectFetching(
                 reply_to=msg.msg_id, reason="pruned")))
             return
-        rvt_leaves = self._stable[2]
+        # prove at the requester's agreed leaf count, NOT our own stable
+        # point — ours may have advanced past the agreed summary mid-transfer
+        rvt_leaves = msg.target_last_block
         for bid in range(msg.from_block, msg.to_block + 1):
             raw = self.bc.get_raw_block(bid)
             if raw is None:
@@ -376,7 +408,8 @@ class StateTransferManager:
         to = min(nxt + self.cfg.fetch_batch_blocks - 1,
                  self._agreed.last_block)
         self._send(src, stm.pack(stm.FetchBlocks(
-            msg_id=self._msg_id, from_block=nxt, to_block=to)))
+            msg_id=self._msg_id, from_block=nxt, to_block=to,
+            target_last_block=self._agreed.last_block)))
 
     def _on_item_data(self, sender: int, msg: stm.ItemData) -> None:
         if (self.state != _FETCHING or self._agreed is None
@@ -422,14 +455,17 @@ class StateTransferManager:
         except Exception:
             self._punish_source()
             return
+        self.sources.note_success()
         self._request_next_batch()
 
     def _punish_source(self) -> None:
-        """Bad data: rotate away and retry the batch from the new source."""
+        """Bad data: charge the source and retry the batch from the next
+        one; source exhaustion falls back to summaries (in
+        _request_next_batch)."""
         self._chunks.clear()
         self._chunk_totals.clear()
         self._proofs.clear()
-        self.sources.rotate()
+        self.sources.fail_current()
         self._request_next_batch()
 
     def _on_reject(self, sender: int, msg: stm.RejectFetching) -> None:
@@ -480,7 +516,7 @@ class StateTransferManager:
         # a source switching total_chunks mid-response is malformed
         if self._page_chunks and msg.total_chunks != self._page_total:
             self._page_chunks.clear()
-            self.sources.rotate()
+            self.sources.fail_current()
             self._request_res_pages()
             return
         self._page_total = msg.total_chunks
@@ -494,7 +530,7 @@ class StateTransferManager:
         from tpubft.consensus.reserved_pages import ReservedPages
         if ReservedPages.digest_of(pages) != self._agreed.res_pages_digest:
             self._page_chunks.clear()
-            self.sources.rotate()
+            self.sources.fail_current()
             self._request_res_pages()
             return
         self.pages.replace_all(pages)

@@ -39,11 +39,19 @@ class CheckpointSummary:
 
 @dataclass
 class FetchBlocks:
+    """Block-range fetch. `target_last_block` is the AGREED summary's last
+    block: the source must build RVT proofs at that historical leaf count
+    (the append-only MMR supports old sizes), not its own — its stable
+    checkpoint may advance mid-transfer, and proofs built at the newer size
+    would never verify against the agreed root (destination pins the
+    agreed (root, n) for the whole transfer)."""
     ID = 3
     msg_id: int = 0
     from_block: int = 0
     to_block: int = 0
-    SPEC = [("msg_id", "u64"), ("from_block", "u64"), ("to_block", "u64")]
+    target_last_block: int = 0
+    SPEC = [("msg_id", "u64"), ("from_block", "u64"), ("to_block", "u64"),
+            ("target_last_block", "u64")]
 
 
 @dataclass

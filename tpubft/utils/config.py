@@ -99,11 +99,6 @@ class ReplicaConfig:
         """all n shares for OPTIMISTIC_FAST."""
         return self.n_val
 
-    @property
-    def checkpoint_quorum(self) -> int:
-        """f + 1 matching signed checkpoints make a stable checkpoint proof."""
-        return self.f_val + 1
-
     def validate(self) -> None:
         if self.replica_id >= self.n_val + self.num_ro_replicas:
             raise ValueError(
