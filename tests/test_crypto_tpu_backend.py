@@ -1,0 +1,143 @@
+"""The TPU crypto backend wired into consensus (VERDICT r1 item #1).
+
+Validates that crypto_backend="tpu" routes the same plugin boundaries the
+CPU backend uses (SigManager verifier factory + cross-principal batch,
+threshold verifiers per commit path) through the batched device kernels,
+and that a live cluster orders and executes with it. Tests run on the CPU
+JAX backend (conftest) — the code path is identical on a real TPU chip.
+"""
+import time
+
+import pytest
+
+from tpubft.apps import counter
+from tpubft.crypto import cpu as ccpu
+from tpubft.testing import InProcessCluster
+
+TPU_CFG = {"crypto_backend": "tpu"}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def warm_kernel():
+    """Compile the batch-64 verify program once up front: replicas in the
+    cluster tests share this process's jit cache, so the dispatcher thread
+    never stalls on a first-compile while a client is waiting."""
+    from tpubft.crypto.tpu import verify_batch_items
+    s = ccpu.Ed25519Signer.generate(seed=b"warm")
+    verify_batch_items([(s.public_bytes(), b"w", s.sign(b"w"))])
+
+
+def _items(n, tamper_at=()):
+    out = []
+    for i in range(n):
+        s = ccpu.Ed25519Signer.generate(seed=f"tpu-bk-{i}".encode())
+        msg = f"payload-{i}".encode()
+        sig = s.sign(msg)
+        if i in tamper_at:
+            sig = sig[:20] + bytes([sig[20] ^ 0xFF]) + sig[21:]
+        out.append((msg, sig, s.public_bytes()))
+    return out
+
+
+def test_tpu_verifier_matches_cpu_verdicts():
+    from tpubft.crypto.tpu import TpuEd25519Verifier, verify_batch_items
+    items = _items(6, tamper_at=(1, 4))
+    got = verify_batch_items([(pk, m, s) for m, s, pk in items])
+    want = [ccpu.Ed25519Verifier(pk).verify(m, s) for m, s, pk in items]
+    assert got == want == [True, False, True, True, False, True]
+    v = TpuEd25519Verifier(items[0][2])
+    assert v.verify(items[0][0], items[0][1])
+    assert not v.verify(items[0][0] + b"!", items[0][1])
+
+
+def test_tpu_multisig_threshold_verifier():
+    from tpubft.crypto.interfaces import Cryptosystem
+    from tpubft.crypto.tpu import make_threshold_verifier
+    sysm = Cryptosystem("multisig-ed25519", 3, 4, seed=b"tpu-ms")
+    tpu_v = make_threshold_verifier(
+        "multisig-ed25519", 3, 4, sysm.public_key, sysm.share_public_keys)
+    cpu_v = sysm.create_threshold_verifier()
+    digest = b"d" * 32
+    acc = tpu_v.new_accumulator(with_share_verification=False)
+    acc.set_expected_digest(digest)
+    for sid in (1, 2, 4):
+        acc.add(sid, sysm.create_threshold_signer(sid).sign_share(digest))
+    assert acc.has_threshold()
+    combined = acc.get_full_signed_data()
+    # device-batch verify agrees with the CPU verifier, and vice versa
+    assert tpu_v.verify(digest, combined)
+    assert cpu_v.verify(digest, combined)
+    assert not tpu_v.verify(b"x" * 32, combined)
+    # batched share verification isolates the bad share
+    sig2 = sysm.create_threshold_signer(2).sign_share(digest)
+    bad = sig2[:10] + bytes([sig2[10] ^ 1]) + sig2[11:]
+    verdicts = tpu_v.verify_share_batch(
+        [(1, digest, sysm.create_threshold_signer(1).sign_share(digest)),
+         (2, digest, bad), (9, digest, sig2)])
+    assert verdicts == [True, False, False]
+
+
+@pytest.mark.slow
+def test_tpu_bls_combine_matches_cpu():
+    from tpubft.crypto import bls12381 as bls
+    from tpubft.crypto.interfaces import Cryptosystem
+    from tpubft.crypto.tpu import make_threshold_verifier
+    sysm = Cryptosystem("threshold-bls", 3, 4, seed=b"tpu-bls")
+    tpu_v = make_threshold_verifier(
+        "threshold-bls", 3, 4, sysm.public_key, sysm.share_public_keys)
+    cpu_v = sysm.create_threshold_verifier()
+    digest = b"e" * 32
+    acc_t = tpu_v.new_accumulator(False)
+    acc_c = cpu_v.new_accumulator(False)
+    for sid in (1, 3, 4):
+        share = sysm.create_threshold_signer(sid).sign_share(digest)
+        acc_t.add(sid, share)
+        acc_c.add(sid, share)
+    combined_tpu = acc_t.get_full_signed_data()   # device MSM
+    combined_cpu = acc_c.get_full_signed_data()   # host Lagrange+MSM
+    assert combined_tpu == combined_cpu
+    assert cpu_v.verify(digest, combined_tpu)
+
+
+def test_cluster_orders_with_tpu_backend():
+    """4-replica counter cluster, crypto_backend=tpu end to end: client
+    sigs verified by the cross-principal device batch, commit certificates
+    by the TPU multisig verifier."""
+    with InProcessCluster(f=1, cfg_overrides=TPU_CFG) as cluster:
+        cl = cluster.client()
+        total = 0
+        for delta in (4, 11, -2):
+            total += delta
+            # generous timeout: on the CPU JAX test backend every device
+            # dispatch is ~70ms, so one ordering round is ~1s
+            reply = cl.send_write(counter.encode_add(delta),
+                                  timeout_ms=30000)
+            assert counter.decode_reply(reply) == total
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(cluster.handlers[r].value == total
+                   for r in range(cluster.n)):
+                break
+            time.sleep(0.05)
+        assert all(cluster.handlers[r].value == total
+                   for r in range(cluster.n))
+        # the device path actually verified signatures
+        assert cluster.metric(0, "counters", "sigs_verified",
+                              component="signature_manager") > 0
+
+
+def test_tpu_backend_rejects_forged_client_request():
+    """A forged client signature must be rejected by the device batch path
+    exactly as by CPU: no execution happens."""
+    with InProcessCluster(f=1, cfg_overrides=TPU_CFG) as cluster:
+        cl = cluster.client()
+        cl.send_write(counter.encode_add(3))
+        # forged signature injected straight into the primary's inbox
+        from tpubft.consensus import messages as m
+        forged = m.ClientRequestMsg(
+            sender_id=cl.cfg.client_id, req_seq_num=999, flags=0,
+            request=counter.encode_add(100), cid="forged",
+            signature=bytes(64))
+        cluster.replicas[0].on_new_message(cl.cfg.client_id, forged.pack())
+        time.sleep(0.5)
+        assert cluster.handlers[0].value == 3

@@ -1,0 +1,26 @@
+import time
+from tpubft.apps import counter
+from tpubft.testing import InProcessCluster
+from tpubft.crypto import cpu as ccpu
+
+def test_dbg3():
+    from tpubft.crypto.tpu import verify_batch_items
+    s = ccpu.Ed25519Signer.generate(seed=b"warm")
+    print("warm:", verify_batch_items([(s.public_bytes(), b"w", s.sign(b"w"))]))
+    with InProcessCluster(f=1, cfg_overrides={"crypto_backend": "tpu"}) as cluster:
+        cl = cluster.client()
+        total = 0
+        for i, delta in enumerate((4, 11, -2)):
+            total += delta
+            t0 = time.time()
+            try:
+                r = cl.send_write(counter.encode_add(delta), timeout_ms=30000)
+                print(f"write{i}: reply {counter.decode_reply(r)} in {time.time()-t0:.1f}s")
+            except Exception as e:
+                print(f"write{i} FAILED after {time.time()-t0:.0f}s")
+                for rid in range(4):
+                    print(rid, "verified:", cluster.metric(rid, "counters", "sigs_verified", component="signature_manager"),
+                          "failures:", cluster.metric(rid, "counters", "sig_failures", component="signature_manager"),
+                          "executed:", cluster.metric(rid, "counters", "executed_requests"),
+                          "view:", cluster.metric(rid, "gauges", "view"))
+                raise

@@ -76,7 +76,9 @@ def test_noncanonical_rejected(ops_ed):
 
 
 def test_point_ops_match_reference(ops_ed):
-    # scalar mult on the base point vs a python-int reference ladder
+    # windowed double-scalar mult [s]B + [h]A vs a python-int reference
+    # ladder (A = 7B so both table paths are exercised)
+    import jax
     import jax.numpy as jnp
     F = ops_ed.F
     P, D = ops_ed.P, ops_ed.D
@@ -96,22 +98,31 @@ def test_point_ops_match_reference(ops_ed):
             k >>= 1
         return acc
 
-    k = 0x1234567890ABCDEF1234567890ABCDEF
-    want = ref_mul((ops_ed.BASE_X, ops_ed.BASE_Y), k)
-    bits = np.zeros((256, 1), np.int32)
-    for i in range(256):
-        bits[i, 0] = (k >> (255 - i)) & 1
-    zero_bits = np.zeros((256, 1), np.int32)
-    import jax
+    base = (ops_ed.BASE_X, ops_ed.BASE_Y)
+    a_pt = ref_mul(base, 7)
+    s = 0x1234567890ABCDEF1234567890ABCDEF
+    h = 0xFEDCBA09876543211234  # exercises h path incl. zero windows
+    want = ref_add(ref_mul(base, s), ref_mul(a_pt, h))
+
+    def windows(k):
+        out = np.zeros((ops_ed.WINDOWS, 1), np.int32)
+        for w in range(ops_ed.WINDOWS):
+            out[w, 0] = (k >> (4 * w)) & 0xF
+        return out
+
+    ax = jnp.asarray(np.stack([F.int_to_limbs(a_pt[0])], axis=1))
+    ay = jnp.asarray(np.stack([F.int_to_limbs(a_pt[1])], axis=1))
+    a_dev = ops_ed.Point(ax, ay, F.one((1,)),
+                         jnp.asarray(np.stack(
+                             [F.int_to_limbs(a_pt[0] * a_pt[1] % P)],
+                             axis=1)))
 
     @jax.jit
-    def kernel(sb, hb):
-        q = ops_ed.double_scalar_mul(jnp.asarray(sb), jnp.asarray(hb),
-                                     ops_ed.identity(1))
+    def kernel(sw, hw):
+        q = ops_ed.double_scalar_mul(sw, hw, a_dev)
         zi = F.inv(q.z)
-        return F.from_mont(F.mul(q.x, zi)), F.from_mont(F.mul(q.y, zi))
+        return (F.canonical(F.mul(q.x, zi)), F.canonical(F.mul(q.y, zi)))
 
-    gx, gy = kernel(bits, zero_bits)
-    from tpubft.ops.field import limbs_to_int
-    assert limbs_to_int(np.asarray(gx)[:, 0]) == want[0]
-    assert limbs_to_int(np.asarray(gy)[:, 0]) == want[1]
+    gx, gy = kernel(jnp.asarray(windows(s)), jnp.asarray(windows(h)))
+    assert F.limbs_to_int(np.asarray(gx)[:, 0]) == want[0]
+    assert F.limbs_to_int(np.asarray(gy)[:, 0]) == want[1]

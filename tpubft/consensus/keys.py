@@ -121,5 +121,15 @@ class ClusterKeys:
         """Threshold signer ids are 1-based in the reference."""
         return system.create_threshold_signer(replica_id + 1)
 
-    def threshold_verifier(self, system: Cryptosystem) -> IThresholdVerifier:
+    def threshold_verifier(self, system: Cryptosystem,
+                           backend: str = "cpu") -> IThresholdVerifier:
+        """Backend-selected threshold verifier over the same key material
+        (reference: Cryptosystem::createThresholdVerifier,
+        ThresholdSignaturesTypes.cpp:183 — the TPU backend slots in behind
+        the identical boundary)."""
+        if backend == "tpu":
+            from tpubft.crypto import tpu as tpu_backend
+            return tpu_backend.make_threshold_verifier(
+                system.type_name, system.threshold_, system.num_signers,
+                system.public_key, system.share_public_keys)
         return system.create_threshold_verifier()

@@ -111,22 +111,38 @@ class Replica(IReceiver):
         self.storage = storage or InMemoryPersistentStorage()
         self.aggregator = aggregator or Aggregator()
 
+        # crypto backend selection (the project's north star: the same
+        # plugin boundaries the reference routes to CPU crypto —
+        # SigManager.cpp:197, IThresholdVerifier.h:23 — route to the
+        # batched TPU kernels when crypto_backend == "tpu")
+        backend = cfg.crypto_backend
+        verifier_factory = None
+        batch_fn = None
+        if backend == "tpu":
+            from tpubft.crypto import tpu as tpu_backend
+            verifier_factory = tpu_backend.TpuEd25519Verifier
+            batch_fn = tpu_backend.verify_batch_items
         self.sig = SigManager(
             keys, self.aggregator,
+            verifier_factory=verifier_factory,
             alias_fn=lambda p: (self.info.owner_of_internal_client(p)
                                 if self.info.is_internal_client(p) else p),
-            grace_seq_window=cfg.work_window_size)
+            grace_seq_window=cfg.work_window_size,
+            batch_fn=batch_fn)
         # threshold machinery per commit path (CryptoManager.hpp:109-111):
         # slow = 2f+c+1, fast-with-threshold = 3f+c+1, optimistic = n
         self.slow_signer = keys.threshold_signer(keys.slow_path_system,
                                                  self.id)
-        self.slow_verifier = keys.threshold_verifier(keys.slow_path_system)
+        self.slow_verifier = keys.threshold_verifier(keys.slow_path_system,
+                                                     backend)
         self.thr_signer = keys.threshold_signer(keys.commit_path_system,
                                                 self.id)
-        self.thr_verifier = keys.threshold_verifier(keys.commit_path_system)
+        self.thr_verifier = keys.threshold_verifier(keys.commit_path_system,
+                                                    backend)
         self.opt_signer = keys.threshold_signer(keys.optimistic_system,
                                                 self.id)
-        self.opt_verifier = keys.threshold_verifier(keys.optimistic_system)
+        self.opt_verifier = keys.threshold_verifier(keys.optimistic_system,
+                                                    backend)
         self.controller = CommitPathController(cfg.f_val, cfg.c_val)
 
         # --- protocol state (dispatcher-thread only) ---

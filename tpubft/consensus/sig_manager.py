@@ -28,8 +28,14 @@ class SigManager:
                  aggregator: Optional[Aggregator] = None,
                  verifier_factory: Optional[Callable[[bytes], IVerifier]] = None,
                  alias_fn: Optional[Callable[[int], int]] = None,
-                 grace_seq_window: int = 300):
+                 grace_seq_window: int = 300,
+                 batch_fn: Optional[Callable[
+                     [Sequence[Tuple[bytes, bytes, bytes]]],
+                     List[bool]]] = None):
         self._keys = keys
+        # cross-principal batch backend: [(pubkey, data, sig)] -> verdicts
+        # in ONE dispatch (the TPU path; None = per-principal loop)
+        self._batch_fn = batch_fn
         # a superseded key only verifies messages whose consensus seqnum
         # is at most rotation_seq + this window (callers pass the
         # config's work_window_size: everything deeper in flight than the
@@ -164,9 +170,14 @@ class SigManager:
 
     def verify_batch(self, items: Sequence[Tuple[int, bytes, bytes]],
                      seq: Optional[int] = None) -> List[bool]:
-        """Verify [(principal, data, sig)] — grouped per principal so a
-        backend can vectorize. CPU backends loop; the TPU backend receives
-        the whole batch at once."""
+        """Verify [(principal, data, sig)] — one cross-principal device
+        dispatch when a batch backend is configured (TPU), otherwise
+        grouped per principal with each verifier free to vectorize."""
+        if self._batch_fn is not None:
+            out = self._verify_batch_cross(items, seq)
+            for ok in out:
+                (self.sigs_verified if ok else self.sig_failures).inc()
+            return out
         by_principal: Dict[int, List[int]] = {}
         for i, (p, _, _) in enumerate(items):
             by_principal.setdefault(p, []).append(i)
@@ -185,6 +196,27 @@ class SigManager:
                 out[i] = ok
         for ok in out:
             (self.sigs_verified if ok else self.sig_failures).inc()
+        return out
+
+    def _verify_batch_cross(self, items: Sequence[Tuple[int, bytes, bytes]],
+                            seq: Optional[int]) -> List[bool]:
+        """Resolve principals to pubkeys, run the whole batch through the
+        backend in one call; failed items retry against grace keys."""
+        entries = []
+        keyed = []
+        for i, (p, data, sig) in enumerate(items):
+            pk = self._pubkey_of(self._alias(p))
+            if pk is not None:
+                entries.append((pk, data, sig))
+                keyed.append(i)
+        verdicts = self._batch_fn(entries)
+        out = [False] * len(items)
+        for i, ok in zip(keyed, verdicts):
+            if not ok:
+                grace = self._grace_verifier(items[i][0], seq)
+                if grace is not None:
+                    ok = grace.verify(items[i][1], items[i][2])
+            out[i] = ok
         return out
 
 

@@ -60,8 +60,8 @@ def sharded_verify_ed25519(mesh: Mesh):
     """Data-parallel batched Ed25519 verify: every input sharded on batch."""
     from tpubft.ops import ed25519 as ops
 
-    def fn(s_bits, h_bits, a_y, a_sign, r_y, r_sign):
-        return ops.verify_kernel(s_bits, h_bits, a_y, a_sign, r_y, r_sign)
+    def fn(s_win, h_win, a_y, a_sign, r_y, r_sign):
+        return ops.verify_kernel(s_win, h_win, a_y, a_sign, r_y, r_sign)
 
     batch_last = NamedSharding(mesh, P(None, AXIS))
     batch_only = NamedSharding(mesh, P(AXIS))
