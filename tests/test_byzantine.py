@@ -38,9 +38,20 @@ def test_replayed_request_in_batch_not_reexecuted():
     with InProcessCluster(f=1) as cluster:
         cl = cluster.client()
         assert counter.decode_reply(cl.send_write(counter.encode_add(5))) == 5
+        # replica 1 may trail the reply quorum (async verification):
+        # wait for it to execute request 1 before baselining its counter
+        deadline = time.time() + 5
+        while time.time() < deadline \
+                and cluster.metric(1, "counters", "executed_requests") < 1:
+            time.sleep(0.02)
         exec_before = cluster.metric(1, "counters", "executed_requests")
         # a second distinct request executes normally
         assert counter.decode_reply(cl.send_write(counter.encode_add(2))) == 7
+        deadline = time.time() + 5
+        while time.time() < deadline \
+                and cluster.metric(1, "counters", "executed_requests") \
+                == exec_before:
+            time.sleep(0.02)
         assert cluster.metric(1, "counters", "executed_requests") \
             == exec_before + 1
 

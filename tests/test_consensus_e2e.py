@@ -76,6 +76,14 @@ def test_metrics_advance():
         cl = cluster.client()
         cl.send_write(counter.encode_add(1))
         assert cluster.metric(0, "counters", "sent_preprepares") >= 1
+        # the client reply proves a quorum (3) executed; the 4th replica
+        # finishes its async verification moments later — poll for it
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(cluster.metric(r, "gauges", "last_executed_seq") >= 1
+                   for r in range(4)):
+                break
+            time.sleep(0.02)
         for r in range(4):
             assert cluster.metric(r, "gauges", "last_executed_seq") >= 1
 

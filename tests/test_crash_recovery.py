@@ -24,6 +24,14 @@ def test_backup_restart_rejoins_and_cluster_progresses(tmp_path):
         cl = cluster.client()
         assert counter.decode_reply(cl.send_write(counter.encode_add(10))) == 10
         assert counter.decode_reply(cl.send_write(counter.encode_add(5))) == 15
+        # the client quorum (3) may not include replica 2 — wait for its
+        # async verification to finish executing before crashing it, so
+        # the restart genuinely recovers an executed prefix
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline \
+                and cluster.metric(2, "gauges", "last_executed_seq") < 1:
+            time.sleep(0.02)
         # crash + restart a backup; it must reload metadata and the
         # cluster must keep committing with it back
         storages[2].close()

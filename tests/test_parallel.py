@@ -55,7 +55,7 @@ def test_sharded_ed25519_verify():
         items.append((m, sig, pk))
     prep = ops.prepare_batch(items)
     kern = sharded_verify_ed25519(mesh)
-    got = np.asarray(kern(prep.s_bits, prep.h_bits, prep.a_y, prep.a_sign,
+    got = np.asarray(kern(prep.s_win, prep.h_win, prep.a_y, prep.a_sign,
                           prep.r_y, prep.r_sign)) & prep.host_valid
     want = ops.verify_batch(items)
     assert got.tolist() == want.tolist()

@@ -98,6 +98,15 @@ class CollectorPool:
                                         thread_name_prefix="sig-combine")
         self._closed = False
 
+    def submit(self, fn: Callable[[], None]) -> bool:
+        """Run an arbitrary background verification job on the pool (the
+        reference's RequestThreadPool / CombinedSigVerificationJob role —
+        the job itself posts its verdict back as an internal msg)."""
+        if self._closed:
+            return False
+        self._pool.submit(fn)
+        return True
+
     def maybe_launch(self, collector: ShareCollector) -> bool:
         """Called on the dispatcher thread only; snapshots the share set
         so the job never races dispatcher-side mutations."""

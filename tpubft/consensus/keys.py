@@ -122,7 +122,8 @@ class ClusterKeys:
         return system.create_threshold_signer(replica_id + 1)
 
     def threshold_verifier(self, system: Cryptosystem,
-                           backend: str = "cpu") -> IThresholdVerifier:
+                           backend: str = "cpu",
+                           min_device_batch: int = 1) -> IThresholdVerifier:
         """Backend-selected threshold verifier over the same key material
         (reference: Cryptosystem::createThresholdVerifier,
         ThresholdSignaturesTypes.cpp:183 — the TPU backend slots in behind
@@ -131,5 +132,6 @@ class ClusterKeys:
             from tpubft.crypto import tpu as tpu_backend
             return tpu_backend.make_threshold_verifier(
                 system.type_name, system.threshold_, system.num_signers,
-                system.public_key, system.share_public_keys)
+                system.public_key, system.share_public_keys,
+                min_device_batch)
         return system.create_threshold_verifier()

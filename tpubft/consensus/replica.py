@@ -116,33 +116,35 @@ class Replica(IReceiver):
         # SigManager.cpp:197, IThresholdVerifier.h:23 — route to the
         # batched TPU kernels when crypto_backend == "tpu")
         backend = cfg.crypto_backend
-        verifier_factory = None
         batch_fn = None
         if backend == "tpu":
             from tpubft.crypto import tpu as tpu_backend
-            verifier_factory = tpu_backend.TpuEd25519Verifier
             batch_fn = tpu_backend.verify_batch_items
+        # singleton verifies stay on the CPU verifiers even with the TPU
+        # backend (latency-critical, can't amortize a dispatch); batches
+        # of >= device_min_verify_batch ride the device kernel
         self.sig = SigManager(
             keys, self.aggregator,
-            verifier_factory=verifier_factory,
             alias_fn=lambda p: (self.info.owner_of_internal_client(p)
                                 if self.info.is_internal_client(p) else p),
             grace_seq_window=cfg.work_window_size,
-            batch_fn=batch_fn)
+            batch_fn=batch_fn,
+            device_min_batch=cfg.device_min_verify_batch)
         # threshold machinery per commit path (CryptoManager.hpp:109-111):
         # slow = 2f+c+1, fast-with-threshold = 3f+c+1, optimistic = n
+        min_dev = cfg.device_min_verify_batch
         self.slow_signer = keys.threshold_signer(keys.slow_path_system,
                                                  self.id)
         self.slow_verifier = keys.threshold_verifier(keys.slow_path_system,
-                                                     backend)
+                                                     backend, min_dev)
         self.thr_signer = keys.threshold_signer(keys.commit_path_system,
                                                 self.id)
         self.thr_verifier = keys.threshold_verifier(keys.commit_path_system,
-                                                    backend)
+                                                    backend, min_dev)
         self.opt_signer = keys.threshold_signer(keys.optimistic_system,
                                                 self.id)
         self.opt_verifier = keys.threshold_verifier(keys.optimistic_system,
-                                                    backend)
+                                                    backend, min_dev)
         self.controller = CommitPathController(cfg.f_val, cfg.c_val)
 
         # --- protocol state (dispatcher-thread only) ---
@@ -196,6 +198,9 @@ class Replica(IReceiver):
         self.dispatcher = Dispatcher(self.incoming, name=f"replica-{self.id}")
         self.dispatcher.set_external_handler(self._on_external)
         self.dispatcher.register_internal("combine", self._on_combine_result)
+        self.dispatcher.register_internal("pp_verified", self._on_pp_verified)
+        self.dispatcher.register_internal("cert_verified",
+                                          self._on_cert_verified)
         self.dispatcher.add_timer(cfg.batch_flush_period_ms / 1000.0,
                                   self._try_send_pre_prepare)
         self.dispatcher.add_timer(cfg.fast_path_timeout_ms / 1000.0 / 4,
@@ -595,39 +600,50 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     # PrePrepare (ReplicaImp.cpp:1047)
     # ------------------------------------------------------------------
+    def _pp_acceptable_now(self, pp: m.PrePrepareMsg) -> bool:
+        """Structural acceptance checks that depend on CURRENT protocol
+        state — run at arrival AND re-run when the async client-sig
+        verdict lands (the view/window may have moved while the batch was
+        on a worker). Content checks (parse, per-request validity, time
+        bound) run at arrival only: message content cannot change."""
+        if pp.view != self.view or pp.sender_id != self.primary \
+                or self.in_view_change:
+            return False
+        if not self.window.in_window(pp.seq_num) \
+                or pp.seq_num <= self.last_stable:
+            return False
+        if self.window.get(pp.seq_num).pre_prepare is not None:
+            return False                        # already have it
+        if self.control.blocks_ordering(pp.seq_num):
+            return False                        # wedged: nothing past stop
+        # view-change safety: a seqnum certified as possibly-committed in
+        # an earlier view may ONLY be re-proposed with the same batch
+        # (ViewChangeSafetyLogic restrictions)
+        restr = self.restrictions.get(pp.seq_num)
+        return restr is None or pp.requests_digest == restr.requests_digest
+
     def _on_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
-        if pp.view != self.view or pp.sender_id != self.primary:
-            return
-        if not self.window.in_window(pp.seq_num) or pp.seq_num <= self.last_stable:
+        if not self._pp_acceptable_now(pp):
             return
         info = self.window.get(pp.seq_num)
-        if info.pre_prepare is not None:
-            return                              # already have it
-        if self.control.blocks_ordering(pp.seq_num):
-            return                              # wedged: nothing past stop
+        if info.pp_verifying is not None:
+            # a duplicate arriving during the async-verify window must not
+            # repay the inline sig check + request validation below
+            return
         if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature,
                                seq=pp.seq_num):
             return
-        # Verify every embedded client request before signing shares over
-        # the batch — a byzantine primary must not be able to smuggle
+        # Every embedded client request is verified before signing shares
+        # over the batch — a byzantine primary must not be able to smuggle
         # forged client operations (reference: per-request verification
         # via RequestThreadPool, ReplicaImp.cpp onMessage<PrePrepareMsg>).
+        # Structural checks run here on the dispatcher; the signature
+        # batch itself verifies on a background worker (one device
+        # dispatch with the TPU backend) and re-enters as "pp_verified".
         try:
             reqs = pp.client_requests()
         except m.MsgError:
             return
-        # pre-executed wrappers carry their own proof set (original client
-        # sig + f+1 replica result sigs) instead of a wrapper signature
-        plain = [r for r in reqs
-                 if not r.flags & m.RequestFlag.HAS_PRE_PROCESSED]
-        items = [(r.sender_id, r.signed_payload(), r.signature)
-                 for r in plain]
-        if items:
-            from tpubft.diagnostics import TimeRecorder
-            with TimeRecorder(self._h_verify):
-                ok = all(self.sig.verify_batch(items, seq=pp.seq_num))
-            if not ok:
-                return
         for r in reqs:
             if r.flags & m.RequestFlag.HAS_PRE_PROCESSED:
                 from tpubft.preprocessor.preprocessor import (
@@ -644,18 +660,58 @@ class Replica(IReceiver):
             if r.flags & m.RequestFlag.RECONFIG \
                     and r.sender_id != self.info.operator_id:
                 return
-        # view-change safety: a seqnum certified as possibly-committed in
-        # an earlier view may ONLY be re-proposed with the same batch
-        # (ViewChangeSafetyLogic restrictions)
-        restr = self.restrictions.get(pp.seq_num)
-        if restr is not None and pp.requests_digest != restr.requests_digest:
-            return
         # time service: bound the primary's stamp (reference
         # TimeServiceManager::hasTimeRequest). Gap-fill PrePrepares
         # (empty, time=0) and restricted re-proposals (old stamp, content
         # already certified) are exempt or view change could never finish.
-        if (self.cfg.time_service_enabled and reqs and restr is None
+        if (self.cfg.time_service_enabled and reqs
+                and pp.seq_num not in self.restrictions
                 and not self.time_service.validate(pp.time)):
+            return
+        # pre-executed wrappers carry their own proof set (original client
+        # sig + f+1 replica result sigs) instead of a wrapper signature
+        items = [(r.sender_id, r.signed_payload(), r.signature)
+                 for r in reqs
+                 if not r.flags & m.RequestFlag.HAS_PRE_PROCESSED]
+        if items and self.cfg.async_verification:
+            info.pp_verifying = pp              # guarded at entry above
+            self.collector_pool.submit(lambda: self._bg_verify_pp(pp, items))
+            return
+        if items:
+            from tpubft.diagnostics import TimeRecorder
+            with TimeRecorder(self._h_verify):
+                if not all(self.sig.verify_batch(items, seq=pp.seq_num)):
+                    return
+        self._accept_pre_prepare(pp)
+
+    def _bg_verify_pp(self, pp: m.PrePrepareMsg, items) -> None:
+        """Worker-thread body: one verify_batch call (one device dispatch
+        on the TPU backend), verdict re-enters the dispatcher."""
+        from tpubft.diagnostics import TimeRecorder
+        try:
+            with TimeRecorder(self._h_verify):
+                ok = all(self.sig.verify_batch(items, seq=pp.seq_num))
+        except Exception:  # noqa: BLE001 — job failure = verify failure
+            import traceback
+            traceback.print_exc()
+            ok = False
+        self.incoming.push_internal("pp_verified", (pp, ok))
+
+    def _on_pp_verified(self, payload) -> None:
+        """Async client-sig batch verdict (dispatcher thread). The world
+        may have moved while the batch was on the worker: re-run the
+        cheap structural checks before accepting."""
+        pp, ok = payload
+        if not self.window.in_window(pp.seq_num):
+            return
+        info = self.window.peek(pp.seq_num)
+        if info is not None and info.pp_verifying is pp:
+            # identity check: a verdict for a message the view change
+            # dropped must not clear a NEWER message's in-flight guard
+            info.pp_verifying = None
+        if not ok or info is None:
+            return
+        if not self._pp_acceptable_now(pp):
             return
         self._accept_pre_prepare(pp)
 
@@ -672,6 +728,7 @@ class Replica(IReceiver):
         else:
             self._send_partial_commit_proof(info)
         self._drain_early_shares(info)
+        self._drain_early_certs(info)
 
     # ------------------------------------------------------------------
     # slow path: shares → collectors (ReplicaImp.cpp:1373,1399)
@@ -809,21 +866,114 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     # full certificates
     # ------------------------------------------------------------------
-    def _verify_full(self, msg, kind: str) -> bool:
-        if msg.view != self.view or not self.window.in_window(msg.seq_num):
-            return False
+    def _cert_tools(self, msg, kind: str):
+        """(verifier, expected digest) for a full-certificate message
+        against CURRENT state, "early" when the PrePrepare isn't accepted
+        yet, or None when the message can't be valid."""
+        if msg.view != self.view or not self.window.in_window(msg.seq_num) \
+                or msg.seq_num <= self.last_stable:
+            return None
         info = self.window.peek(msg.seq_num)
         if info is None or info.pre_prepare is None:
-            return False                        # need PP first (ReqMissing later)
-        d = share_digest(kind, self.view, msg.seq_num,
+            return "early"
+        if kind == "fast":
+            _, verifier, tag = self._fast_tools(info.pre_prepare.first_path)
+        else:
+            verifier, tag = self.slow_verifier, kind
+        d = share_digest(tag, self.view, msg.seq_num,
                          info.pre_prepare.digest())
         if msg.digest != d:
-            return False
-        return self.slow_verifier.verify(d, msg.sig)
+            return None
+        return verifier, d
+
+    def _handle_full_cert(self, msg, kind: str) -> None:
+        """Common path for PrepareFull / CommitFull / FullCommitProof:
+        structural checks on the dispatcher, the threshold verification as
+        a background job re-entering as "cert_verified" (reference:
+        CombinedSigVerificationJob, CollectorOfThresholdSignatures.hpp:409)."""
+        tools = self._cert_tools(msg, kind)
+        if tools is None:
+            return
+        if tools == "early":
+            # PP not here yet (possibly still in async verification):
+            # buffer per (kind, sender), drained on PP acceptance — one
+            # slot per sender, so a byzantine peer's spam only ever
+            # displaces its own buffered certs, never the collector's
+            if self.info.is_replica(msg.sender_id):
+                self.window.get(msg.seq_num).early_certs[
+                    (kind, msg.sender_id)] = msg
+            return
+        info = self.window.get(msg.seq_num)
+        if info.committed or (kind == "prepare" and info.prepared):
+            return
+        verifier, d = tools
+        if not self.cfg.async_verification:
+            if verifier.verify(d, msg.sig):
+                self._accept_cert(msg, kind)
+            return
+        if kind in info.cert_verifying:
+            # a same-kind job is in flight (possibly over a forged cert):
+            # park this one per sender and retry when that verdict lands,
+            # so a forgery can't shadow the genuine certificate
+            if self.info.is_replica(msg.sender_id):
+                info.cert_pending[(kind, msg.sender_id)] = msg
+            return
+        info.cert_verifying[kind] = msg
+
+        def job():
+            try:
+                ok = verifier.verify(d, msg.sig)
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
+                ok = False
+            self.incoming.push_internal("cert_verified", (msg, kind, ok))
+        self.collector_pool.submit(job)
+
+    def _on_cert_verified(self, payload) -> None:
+        """Async combined-cert verdict (dispatcher thread)."""
+        msg, kind, ok = payload
+        if not self.window.in_window(msg.seq_num):
+            return
+        info = self.window.peek(msg.seq_num)
+        if info is not None and info.cert_verifying.get(kind) is msg:
+            del info.cert_verifying[kind]
+        if ok:
+            # re-validate vs current state: view change may have reset the
+            # window entry, or a different PP may sit there now — the
+            # digest re-check binds the cert to the PP it actually covers
+            tools = self._cert_tools(msg, kind)
+            if tools is not None and tools != "early":
+                self._accept_cert(msg, kind)
+        # certs parked while this job was in flight get their turn now
+        # (one may be the genuine one if this verdict was a forgery's);
+        # the first re-handled becomes the next in-flight job, the rest
+        # re-park into their per-sender slots
+        if info is not None:
+            parked = [(k, pmsg) for (k, _), pmsg in
+                      list(info.cert_pending.items()) if k == kind]
+            for key in [key for key in info.cert_pending if key[0] == kind]:
+                del info.cert_pending[key]
+            for k, pmsg in parked:
+                if info.committed or (k == "prepare" and info.prepared):
+                    break
+                self._handle_full_cert(pmsg, k)
+
+    def _accept_cert(self, msg, kind: str) -> None:
+        if kind == "prepare":
+            self._accept_prepare_full(msg)
+        elif kind == "commit":
+            self._accept_commit_full(msg)
+        else:
+            self._accept_full_commit_proof(msg)
+
+    def _drain_early_certs(self, info: SeqNumInfo) -> None:
+        certs, info.early_certs = info.early_certs, {}
+        for (kind, _sender), msg in certs.items():
+            self._handle_full_cert(msg, kind)
 
     def _on_prepare_full(self, msg: m.PrepareFullMsg) -> None:
-        if self._verify_full(msg, "prepare"):
-            self._accept_prepare_full(msg)
+        self._handle_full_cert(msg, "prepare")
 
     def _accept_prepare_full(self, msg: m.PrepareFullMsg) -> None:
         info = self.window.get(msg.seq_num)
@@ -836,8 +986,7 @@ class Replica(IReceiver):
         self._send_commit_partial(info)
 
     def _on_commit_full(self, msg: m.CommitFullMsg) -> None:
-        if self._verify_full(msg, "commit"):
-            self._accept_commit_full(msg)
+        self._handle_full_cert(msg, "commit")
 
     def _accept_commit_full(self, msg: m.CommitFullMsg) -> None:
         info = self.window.get(msg.seq_num)
@@ -859,17 +1008,7 @@ class Replica(IReceiver):
     # fast path: full proof + demotion (ReplicaImp.cpp:1468,1284)
     # ------------------------------------------------------------------
     def _on_full_commit_proof(self, msg: m.FullCommitProofMsg) -> None:
-        if msg.view != self.view or not self.window.in_window(msg.seq_num):
-            return
-        info = self.window.peek(msg.seq_num)
-        if info is None or info.pre_prepare is None:
-            return
-        _, verifier, tag = self._fast_tools(info.pre_prepare.first_path)
-        d = share_digest(tag, self.view, msg.seq_num,
-                         info.pre_prepare.digest())
-        if msg.digest != d or not verifier.verify(d, msg.sig):
-            return
-        self._accept_full_commit_proof(msg)
+        self._handle_full_cert(msg, "fast")
 
     def _accept_full_commit_proof(self, msg: m.FullCommitProofMsg) -> None:
         info = self.window.get(msg.seq_num)

@@ -39,6 +39,22 @@ class SeqNumInfo:
     # shares that arrived before our PrePrepare did (reference keeps them
     # in the collectors keyed by digest; we buffer until digest is known)
     early_shares: Dict[str, list] = field(default_factory=dict)
+    # async verification state: the exact messages whose verify jobs are
+    # in flight (identity-checked when the verdict re-enters, so a stale
+    # verdict for a dropped/replaced message can't clear a newer job's
+    # guard): the PrePrepare being batch-verified / per-kind full certs
+    pp_verifying: Optional[PrePrepareMsg] = None
+    cert_verifying: Dict[str, object] = field(default_factory=dict)
+    # full certs that arrived before the PrePrepare was accepted (window
+    # widened by async PP verification), keyed (kind, sender): one slot
+    # PER SENDER, so a byzantine peer's forgeries can only ever displace
+    # that peer's own buffered certs, never the honest collector's
+    # (bounded at n_kinds x n_replicas entries)
+    early_certs: Dict[tuple, object] = field(default_factory=dict)
+    # certs that arrived while a same-kind verify job was in flight,
+    # keyed (kind, sender) for the same anti-shadowing reason; retried
+    # when the in-flight verdict lands
+    cert_pending: Dict[tuple, object] = field(default_factory=dict)
 
 
 T = TypeVar("T")

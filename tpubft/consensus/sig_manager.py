@@ -31,11 +31,16 @@ class SigManager:
                  grace_seq_window: int = 300,
                  batch_fn: Optional[Callable[
                      [Sequence[Tuple[bytes, bytes, bytes]]],
-                     List[bool]]] = None):
+                     List[bool]]] = None,
+                 device_min_batch: int = 1):
         self._keys = keys
         # cross-principal batch backend: [(pubkey, data, sig)] -> verdicts
         # in ONE dispatch (the TPU path; None = per-principal loop)
         self._batch_fn = batch_fn
+        # batches smaller than this verify on the per-principal CPU
+        # verifiers — a device dispatch only pays off once it amortizes
+        # over enough signatures (SURVEY §7 hard part 6)
+        self.device_min_batch = device_min_batch
         # a superseded key only verifies messages whose consensus seqnum
         # is at most rotation_seq + this window (callers pass the
         # config's work_window_size: everything deeper in flight than the
@@ -50,6 +55,10 @@ class SigManager:
         self._signer = keys.my_signer() if keys.my_sign_seed else None
         self._verifiers: Dict[int, IVerifier] = {}
         self._prev_verifiers: Dict[int, IVerifier] = {}
+        # verify() runs on the dispatcher AND on collector-pool workers
+        # (async PP batches); key rotation + grace-key expiry mutate the
+        # shared dicts, so those sections take this lock
+        self._lock = threading.Lock()
         self._verifier_factory = verifier_factory
         # maps alias principals (e.g. internal-client ids) onto the
         # replica principal whose key signs for them
@@ -58,6 +67,10 @@ class SigManager:
         self.sigs_verified = self.metrics.register_counter("sigs_verified")
         self.sig_failures = self.metrics.register_counter("sig_failures")
         self.sigs_signed = self.metrics.register_counter("sigs_signed")
+        # signatures dispatched through the cross-principal device batch
+        # (dispatch count, not verdicts — failures land in sig_failures)
+        self.sigs_device_dispatched = self.metrics.register_counter(
+            "sigs_device_dispatched")
 
     # ---- signing ----
     def sign(self, data: bytes) -> bytes:
@@ -81,15 +94,16 @@ class SigManager:
         verifying messages at seqnums ordered before (or immediately
         around) the exchange at `rotation_seq`; verifications that carry
         no seqnum context never fall back to it."""
-        old = self._replica_pubkeys.get(replica_id)
-        if old == new_pubkey:
-            return
-        if old is not None:
-            self._prev_pubkeys[replica_id] = (old, time.monotonic(),
-                                              rotation_seq)
-            self._prev_verifiers.pop(replica_id, None)
-        self._replica_pubkeys[replica_id] = new_pubkey
-        self._verifiers.pop(replica_id, None)
+        with self._lock:
+            old = self._replica_pubkeys.get(replica_id)
+            if old == new_pubkey:
+                return
+            if old is not None:
+                self._prev_pubkeys[replica_id] = (old, time.monotonic(),
+                                                  rotation_seq)
+                self._prev_verifiers.pop(replica_id, None)
+            self._replica_pubkeys[replica_id] = new_pubkey
+            self._verifiers.pop(replica_id, None)
 
     def set_my_signer(self, signer) -> None:
         self._signer = signer
@@ -106,14 +120,18 @@ class SigManager:
                 or self._client_pubkeys.get(principal))
 
     def _verifier(self, principal: int) -> IVerifier:
+        # the whole get-or-create holds the lock: a worker thread must not
+        # read a pre-rotation pubkey, lose the CPU to the dispatcher's
+        # set_replica_key, then cache a verifier for the rotated-away key
         principal = self._alias(principal)
-        v = self._verifiers.get(principal)
-        if v is None:
-            pk = self._pubkey_of(principal)
-            if pk is None:
-                raise KeyError(f"no public key for principal {principal}")
-            v = self._verifiers[principal] = self._make_verifier(pk)
-        return v
+        with self._lock:
+            v = self._verifiers.get(principal)
+            if v is None:
+                pk = self._pubkey_of(principal)
+                if pk is None:
+                    raise KeyError(f"no public key for principal {principal}")
+                v = self._verifiers[principal] = self._make_verifier(pk)
+            return v
 
     def _grace_verifier(self, principal: int, seq: Optional[int],
                         view_scoped: bool = False) -> Optional[IVerifier]:
@@ -124,26 +142,27 @@ class SigManager:
         client requests — never accept a rotated-away key (a compromised
         pre-rotation key must not keep authenticating arbitrary traffic)."""
         principal = self._alias(principal)
-        entry = self._prev_pubkeys.get(principal)
-        if entry is None:
-            return None
-        pk, rotated_at, rotation_seq = entry
-        if time.monotonic() - rotated_at > self.GRACE_WINDOW_S:
-            # the leaked/old key must stop verifying — that's the point
-            # of rotating
-            del self._prev_pubkeys[principal]
-            self._prev_verifiers.pop(principal, None)
-            return None
-        if seq is None:
-            if not view_scoped:
+        with self._lock:
+            entry = self._prev_pubkeys.get(principal)
+            if entry is None:
                 return None
-        elif rotation_seq is not None \
-                and seq > rotation_seq + self.grace_seq_window:
-            return None
-        v = self._prev_verifiers.get(principal)
-        if v is None:
-            v = self._prev_verifiers[principal] = self._make_verifier(pk)
-        return v
+            pk, rotated_at, rotation_seq = entry
+            if time.monotonic() - rotated_at > self.GRACE_WINDOW_S:
+                # the leaked/old key must stop verifying — that's the
+                # point of rotating
+                self._prev_pubkeys.pop(principal, None)
+                self._prev_verifiers.pop(principal, None)
+                return None
+            if seq is None:
+                if not view_scoped:
+                    return None
+            elif rotation_seq is not None \
+                    and seq > rotation_seq + self.grace_seq_window:
+                return None
+            v = self._prev_verifiers.get(principal)
+            if v is None:
+                v = self._prev_verifiers[principal] = self._make_verifier(pk)
+            return v
 
     def has_principal(self, principal: int) -> bool:
         return self._pubkey_of(self._alias(principal)) is not None
@@ -171,9 +190,10 @@ class SigManager:
     def verify_batch(self, items: Sequence[Tuple[int, bytes, bytes]],
                      seq: Optional[int] = None) -> List[bool]:
         """Verify [(principal, data, sig)] — one cross-principal device
-        dispatch when a batch backend is configured (TPU), otherwise
-        grouped per principal with each verifier free to vectorize."""
-        if self._batch_fn is not None:
+        dispatch when a batch backend is configured (TPU) and the batch is
+        big enough to amortize it, otherwise grouped per principal with
+        each verifier free to vectorize."""
+        if self._batch_fn is not None and len(items) >= self.device_min_batch:
             out = self._verify_batch_cross(items, seq)
             for ok in out:
                 (self.sigs_verified if ok else self.sig_failures).inc()
@@ -204,12 +224,18 @@ class SigManager:
         backend in one call; failed items retry against grace keys."""
         entries = []
         keyed = []
-        for i, (p, data, sig) in enumerate(items):
-            pk = self._pubkey_of(self._alias(p))
+        with self._lock:
+            # pubkey resolution under the lock: a worker must not race a
+            # key rotation into treating the rotated-away key as current
+            resolved = [self._pubkey_of(self._alias(p))
+                        for p, _, _ in items]
+        for i, ((_, data, sig), pk) in enumerate(zip(items, resolved)):
             if pk is not None:
                 entries.append((pk, data, sig))
                 keyed.append(i)
         verdicts = self._batch_fn(entries)
+        # counts only what actually reached the device dispatch
+        self.sigs_device_dispatched.inc(len(entries))
         out = [False] * len(items)
         for i, ok in zip(keyed, verdicts):
             if not ok:

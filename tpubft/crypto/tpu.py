@@ -71,14 +71,21 @@ class TpuEd25519Verifier(IVerifier):
 
 class TpuMultisigEd25519Verifier(MultisigEd25519Verifier):
     """Multisig verifier whose combined-signature check and bad-share
-    identification run as one device batch (k shares -> one dispatch)."""
+    identification run as one device batch (k shares -> one dispatch).
+    Below `min_device_batch` shares the check stays on the CPU verifiers:
+    a k=3 certificate is latency-critical and too small to amortize a
+    device dispatch."""
 
     def __init__(self, threshold: int, total: int,
-                 share_public_keys: Sequence[bytes]):
+                 share_public_keys: Sequence[bytes],
+                 min_device_batch: int = 1):
         super().__init__(threshold, total, share_public_keys)
         self._share_pk_bytes = list(share_public_keys)
+        self.min_device_batch = min_device_batch
 
     def verify(self, data: bytes, sig: bytes) -> bool:
+        if self.threshold < self.min_device_batch:
+            return super().verify(data, sig)
         try:
             (k,) = struct.unpack_from("<H", sig, 0)
             if k < self.threshold:
@@ -104,6 +111,8 @@ class TpuMultisigEd25519Verifier(MultisigEd25519Verifier):
     def verify_share_batch(self, items: Sequence[Tuple[int, bytes, bytes]]
                            ) -> List[bool]:
         """[(share_id, data, share)] -> verdicts, one device dispatch."""
+        if len(items) < self.min_device_batch:
+            return [self.verify_share(i, d, s) for i, d, s in items]
         entries = []
         ok_shape = []
         for share_id, data, share in items:
@@ -137,13 +146,15 @@ class TpuBlsThresholdVerifier(BlsThresholdVerifier):
 
 
 def make_threshold_verifier(type_name: str, threshold: int, total: int,
-                            public_key, share_public_keys):
+                            public_key, share_public_keys,
+                            min_device_batch: int = 1):
     """TPU-flavored counterpart of Cryptosystem.create_threshold_verifier
     (ThresholdSignaturesTypes.cpp:183): same key material, device-backed
     verification."""
     if type_name == "multisig-ed25519":
         return TpuMultisigEd25519Verifier(threshold, total,
-                                          share_public_keys)
+                                          share_public_keys,
+                                          min_device_batch)
     if type_name == "threshold-bls":
         return TpuBlsThresholdVerifier(threshold, total, public_key,
                                        share_public_keys)

@@ -61,6 +61,14 @@ class ReplicaConfig:
     # crypto batch dispatch (TPU seam)
     verify_batch_size: int = 256
     verify_batch_flush_us: int = 200
+    # below this many signatures a batch verifies on the CPU verifiers
+    # instead of paying a device dispatch (latency-critical singletons)
+    device_min_verify_batch: int = 32
+    # hot-path verifications (client sigs at PrePrepare, combined-cert
+    # checks) run as background jobs re-entering the dispatcher as
+    # internal msgs (reference: RequestThreadPool +
+    # CombinedSigVerificationJob); False = verify inline (debug only)
+    async_verification: bool = True
 
     # retransmissions
     retransmissions_enabled: bool = True

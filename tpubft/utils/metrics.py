@@ -16,13 +16,17 @@ from typing import Dict, Optional
 
 
 class Counter:
-    __slots__ = ("value",)
+    """Incremented from the dispatcher AND crypto worker threads (async
+    verification), so the read-modify-write takes a lock."""
+    __slots__ = ("value", "_lock")
 
     def __init__(self) -> None:
         self.value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
