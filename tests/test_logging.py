@@ -1,0 +1,83 @@
+"""Structured logging with consensus MDC (reference logging/ +
+SCOPED_MDC_* in ReplicaImp.cpp:405,1067)."""
+import io
+import logging as stdlog
+import threading
+
+from tpubft.utils.logging import (configure, get_logger, mdc, mdc_scope,
+                                  set_mdc)
+
+
+def _capture():
+    buf = io.StringIO()
+    configure(level="debug", stream=buf)
+    return buf
+
+
+def _teardown():
+    root = stdlog.getLogger("tpubft")
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.setLevel(stdlog.WARNING)
+
+
+def test_mdc_scope_sets_and_restores():
+    set_mdc(r=3)
+    assert mdc()["r"] == 3
+    with mdc_scope(v=1, s=42):
+        assert mdc() == {"r": 3, "v": 1, "s": 42}
+        with mdc_scope(s=43):
+            assert mdc()["s"] == 43
+        assert mdc()["s"] == 42
+    assert mdc() == {"r": 3}
+    mdc().clear()
+
+
+def test_mdc_is_thread_local():
+    set_mdc(r=7)
+    seen = {}
+
+    def worker():
+        seen["ctx"] = dict(mdc())
+        set_mdc(r=99)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["ctx"] == {}          # fresh thread, fresh context
+    assert mdc()["r"] == 7            # worker's set_mdc didn't leak here
+    mdc().clear()
+
+
+def test_log_lines_carry_mdc():
+    buf = _capture()
+    try:
+        log = get_logger("testsub")
+        set_mdc(r=2)
+        with mdc_scope(v=0, s=17):
+            log.info("accepted PrePrepare")
+        log.warning("bare line")
+        out = buf.getvalue()
+        assert "[r=2 v=0 s=17] tpubft.testsub: accepted PrePrepare" in out
+        assert "[r=2] tpubft.testsub: bare line" in out
+    finally:
+        _teardown()
+        mdc().clear()
+
+
+def test_replica_logs_protocol_events():
+    """A live cluster logs its lifecycle with replica-tagged MDC."""
+    buf = _capture()
+    try:
+        from tpubft.apps import counter
+        from tpubft.testing import InProcessCluster
+        with InProcessCluster(f=1) as cluster:
+            cl = cluster.client()
+            assert counter.decode_reply(
+                cl.send_write(counter.encode_add(2))) == 2
+        out = buf.getvalue()
+        assert "replica up: n=4 f=1" in out
+        assert "[r=0]" in out and "[r=3]" in out
+        assert "replica stopping" in out
+    finally:
+        _teardown()

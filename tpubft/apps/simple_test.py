@@ -138,6 +138,8 @@ def run_orchestrator(args) -> int:
 
 
 def main() -> int:
+    from tpubft.utils.logging import configure
+    configure()                       # level from TPUBFT_LOG (default warn)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--replica", type=int, default=None,
                     help="run a single replica with this id (internal)")

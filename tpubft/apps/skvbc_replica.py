@@ -63,6 +63,8 @@ def make_parser() -> argparse.ArgumentParser:
 
 
 def main() -> None:
+    from tpubft.utils.logging import configure
+    configure()                       # level from TPUBFT_LOG (default warn)
     args = make_parser().parse_args()
     comm_wrapper = None
     if args.strategy:

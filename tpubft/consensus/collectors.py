@@ -122,8 +122,10 @@ class CollectorPool:
         try:
             result = collector.combine_and_verify(shares)
         except Exception:  # noqa: BLE001 — job failure = combine failure
-            import traceback
-            traceback.print_exc()
+            from tpubft.utils.logging import get_logger
+            get_logger("collectors").exception(
+                "combine job raised (kind=%s seq=%d)", collector.kind,
+                collector.seq_num)
             result = CombineResult(collector.view, collector.seq_num,
                                    collector.kind, False)
         if result.ok:

@@ -18,6 +18,10 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
+from tpubft.utils.logging import get_logger, set_mdc
+
+log = get_logger("dispatch")
+
 MAX_EXTERNAL_PENDING = 20000
 
 
@@ -73,7 +77,8 @@ class Dispatcher:
     """The single consensus thread: pops queues, dispatches to registered
     handlers, fires periodic timers between messages."""
 
-    def __init__(self, storage: IncomingMsgsStorage, name: str = "dispatch"):
+    def __init__(self, storage: IncomingMsgsStorage, name: str = "dispatch",
+                 thread_mdc: Optional[Dict[str, Any]] = None):
         self._storage = storage
         self._external_handler: Optional[Callable[[int, bytes], None]] = None
         self._internal_handlers: Dict[str, Callable[[Any], None]] = {}
@@ -81,6 +86,9 @@ class Dispatcher:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._name = name
+        # sticky MDC pinned on the dispatcher thread (e.g. replica id) so
+        # every log line from protocol handlers is attributable
+        self._thread_mdc = thread_mdc or {}
 
     def set_external_handler(self, fn: Callable[[int, bytes], None]) -> None:
         self._external_handler = fn
@@ -106,6 +114,7 @@ class Dispatcher:
             self._thread = None
 
     def _loop(self) -> None:
+        set_mdc(**self._thread_mdc)
         while self._running:
             now = time.monotonic()
             next_due = min((t[2] for t in self._timers), default=now + 0.05)
@@ -121,8 +130,7 @@ class Dispatcher:
                         if fn is not None:
                             fn(item.payload)
                 except Exception:  # noqa: BLE001 — a bad msg must not kill
-                    import traceback
-                    traceback.print_exc()
+                    log.exception("handler raised (msg dropped)")
             now = time.monotonic()
             for t in self._timers:
                 if now >= t[2]:
@@ -130,5 +138,4 @@ class Dispatcher:
                     try:
                         t[1]()
                     except Exception:  # noqa: BLE001
-                        import traceback
-                        traceback.print_exc()
+                        log.exception("timer callback raised")

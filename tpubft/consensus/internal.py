@@ -149,6 +149,10 @@ class KeyExchangeManager:
         owner additionally activates its private candidate. `seq` is the
         consensus seqnum the exchange executed at — it scopes the old
         key's grace window (SigManager seq-scoped grace)."""
+        from tpubft.utils.logging import get_logger
+        get_logger("keyexchange").info(
+            "key rotation executed for replica %d at seq %d",
+            op.replica_id, seq)
         self._replica.sig.set_replica_key(op.replica_id, op.pubkey,
                                           rotation_seq=seq)
         self._pages.save(op.pubkey, index=op.replica_id)

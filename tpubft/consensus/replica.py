@@ -52,7 +52,10 @@ from tpubft.consensus.view_change import (CERT_COMMIT, CERT_FAST_OPT,
                                           validate_certificate)
 from tpubft.crypto.digest import digest as sha256
 from tpubft.utils.config import ReplicaConfig
+from tpubft.utils.logging import get_logger, mdc_scope
 from tpubft.utils.metrics import Aggregator, Component
+
+log = get_logger("replica")
 
 
 def share_digest(kind: str, view: int, seq_num: int, pp_digest: bytes) -> bytes:
@@ -195,7 +198,8 @@ class Replica(IReceiver):
 
         # --- pipeline ---
         self.incoming = IncomingMsgsStorage()
-        self.dispatcher = Dispatcher(self.incoming, name=f"replica-{self.id}")
+        self.dispatcher = Dispatcher(self.incoming, name=f"replica-{self.id}",
+                                     thread_mdc={"r": self.id})
         self.dispatcher.set_external_handler(self._on_external)
         self.dispatcher.register_internal("combine", self._on_combine_result)
         self.dispatcher.register_internal("pp_verified", self._on_pp_verified)
@@ -385,12 +389,20 @@ class Replica(IReceiver):
         self.dispatcher.register_internal("repropose",
                                           lambda _: self._repropose())
         self.dispatcher.start()
+        with mdc_scope(r=self.id):       # start() runs on the caller thread
+            log.info("replica up: n=%d f=%d c=%d view=%d primary=%d "
+                     "backend=%s", self.info.n, self.cfg.f_val,
+                     self.cfg.c_val, self.view, self.primary,
+                     self.cfg.crypto_backend)
         if self.cfg.key_exchange_on_start:
             # sendInitialKey (BFTEngine start path, ReplicaImp.cpp:4622)
             self.key_exchange.initiate()
 
     def stop(self) -> None:
         self._running = False
+        with mdc_scope(r=self.id):
+            log.info("replica stopping: last_executed=%d last_stable=%d",
+                     self.last_executed, self.last_stable)
         self.dispatcher.stop()
         self.collector_pool.shutdown()
         if self.preprocessor:
@@ -418,7 +430,17 @@ class Replica(IReceiver):
         try:
             msg = m.unpack(raw)
         except m.MsgError:
+            log.debug("unparseable message from %d (%d bytes)", sender,
+                      len(raw))
             return
+        # scoped MDC (reference SCOPED_MDC_SEQ_NUM, ReplicaImp.cpp:1067):
+        # every line logged while handling this message carries its
+        # consensus coordinates
+        with mdc_scope(v=self.view,
+                       s=getattr(msg, "seq_num", None) or "-"):
+            self._dispatch_external(sender, msg)
+
+    def _dispatch_external(self, sender: int, msg) -> None:
         if isinstance(msg, m.ClientRequestMsg):
             # accepted from the client itself OR forwarded by a replica;
             # either way the client's own signature is verified next
@@ -632,6 +654,8 @@ class Replica(IReceiver):
             return
         if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature,
                                seq=pp.seq_num):
+            log.warning("PrePrepare replica-signature check failed "
+                        "(sender=%d)", pp.sender_id)
             return
         # Every embedded client request is verified before signing shares
         # over the batch — a byzantine primary must not be able to smuggle
@@ -692,8 +716,8 @@ class Replica(IReceiver):
             with TimeRecorder(self._h_verify):
                 ok = all(self.sig.verify_batch(items, seq=pp.seq_num))
         except Exception:  # noqa: BLE001 — job failure = verify failure
-            import traceback
-            traceback.print_exc()
+            log.exception("client-sig batch job raised for seq %d",
+                          pp.seq_num)
             ok = False
         self.incoming.push_internal("pp_verified", (pp, ok))
 
@@ -709,7 +733,11 @@ class Replica(IReceiver):
             # identity check: a verdict for a message the view change
             # dropped must not clear a NEWER message's in-flight guard
             info.pp_verifying = None
-        if not ok or info is None:
+        if not ok:
+            log.warning("client-signature batch rejected for seq %d "
+                        "(byzantine primary or forged request)", pp.seq_num)
+            return
+        if info is None:
             return
         if not self._pp_acceptable_now(pp):
             return
@@ -831,6 +859,8 @@ class Replica(IReceiver):
         if info is None or info.pre_prepare is None:
             return
         if not res.ok:
+            log.warning("combine failed kind=%s seq=%d bad_shares=%s",
+                        res.kind, res.seq_num, res.bad_shares)
             # bad shares identified: drop them, then retry if an honest
             # quorum is still present (or when the next share arrives)
             col = getattr(info, f"{res.kind}_collector", None)
@@ -924,8 +954,8 @@ class Replica(IReceiver):
             try:
                 ok = verifier.verify(d, msg.sig)
             except Exception:  # noqa: BLE001
-                import traceback
-                traceback.print_exc()
+                log.exception("cert verify job raised (kind=%s seq=%d)",
+                              kind, msg.seq_num)
                 ok = False
             self.incoming.push_internal("cert_verified", (msg, kind, ok))
         self.collector_pool.submit(job)
@@ -1286,6 +1316,9 @@ class Replica(IReceiver):
                 # hopelessly behind: fetch state now (BCStateTran trigger,
                 # reference startCollectingState on checkpoint beyond
                 # window)
+                log.info("lagging by >window (ckpt %d vs executed %d): "
+                         "starting state transfer", ck.seq_num,
+                         self.last_executed)
                 self.state_transfer.start_collecting(
                     ck.seq_num, dict(self.certified_checkpoints))
         # stability needs the full 2f+c+1 certificate (reference
@@ -1301,6 +1334,7 @@ class Replica(IReceiver):
         """onSeqNumIsStable: slide the work window, GC old state."""
         if seq <= self.last_stable:
             return
+        log.debug("checkpoint stable at seq %d", seq)
         if self.state_transfer is not None:
             self.state_transfer.on_checkpoint_stable(
                 seq, state_digest if state_digest is not None
@@ -1380,6 +1414,9 @@ class Replica(IReceiver):
         first = view not in self._complained_views
         if not first and not force:
             return
+        if first:
+            log.warning("no progress: complaining about view %d "
+                        "(primary=%d)", view, self.info.primary_of_view(view))
         self._complained_views.add(view)
         msg = m.ReplicaAsksToLeaveViewMsg(sender_id=self.id, view=view,
                                           reason=reason, signature=b"")
@@ -1522,6 +1559,8 @@ class Replica(IReceiver):
         self.pending_view = None
         self.restrictions = restrictions
         self.m_view.set(new_view)
+        log.info("entered view %d (primary=%d, %d restricted seqnums)",
+                 new_view, self.primary, len(restrictions))
         # purge complaints ABOUT the view we just entered too: complaint
         # quorums accumulated while the view change was forming must not
         # depose the fresh primary; if it really is unhealthy, complaints

@@ -359,12 +359,33 @@ def _pad_to_class(n: int) -> int:
             // _SIZE_CLASSES[-1]) * _SIZE_CLASSES[-1]
 
 
+@functools.lru_cache(maxsize=1)
+def _use_pallas() -> bool:
+    """The fused Pallas kernel (ed25519_pallas.py) is Mosaic/TPU-only;
+    everything else (CPU tests, other accelerators) takes the plain-XLA
+    kernel. "axon" is this environment's tunneled-TPU platform name."""
+    import jax
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # noqa: BLE001 — no backend: stay on XLA path
+        return False
+
+
 def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
     """End-to-end batched verify: (msg, sig, pk) triples → bool array."""
     if not items:
         return np.zeros(0, bool)
     n = len(items)
-    m = _pad_to_class(n)
+    if _use_pallas():
+        from tpubft.ops import ed25519_pallas
+        kernel = ed25519_pallas.verify_kernel
+        # the fused kernel tiles the batch in TILE-lane grid steps
+        m = max(_pad_to_class(n), ed25519_pallas.TILE)
+        m = ((m + ed25519_pallas.TILE - 1)
+             // ed25519_pallas.TILE) * ed25519_pallas.TILE
+    else:
+        kernel = verify_kernel
+        m = _pad_to_class(n)
     prep = prepare_batch(list(items))
 
     def pad(a, axis):
@@ -374,7 +395,7 @@ def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
         width[axis] = (0, m - n)
         return np.pad(a, width)
 
-    dev = verify_kernel(pad(prep.s_win, 1), pad(prep.h_win, 1),
-                        pad(prep.a_y, 1), pad(prep.a_sign, 0),
-                        pad(prep.r_y, 1), pad(prep.r_sign, 0))
+    dev = kernel(pad(prep.s_win, 1), pad(prep.h_win, 1),
+                 pad(prep.a_y, 1), pad(prep.a_sign, 0),
+                 pad(prep.r_y, 1), pad(prep.r_sign, 0))
     return np.asarray(dev)[:n] & prep.host_valid

@@ -233,6 +233,9 @@ class StateTransferManager:
         self._min_seq = max(self._min_seq, min_checkpoint_seq)
         if self.state == _SUMMARIES:
             return
+        from tpubft.utils.logging import get_logger
+        get_logger("statetransfer").info(
+            "starting state transfer toward checkpoint >= %d", self._min_seq)
         self.state = _SUMMARIES
         self._summaries.clear()
         self._agreed = None
@@ -538,6 +541,9 @@ class StateTransferManager:
 
     def _complete_transfer(self) -> None:
         agreed = self._agreed
+        from tpubft.utils.logging import get_logger
+        get_logger("statetransfer").info(
+            "state transfer complete at checkpoint %d", agreed.checkpoint_seq)
         self.state = _IDLE
         self._agreed = None
         self._summaries.clear()
