@@ -17,7 +17,7 @@ import os
 import time
 
 from tpubft.apps.simple_test import endpoint_table
-from tpubft.comm import CommConfig, PlainUdpCommunication
+from tpubft.comm import CommConfig, create_communication
 from tpubft.consensus.keys import ClusterKeys
 from tpubft.kvbc.replica import KvbcReplica
 from tpubft.utils.config import ReplicaConfig
@@ -31,8 +31,15 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()).for_node(args.replica)
     eps = endpoint_table(args.base_port, cfg.n_val, args.clients)
-    comm = PlainUdpCommunication(
-        CommConfig(self_id=args.replica, endpoints=eps))
+    if args.transport == "tls":
+        from tpubft.comm.tls import TlsConfig
+        comm_cfg = TlsConfig(self_id=args.replica, endpoints=eps,
+                             certs_dir=args.certs_dir,
+                             key_password=os.environ.get(
+                                 "TPUBFT_TLS_KEY_PASSWORD"))
+    else:
+        comm_cfg = CommConfig(self_id=args.replica, endpoints=eps)
+    comm = create_communication(comm_cfg, args.transport)
     if comm_wrapper is not None:
         comm = comm_wrapper(comm)
     db_path = (os.path.join(args.db_dir, f"replica-{args.replica}.kvlog")
@@ -56,6 +63,10 @@ def make_parser() -> argparse.ArgumentParser:
                    help="diagnostics admin server port (0 = ephemeral)")
     p.add_argument("--db-dir", default=None)
     p.add_argument("--seed", default="tpubft-skvbc")
+    p.add_argument("--transport", default="udp",
+                   choices=("udp", "tcp", "tls"))
+    p.add_argument("--certs-dir", default=None,
+                   help="TLS material dir (node-<id>.key/.crt)")
     p.add_argument("--view-change-timeout-ms", type=int, default=4000)
     p.add_argument("--strategy", default=None,
                    help="byzantine strategy name (testing)")

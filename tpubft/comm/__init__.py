@@ -1,17 +1,21 @@
 """Communication layer — node-addressed async message passing.
 
 Rebuild of /root/reference/communication/ (ICommunication.hpp:42, IReceiver
-:26): UDP datagrams, length-prefixed TCP, and an in-process loopback bus
-(the reference's fake_comm.h role) with byzantine hooks for tests.
+:26): UDP datagrams, length-prefixed TCP, cert-pinned TLS, a factory
+(CommFactory.cpp), and an in-process loopback bus (the reference's
+fake_comm.h role) with byzantine hooks for tests.
 """
+from tpubft.comm.factory import create_communication
 from tpubft.comm.interfaces import (CommConfig, ConnectionStatus,
                                     ICommunication, IReceiver)
 from tpubft.comm.loopback import LoopbackBus, LoopbackCommunication
 from tpubft.comm.tcp import PlainTcpCommunication
+from tpubft.comm.tls import TlsConfig, TlsTcpCommunication
 from tpubft.comm.udp import PlainUdpCommunication
 
 __all__ = [
     "CommConfig", "ConnectionStatus", "ICommunication", "IReceiver",
     "LoopbackBus", "LoopbackCommunication",
     "PlainTcpCommunication", "PlainUdpCommunication",
+    "TlsConfig", "TlsTcpCommunication", "create_communication",
 ]

@@ -1,0 +1,34 @@
+"""Transport factory: build an ICommunication from a config.
+
+Rebuild of the reference's CommFactory
+(/root/reference/communication/src/CommFactory.cpp — `create` dispatches
+on the config struct type: PlainUdpConfig / PlainTcpConfig /
+TlsTcpConfig, CommDefs.hpp). Same pattern: a TlsConfig selects the TLS
+transport by type; the string form serves flag-driven app wiring
+(reference CONCORD_BFT_CMAKE_TRANSPORT selects at build time — here it's
+a runtime choice)."""
+from __future__ import annotations
+
+from tpubft.comm.interfaces import CommConfig, ICommunication
+from tpubft.comm.tcp import PlainTcpCommunication
+from tpubft.comm.udp import PlainUdpCommunication
+
+
+def create_communication(config: CommConfig,
+                         transport: str = "") -> ICommunication:
+    """Type-dispatch (TlsConfig => TLS) with an optional string override:
+    "udp" | "tcp" | "tls"."""
+    from tpubft.comm.tls import TlsConfig, TlsTcpCommunication
+    if transport == "" and isinstance(config, TlsConfig):
+        transport = "tls"
+    transport = transport or "udp"
+    if transport == "udp":
+        return PlainUdpCommunication(config)
+    if transport == "tcp":
+        return PlainTcpCommunication(config)
+    if transport == "tls":
+        if not isinstance(config, TlsConfig):
+            raise TypeError("tls transport needs a TlsConfig "
+                            "(certs_dir with node keys/certs)")
+        return TlsTcpCommunication(config)
+    raise ValueError(f"unknown transport {transport!r}")

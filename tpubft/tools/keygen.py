@@ -69,6 +69,16 @@ def generate(args) -> int:
         view = cluster.for_node(node_id)
         raw = json.dumps(keyfile_dict(view), indent=1).encode()
         sm.encrypt_file(os.path.join(args.out, fname), raw)
+    if args.tls_certs:
+        # per-node TLS material for the pinned-cert transport (reference
+        # GenerateConcordKeys' cert emission for TlsTCPCommunication).
+        # ALWAYS random keys: a TLS certificate is public (any handshake
+        # reveals it), so a seed-derivable private key would let anyone
+        # knowing the seed impersonate every node
+        from tpubft.comm.tls import generate_tls_material
+        generate_tls_material(args.out, sorted(names), seed=None,
+                              password=args.password)
+        print(f"wrote TLS certs for {len(names)} nodes to {args.out}")
     print(f"wrote {len(names)} keyfiles to {args.out}")
     return 0
 
@@ -120,6 +130,8 @@ def main() -> int:
     g.add_argument("-o", "--out", required=True)
     g.add_argument("--seed", default="tpubft-cluster")
     g.add_argument("--password", default=None)
+    g.add_argument("--tls-certs", action="store_true",
+                   help="also emit per-node TLS keys/certs")
     g.set_defaults(fn=generate)
     v = sub.add_parser("verify")
     v.add_argument("keyfile")
