@@ -31,7 +31,7 @@ import os
 import socket
 import ssl
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from tpubft.comm.interfaces import CommConfig, NodeNum
 from tpubft.comm.tcp import PlainTcpCommunication
@@ -63,10 +63,12 @@ def _fingerprint(der: bytes) -> bytes:
     return hashlib.sha256(der).digest()
 
 
-def _load_der(path: str) -> bytes:
-    with open(path, "rb") as f:
+def _load_cert(path: str) -> Tuple[str, bytes]:
+    """One read per cert: (PEM text for the trust bundle, DER for the
+    pin)."""
+    with open(path) as f:
         pem = f.read()
-    return ssl.PEM_cert_to_DER_cert(pem.decode())
+    return pem, ssl.PEM_cert_to_DER_cert(pem)
 
 
 class TlsTcpCommunication(PlainTcpCommunication):
@@ -88,11 +90,9 @@ class TlsTcpCommunication(PlainTcpCommunication):
         self._pins: Dict[NodeNum, bytes] = {}
         bundle = []
         for node in config.endpoints:
-            path = cert_path(certs_dir, node)
-            der = _load_der(path)
+            pem, der = _load_cert(cert_path(certs_dir, node))
             self._pins[node] = _fingerprint(der)
-            with open(path) as f:
-                bundle.append(f.read())
+            bundle.append(pem)
         cadata = "".join(bundle)
         own_cert = cert_path(certs_dir, config.self_id)
         own_key = key_path(certs_dir, config.self_id)

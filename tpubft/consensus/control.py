@@ -22,6 +22,9 @@ class ControlStateManager:
         self._pages = pages
         self.wedge_point: Optional[int] = None
         self.restart_ready = False
+        # 2f+c+1 replicas announced ReplicaRestartReadyMsg at the wedge
+        # point — the operator's wrapper may safely restart the cluster
+        self.restart_proof = False
         self.reload()
 
     def reload(self) -> None:
@@ -36,6 +39,7 @@ class ControlStateManager:
     def unwedge(self) -> None:
         self.wedge_point = None
         self.restart_ready = False
+        self.restart_proof = False
         self._pages.delete()
 
     def blocks_ordering(self, seq: int) -> bool:
@@ -51,4 +55,5 @@ class ControlStateManager:
 
     def status(self) -> str:
         return (f"wedge_point={self.wedge_point} "
-                f"restart_ready={self.restart_ready}")
+                f"restart_ready={self.restart_ready} "
+                f"restart_proof={self.restart_proof}")

@@ -216,6 +216,29 @@ class Replica(IReceiver):
         self.collector_pool = CollectorPool(
             lambda res: self.incoming.push_internal("combine", res))
 
+        # retransmissions (reference RetransmissionsManager +
+        # sendRetransmittableMsgToReplica, ReplicaImp.cpp:2531)
+        self.retrans = None
+        if cfg.retransmissions_enabled:
+            from tpubft.consensus.retransmissions import \
+                RetransmissionsManager
+            self.retrans = RetransmissionsManager(
+                comm, min_timeout_ms=cfg.retransmission_timer_ms // 2 or 10,
+                max_timeout_ms=cfg.retransmission_timer_ms * 20)
+            self.dispatcher.add_timer(
+                cfg.retransmission_timer_ms / 1000.0,
+                lambda: self.retrans.tick(time.monotonic()))
+            self.dispatcher.add_timer(
+                cfg.retransmission_timer_ms * 4 / 1000.0,
+                self._check_missing_data)
+        # ReqMissingData bookkeeping: seq -> (first_noticed, asks_sent)
+        self._missing_since: Dict[int, list] = {}
+        # restart-ready votes per wedge point (ReplicaRestartReadyMsg);
+        # keyed by point so a later re-wedge starts a fresh election
+        self._restart_announced: Optional[int] = None
+        self._my_restart_vote: Optional[m.ReplicaRestartReadyMsg] = None
+        self._restart_votes: Dict[int, set] = {}
+
         # --- metrics (names mirror the reference's replica component) ---
         self.metrics = Component("replica", self.aggregator)
         self.m_executed = self.metrics.register_counter("executed_requests")
@@ -448,7 +471,16 @@ class Replica(IReceiver):
                 return
             self._on_client_request(msg)
             return
-        if getattr(msg, "sender_id", sender) != sender:
+        # Anti-spoofing: sender_id must match the transport sender —
+        # EXCEPT for messages carrying their own end-to-end signature
+        # (replica sig or threshold combined sig, verified in their
+        # handlers): those are relay-safe, and the gap-resend +
+        # ReqMissingData flows forward them on the original's behalf.
+        relay_ok = (m.PrePrepareMsg, m.PrepareFullMsg, m.CommitFullMsg,
+                    m.FullCommitProofMsg, m.ViewChangeMsg, m.NewViewMsg,
+                    m.CheckpointMsg)
+        if not isinstance(msg, relay_ok) \
+                and getattr(msg, "sender_id", sender) != sender:
             return                              # sender spoofing: drop
         # view-change & checkpoint msgs flow even mid-view-change; normal
         # ordering msgs are frozen until the new view starts (reference
@@ -468,6 +500,19 @@ class Replica(IReceiver):
         if isinstance(msg, m.ReplicaStatusMsg):
             if self.info.is_replica(sender):
                 self._on_replica_status(msg)
+            return
+        if isinstance(msg, m.SimpleAckMsg):
+            if self.retrans is not None and self.info.is_replica(sender):
+                self.retrans.on_ack(sender, msg.acked_msg_code, msg.seq_num,
+                                    time.monotonic())
+            return
+        if isinstance(msg, m.ReqMissingDataMsg):
+            if self.info.is_replica(sender):
+                self._on_req_missing_data(sender, msg)
+            return
+        if isinstance(msg, m.ReplicaRestartReadyMsg):
+            if self.info.is_replica(msg.sender_id):
+                self._on_restart_ready(msg)
             return
         if isinstance(msg, m.StateTransferMsg):
             # ST flows even mid-view-change (reference handles it in
@@ -616,7 +661,7 @@ class Replica(IReceiver):
         pp.signature = self.sig.sign(pp.signed_payload())
         self.primary_next_seq = seq + 1
         self.m_preprepares.inc()
-        self._broadcast(pp)
+        self._broadcast_tracked(pp)             # backups ack receipt
         self._accept_pre_prepare(pp)            # primary processes its own
 
     # ------------------------------------------------------------------
@@ -645,6 +690,11 @@ class Replica(IReceiver):
         return restr is None or pp.requests_digest == restr.requests_digest
 
     def _on_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
+        if pp.view == self.view and pp.sender_id == self.primary \
+                and self.window.in_window(pp.seq_num):
+            # receipt ack, duplicates included (retransmission tracking
+            # keys on receipt, not acceptance)
+            self._ack(pp.sender_id, int(pp.CODE), pp.seq_num)
         if not self._pp_acceptable_now(pp):
             return
         info = self.window.get(pp.seq_num)
@@ -771,7 +821,7 @@ class Replica(IReceiver):
         if collector_id == self.id:
             self._on_share(msg, "prepare")
         else:
-            self.comm.send(collector_id, msg.pack())
+            self._send_tracked(collector_id, msg)
 
     def _send_commit_partial(self, info: SeqNumInfo) -> None:
         pp = info.pre_prepare
@@ -783,7 +833,7 @@ class Replica(IReceiver):
         if collector_id == self.id:
             self._on_share(msg, "commit")
         else:
-            self.comm.send(collector_id, msg.pack())
+            self._send_tracked(collector_id, msg)
 
     def _fast_tools(self, path: int):
         """(signer, verifier, domain-tag) for a fast commit path."""
@@ -804,7 +854,7 @@ class Replica(IReceiver):
         if collector_id == self.id:
             self._on_share(msg, "fast")
         else:
-            self.comm.send(collector_id, msg.pack())
+            self._send_tracked(collector_id, msg)
 
     def _on_share(self, msg: m.PreparePartialMsg, kind: str) -> None:
         """Collector side: accumulate a threshold share
@@ -814,9 +864,14 @@ class Replica(IReceiver):
         if not self.window.in_window(msg.seq_num) \
                 or msg.seq_num <= self.last_stable:
             return
+        # receipt ack (duplicates too — the sender may have missed the
+        # first ack; retransmission keys on receipt, not on usefulness)
+        self._ack(msg.sender_id, int(msg.CODE), msg.seq_num)
         info = self.window.get(msg.seq_num)
         if info.pre_prepare is None:
             info.early_shares.setdefault(kind, []).append(msg)
+            if not info.first_evidence_at:
+                info.first_evidence_at = time.monotonic()
             return
         if kind == "fast" and msg.path != info.pre_prepare.first_path:
             return                              # share for the wrong path
@@ -876,7 +931,7 @@ class Replica(IReceiver):
             full = m.FullCommitProofMsg(sender_id=self.id, view=self.view,
                                         seq_num=res.seq_num, digest=d,
                                         sig=res.combined_sig)
-            self._broadcast(full)
+            self._broadcast_tracked(full)
             self._accept_full_commit_proof(full)
             return
         d = share_digest(res.kind, self.view, pp.seq_num, pp.digest())
@@ -884,13 +939,13 @@ class Replica(IReceiver):
             full = m.PrepareFullMsg(sender_id=self.id, view=self.view,
                                     seq_num=res.seq_num, digest=d,
                                     sig=res.combined_sig)
-            self._broadcast(full)
+            self._broadcast_tracked(full)
             self._accept_prepare_full(full)
         elif res.kind == "commit":
             full = m.CommitFullMsg(sender_id=self.id, view=self.view,
                                    seq_num=res.seq_num, digest=d,
                                    sig=res.combined_sig)
-            self._broadcast(full)
+            self._broadcast_tracked(full)
             self._accept_commit_full(full)
 
     # ------------------------------------------------------------------
@@ -924,14 +979,17 @@ class Replica(IReceiver):
         tools = self._cert_tools(msg, kind)
         if tools is None:
             return
+        self._ack(msg.sender_id, int(msg.CODE), msg.seq_num)
         if tools == "early":
             # PP not here yet (possibly still in async verification):
             # buffer per (kind, sender), drained on PP acceptance — one
             # slot per sender, so a byzantine peer's spam only ever
             # displaces its own buffered certs, never the collector's
             if self.info.is_replica(msg.sender_id):
-                self.window.get(msg.seq_num).early_certs[
-                    (kind, msg.sender_id)] = msg
+                info = self.window.get(msg.seq_num)
+                info.early_certs[(kind, msg.sender_id)] = msg
+                if not info.first_evidence_at:
+                    info.first_evidence_at = time.monotonic()
             return
         info = self.window.get(msg.seq_num)
         if info.committed or (kind == "prepare" and info.prepared):
@@ -1098,7 +1156,10 @@ class Replica(IReceiver):
             if not self.window.in_window(nxt):
                 return
             if self.control.blocks_ordering(nxt):
-                return  # wedged: execution halts at the agreed cut
+                # wedged: execution halts at the agreed cut; announce
+                # readiness for the operator's restart proof
+                self._maybe_announce_restart_ready()
+                return
             info = self.window.peek(nxt)
             if info is None or not info.committed or info.executed:
                 return
@@ -1206,6 +1267,13 @@ class Replica(IReceiver):
             last_executed_seq=self.last_executed,
             in_view_change=self.in_view_change)
         self._broadcast(status)
+        # restart votes are liveness-critical for the n/n proof: keep
+        # re-announcing until the proof forms (peers may have been
+        # lagging or lossy when the first broadcast went out)
+        if self._my_restart_vote is not None \
+                and not self.control.restart_proof \
+                and self.control.wedge_point is not None:
+            self._broadcast(self._my_restart_vote)
 
     MAX_GAP_RESEND = 8
 
@@ -1244,6 +1312,130 @@ class Replica(IReceiver):
                 if entry.prepare_full is not None:
                     self.comm.send(peer, entry.prepare_full)
                 self.comm.send(peer, entry.commit_full)
+
+    # ------------------------------------------------------------------
+    # missing-data flow (reference ReqMissingDataMsg + tryToSendReqMissing)
+    # ------------------------------------------------------------------
+    def _check_missing_data(self) -> None:
+        """Evidence without a PrePrepare (buffered shares/certs) that has
+        aged past the retransmission horizon: explicitly ask for the PP —
+        first the primary, then everyone (the primary may be the one
+        withholding it)."""
+        if not self._running or self.in_view_change:
+            return
+        now = time.monotonic()
+        grace = self.cfg.retransmission_timer_ms * 8 / 1000.0
+        for seq, info in list(self.window.items()):
+            if info.pre_prepare is not None or info.pp_verifying is not None:
+                self._missing_since.pop(seq, None)
+                continue
+            if not info.early_shares and not info.early_certs:
+                continue
+            if not info.first_evidence_at \
+                    or now - info.first_evidence_at < grace:
+                continue
+            entry = self._missing_since.setdefault(seq, [0.0, 0])
+            if entry[1] and now - entry[0] < grace:
+                continue                      # asked recently: wait
+            entry[0] = now
+            entry[1] += 1
+            req = m.ReqMissingDataMsg(sender_id=self.id, view=self.view,
+                                      seq_num=seq, missing=1)
+            log.info("requesting missing PrePrepare for seq %d "
+                     "(attempt %d)", seq, entry[1])
+            if entry[1] == 1:
+                self.comm.send(self.primary, req.pack())
+            else:
+                self._broadcast(req)
+
+    def _on_req_missing_data(self, sender: int,
+                             msg: m.ReqMissingDataMsg) -> None:
+        """Serve a peer's explicit gap request from live window state or
+        persisted metadata (reference handleReqMissingDataMsg). Unsigned
+        like status — a spoofed request costs a bounded resend."""
+        if msg.view != self.view or sender == self.id:
+            return
+        info = self.window.peek(msg.seq_num)
+        pieces = []
+        if info is not None and info.pre_prepare is not None:
+            if msg.missing & 1:
+                pieces.append(info.pre_prepare.pack())
+            if msg.missing & 2 and info.prepare_full is not None:
+                pieces.append(info.prepare_full.pack())
+            if msg.missing & 4 and info.commit_full is not None:
+                pieces.append(info.commit_full.pack())
+            if msg.missing & 8 and info.full_commit_proof is not None:
+                pieces.append(info.full_commit_proof.pack())
+        else:
+            entry = self.storage.load().seq_states.get(msg.seq_num)
+            if entry is not None:
+                for want, raw in ((1, entry.pre_prepare),
+                                  (2, entry.prepare_full),
+                                  (4, entry.commit_full),
+                                  (8, entry.full_commit_proof)):
+                    if msg.missing & want and raw is not None:
+                        pieces.append(raw)
+        for raw in pieces:
+            self.comm.send(sender, raw)
+
+    # ------------------------------------------------------------------
+    # restart-readiness at the wedge point (ReplicaRestartReadyMsg)
+    # ------------------------------------------------------------------
+    def _maybe_announce_restart_ready(self) -> None:
+        """Wedged at the agreed stop point: broadcast a signed readiness
+        vote; a 2f+c+1 certificate of these is the restart proof the
+        operator's wrapper waits for (reference ReplicaRestartReadyMsg →
+        ReplicasRestartReadyProofMsg flow)."""
+        point = self.control.wedge_point
+        if point is None or self._restart_announced == point \
+                or self.last_executed < point:
+            return
+        self._restart_announced = point
+        msg = m.ReplicaRestartReadyMsg(
+            sender_id=self.id, seq_num=point,
+            reason=0, signature=b"")
+        msg.signature = self.sig.sign(msg.signed_payload())
+        self._my_restart_vote = msg
+        log.info("wedged at %d: announcing restart readiness", point)
+        self._broadcast(msg)
+        self._on_restart_ready(msg)
+
+    def _on_restart_ready(self, msg: m.ReplicaRestartReadyMsg) -> None:
+        """Collect signed readiness votes. Votes arriving BEFORE this
+        replica reaches (or even learns of) the wedge point are buffered —
+        a lagging replica must still be able to complete its proof later.
+        Bounded: at most 4 candidate points, highest kept."""
+        votes = self._restart_votes.get(msg.seq_num)
+        if votes is None:
+            if len(self._restart_votes) >= 4:
+                lowest = min(self._restart_votes)
+                if msg.seq_num <= lowest:
+                    return
+                del self._restart_votes[lowest]
+            votes = self._restart_votes[msg.seq_num] = set()
+        if msg.sender_id in votes:
+            return
+        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
+                               msg.signature, seq=msg.seq_num):
+            return
+        votes.add(msg.sender_id)
+        # super-stable n/n proof (the reference's AddRemoveWithWedge
+        # semantics): EVERY replica finished executing to the stop point,
+        # so a restart loses no execution anywhere
+        if (self.control.wedge_point == msg.seq_num
+                and len(votes) >= self.info.n
+                and not self.control.restart_proof):
+            log.info("restart proof complete at wedge point %d "
+                     "(%d/%d votes)", msg.seq_num, len(votes), self.info.n)
+            self.control.restart_proof = True
+
+    def unwedge(self) -> None:
+        """Operator unwedge: clear control state AND the restart election
+        (a later re-wedge — even at the same point — starts fresh)."""
+        self.control.unwedge()
+        self._restart_announced = None
+        self._my_restart_vote = None
+        self._restart_votes.clear()
 
     # ------------------------------------------------------------------
     # checkpointing (ReplicaImp.cpp:2280,3274,3439)
@@ -1335,6 +1527,10 @@ class Replica(IReceiver):
         if seq <= self.last_stable:
             return
         log.debug("checkpoint stable at seq %d", seq)
+        if self.retrans is not None:
+            self.retrans.gc_stable(seq)
+        for s in [s for s in self._missing_since if s <= seq]:
+            del self._missing_since[s]
         if self.state_transfer is not None:
             self.state_transfer.on_checkpoint_stable(
                 seq, state_digest if state_digest is not None
@@ -1561,6 +1757,10 @@ class Replica(IReceiver):
         self.m_view.set(new_view)
         log.info("entered view %d (primary=%d, %d restricted seqnums)",
                  new_view, self.primary, len(restrictions))
+        if self.retrans is not None:
+            # ordering messages of older views are dead letters
+            self.retrans.clear_view(new_view)
+        self._missing_since.clear()
         # purge complaints ABOUT the view we just entered too: complaint
         # quorums accumulated while the view change was forming must not
         # depose the fresh primary; if it really is unhealthy, complaints
@@ -1626,6 +1826,33 @@ class Replica(IReceiver):
         raw = msg.pack()
         for r in self.info.other_replicas(self.id):
             self.comm.send(r, raw)
+
+    # ---- retransmission plumbing (RetransmissionsManager consumers) ----
+
+    def _send_tracked(self, dest: int, msg) -> None:
+        """Send + register for ack-tracked retransmission."""
+        raw = msg.pack()
+        self.comm.send(dest, raw)
+        if self.retrans is not None and dest != self.id:
+            self.retrans.track(dest, int(msg.CODE), msg.seq_num, self.view,
+                               raw, time.monotonic())
+
+    def _broadcast_tracked(self, msg) -> None:
+        raw = msg.pack()
+        now = time.monotonic()
+        for r in self.info.other_replicas(self.id):
+            self.comm.send(r, raw)
+            if self.retrans is not None:
+                self.retrans.track(r, int(msg.CODE), msg.seq_num, self.view,
+                                   raw, now)
+
+    def _ack(self, dest: int, code: int, seq: int) -> None:
+        """Ack receipt of a retransmittable message (SimpleAckMsg)."""
+        if self.retrans is None or dest == self.id:
+            return
+        self.comm.send(dest, m.SimpleAckMsg(
+            sender_id=self.id, seq_num=seq, view=self.view,
+            acked_msg_code=code).pack())
 
     def _tran(self):
         storage = self.storage

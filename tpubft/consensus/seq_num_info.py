@@ -55,6 +55,9 @@ class SeqNumInfo:
     # keyed (kind, sender) for the same anti-shadowing reason; retried
     # when the in-flight verdict lands
     cert_pending: Dict[tuple, object] = field(default_factory=dict)
+    # when evidence (shares/certs) first arrived WITHOUT a PrePrepare —
+    # the ReqMissingDataMsg trigger clock
+    first_evidence_at: float = 0.0
 
 
 T = TypeVar("T")

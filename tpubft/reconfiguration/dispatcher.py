@@ -88,7 +88,7 @@ class WedgeHandler(IReconfigurationHandler):
             replica.control.set_wedge_point(stop)
             return rm.ReconfigReply(success=True, data=str(stop))
         if isinstance(cmd, rm.UnwedgeCommand):
-            replica.control.unwedge()
+            replica.unwedge()       # control state + restart election
             return rm.ReconfigReply(success=True)
         return None
 
