@@ -1,0 +1,88 @@
+"""Read-only replica process — ledger follower/archiver.
+
+Process wrapper for tpubft.kvbc.readonly.ReadOnlyReplica (reference: the
+RO replica TesterReplica variant used by the Apollo RO/S3 suites): joins
+the cluster's network as id n..n+num_ro-1, follows checkpoints, fetches
+state, archives blocks to a filesystem object store, and serves
+read-only queries.
+
+Run:  python -m tpubft.apps.ro_replica --replica 4 --f 1 \
+          --base-port 3710 --archive-dir /tmp/archive [--seed S]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+from tpubft.apps.simple_test import endpoint_table
+from tpubft.comm import CommConfig, create_communication
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.kvbc.readonly import ReadOnlyReplica
+from tpubft.statetransfer.manager import StConfig
+from tpubft.storage.objectstore import FsObjectStore
+from tpubft.utils.config import ReplicaConfig
+from tpubft.utils.metrics import Aggregator, UdpMetricsServer
+
+
+def main() -> None:
+    from tpubft.utils.logging import configure
+    configure()
+    p = argparse.ArgumentParser(description="read-only replica")
+    p.add_argument("--replica", type=int, required=True)
+    p.add_argument("--f", type=int, default=1)
+    p.add_argument("--c", type=int, default=0)
+    p.add_argument("--ro", type=int, default=1,
+                   help="number of RO replicas in the topology")
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--base-port", type=int, default=3710)
+    p.add_argument("--metrics-port", type=int, default=0)
+    p.add_argument("--archive-dir", default=None)
+    p.add_argument("--seed", default="tpubft-skvbc")
+    p.add_argument("--checkpoint-window", type=int, default=150)
+    p.add_argument("--transport", default="udp",
+                   choices=("udp", "tcp", "tls"))
+    p.add_argument("--certs-dir", default=None,
+                   help="TLS material dir (node-<id>.key/.crt)")
+    args = p.parse_args()
+
+    cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f, c_val=args.c,
+                        num_ro_replicas=args.ro,
+                        num_of_client_proxies=args.clients,
+                        checkpoint_window_size=args.checkpoint_window)
+    keys = ClusterKeys.generate(cfg, args.clients,
+                                seed=args.seed.encode()
+                                ).for_node(args.replica)
+    # the endpoint table covers replicas + RO + clients contiguously
+    eps = endpoint_table(args.base_port, cfg.n_val + args.ro, args.clients)
+    if args.transport == "tls":
+        import os as _os
+
+        from tpubft.comm.tls import TlsConfig
+        comm_cfg = TlsConfig(self_id=args.replica, endpoints=eps,
+                             certs_dir=args.certs_dir,
+                             key_password=_os.environ.get(
+                                 "TPUBFT_TLS_KEY_PASSWORD"))
+    else:
+        comm_cfg = CommConfig(self_id=args.replica, endpoints=eps)
+    comm = create_communication(comm_cfg, args.transport)
+    store = FsObjectStore(args.archive_dir) if args.archive_dir else None
+    agg = Aggregator()
+    ro = ReadOnlyReplica(cfg, keys, comm, object_store=store,
+                         aggregator=agg, st_cfg=StConfig())
+    metrics = UdpMetricsServer(agg, port=args.metrics_port)
+    metrics.start()
+    ro.start()
+    print(f"ro replica {args.replica} up (metrics {metrics.port})",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ro.stop()
+        metrics.stop()
+
+
+if __name__ == "__main__":
+    main()

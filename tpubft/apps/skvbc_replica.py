@@ -26,11 +26,12 @@ from tpubft.utils.metrics import Aggregator, UdpMetricsServer
 
 def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f, c_val=args.c,
+                        num_ro_replicas=args.ro,
                         num_of_client_proxies=args.clients,
                         view_change_timer_ms=args.view_change_timeout_ms)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()).for_node(args.replica)
-    eps = endpoint_table(args.base_port, cfg.n_val, args.clients)
+    eps = endpoint_table(args.base_port, cfg.n_val + args.ro, args.clients)
     if args.transport == "tls":
         from tpubft.comm.tls import TlsConfig
         comm_cfg = TlsConfig(self_id=args.replica, endpoints=eps,
@@ -54,6 +55,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--replica", type=int, required=True)
     p.add_argument("--f", type=int, default=1)
     p.add_argument("--c", type=int, default=0)
+    p.add_argument("--ro", type=int, default=0,
+                   help="read-only replicas in the topology")
     p.add_argument("--clients", type=int, default=4)
     p.add_argument("--base-port", type=int, default=3710)
     p.add_argument("--metrics-port", type=int, default=0)

@@ -516,9 +516,11 @@ class Replica(IReceiver):
             return
         if isinstance(msg, m.StateTransferMsg):
             # ST flows even mid-view-change (reference handles it in
-            # ReplicaForStateTransfer below the view gate)
+            # ReplicaForStateTransfer below the view gate); read-only
+            # replicas are legitimate ST destinations (ReadOnlyReplica)
             if self.state_transfer is not None \
-                    and self.info.is_replica(sender):
+                    and (self.info.is_replica(sender)
+                         or self.info.is_ro_replica(sender)):
                 self.state_transfer.handle_message(sender, msg.payload)
             return
         if isinstance(msg, m.PreProcessRequestMsg):
@@ -1452,6 +1454,12 @@ class Replica(IReceiver):
                              signature=b"")
         ck.signature = self.sig.sign(ck.signed_payload())
         self._broadcast(ck)
+        # read-only replicas feed on checkpoint certificates too (their
+        # state-transfer trust anchors — reference: RO replicas receive
+        # the same CheckpointMsg traffic)
+        raw = ck.pack()
+        for ro in self.info.ro_replica_ids:
+            self.comm.send(ro, raw)
         self._store_checkpoint(ck)
 
     def _on_checkpoint(self, ck: m.CheckpointMsg) -> None:

@@ -37,6 +37,15 @@ class ReplicasInfo:
     def is_replica(self, node: int) -> bool:
         return 0 <= node < self.n
 
+    @property
+    def ro_replica_ids(self) -> range:
+        """Read-only replicas (reference ReadOnlyReplica): ST-only nodes
+        squeezed between the voting set and the clients."""
+        return range(self.n, self.n + self.num_ro)
+
+    def is_ro_replica(self, node: int) -> bool:
+        return self.n <= node < self.n + self.num_ro
+
     def is_client(self, node: int) -> bool:
         return node >= self.first_client_id
 

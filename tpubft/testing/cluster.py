@@ -30,6 +30,9 @@ class InProcessCluster:
                                  num_of_client_proxies=num_clients,
                                  **(cfg_overrides or {}))
         self.n = base_cfg.n_val
+        # client ids start after any read-only replicas (reference id
+        # convention: replicas, RO replicas, then clients)
+        self.first_client_id = base_cfg.n_val + base_cfg.num_ro_replicas
         self.bus = LoopbackBus()
         self.keys = ClusterKeys.generate(base_cfg, num_clients, seed=seed)
         self.aggregators: Dict[int, Aggregator] = {}
@@ -99,7 +102,7 @@ class InProcessCluster:
         return OperatorClient(cl)
 
     def client(self, idx: int = 0, **cfg_kw) -> BftClient:
-        client_id = self.n + idx
+        client_id = self.first_client_id + idx
         cl = self.clients.get(client_id)
         if cl is None:
             cfg = ClientConfig(client_id=client_id, f_val=self.f,

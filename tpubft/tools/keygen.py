@@ -53,6 +53,7 @@ def _manager(password: Optional[str]):
 
 def generate(args) -> int:
     cfg = ReplicaConfig(f_val=args.f, c_val=args.c,
+                        num_ro_replicas=args.ro,
                         num_of_client_proxies=args.clients)
     cluster = ClusterKeys.generate(cfg, args.clients,
                                    seed=args.seed.encode())
@@ -61,6 +62,8 @@ def generate(args) -> int:
     names = {}
     for r in range(cfg.n_val):
         names[cluster.for_node(r).my_id] = f"replica-{r}.keys"
+    for ro in range(cfg.n_val, cfg.n_val + args.ro):
+        names[ro] = f"ro-replica-{ro}.keys"
     first_client = cfg.n_val + cfg.num_ro_replicas
     for cl in range(first_client, first_client + args.clients):
         names[cl] = f"client-{cl}.keys"
@@ -126,6 +129,8 @@ def main() -> int:
     g = sub.add_parser("generate")
     g.add_argument("-f", type=int, default=1)
     g.add_argument("-c", type=int, default=0)
+    g.add_argument("--ro", type=int, default=0,
+                   help="read-only replicas in the topology")
     g.add_argument("--clients", type=int, default=4)
     g.add_argument("-o", "--out", required=True)
     g.add_argument("--seed", default="tpubft-cluster")
