@@ -1,0 +1,59 @@
+"""BLS batch share verification tree (reference BlsBatchVerifier.cpp)."""
+import pytest
+
+from tpubft.crypto import bls12381 as bls
+
+
+def _setup(n, seed=b"bvt"):
+    master_pk, share_pks, sks = bls.threshold_keygen(3, n, seed=seed)
+    h = bls.hash_to_g1(b"digest")
+    shares = [bls.g1_mul(h, sk) for sk in sks]
+    return share_pks, h, shares
+
+
+@pytest.mark.slow
+def test_batch_verify_all_good_is_one_check():
+    pks, h, shares = _setup(6)
+    tree = bls.BlsBatchVerifier(pks, h)
+    assert tree.batch_verify(shares) == [True] * 6
+    assert tree.checks == 1                     # one aggregate pairing
+
+
+@pytest.mark.slow
+def test_batch_verify_isolates_bad_shares_logarithmically():
+    pks, h, shares = _setup(8)
+    bad_h = bls.hash_to_g1(b"other")
+    shares[2] = bls.g1_mul(bad_h, 12345)        # forged share
+    tree = bls.BlsBatchVerifier(pks, h)
+    got = tree.batch_verify(shares)
+    assert got == [i != 2 for i in range(8)]
+    # one bad of 8: root + the halving path = O(log n), far below n=8
+    # individual checks (root fails -> 2 halves -> ... path to the leaf)
+    assert tree.checks <= 2 * 3 + 1
+
+
+@pytest.mark.slow
+def test_accumulator_identify_bad_shares_uses_tree():
+    from tpubft.crypto.interfaces import Cryptosystem
+    sysm = Cryptosystem("threshold-bls", 3, 4, seed=b"tree-acc")
+    ver = sysm.create_threshold_verifier()
+    digest = b"d" * 32
+    acc = ver.new_accumulator(with_share_verification=False)
+    acc.set_expected_digest(digest)
+    for sid in (1, 2, 3):
+        acc.add(sid, sysm.create_threshold_signer(sid).sign_share(digest))
+    # corrupt share 2 after the fact
+    acc._shares[2] = bls.g1_mul(bls.hash_to_g1(b"junk"), 7)
+    assert acc.identify_bad_shares() == [2]
+
+
+@pytest.mark.slow
+def test_rlc_rejects_compensating_forgeries():
+    """Two shares forged so their SUM looks right must not pass the
+    random-linear-combination check (the z_i kill cancellation)."""
+    pks, h, shares = _setup(4)
+    # tamper shares 0 and 1 in compensating directions: s0+delta, s1-delta
+    delta = bls.g1_mul(bls.G1_GEN, 987654321)
+    shares[0] = bls.g1_add(shares[0], delta)
+    shares[1] = bls.g1_add(shares[1], bls.g1_neg(delta))
+    assert not bls.batch_verify_shares(pks, h, shares)

@@ -257,7 +257,7 @@ def g1_neg(pt):
     return (pt[0], (-pt[1]) % P)
 
 
-def g1_mul(pt, k: int):
+def g1_mul_py(pt, k: int):
     k %= R
     result = None
     add = pt
@@ -269,12 +269,28 @@ def g1_mul(pt, k: int):
     return result
 
 
-def g1_msm(points: Sequence, scalars: Sequence[int]):
-    """Multi-scalar multiplication sum_i [k_i] P_i (the hot accumulate op)."""
+def g1_mul(pt, k: int):
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.g1_mul(pt, k)
+    return g1_mul_py(pt, k)
+
+
+def g1_msm_py(points: Sequence, scalars: Sequence[int]):
+    """Pure-Python MSM (golden model)."""
     acc = None
     for pt, k in zip(points, scalars):
         acc = g1_add(acc, g1_mul(pt, k))
     return acc
+
+
+def g1_msm(points: Sequence, scalars: Sequence[int]):
+    """Multi-scalar multiplication sum_i [k_i] P_i (the hot accumulate
+    op); native engine when available."""
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.g1_msm(points, scalars)
+    return g1_msm_py(points, scalars)
 
 
 # ---------------- G2 (affine over Fp2) ----------------
@@ -310,7 +326,7 @@ def g2_neg(pt):
     return (pt[0], fp2_neg(pt[1]))
 
 
-def g2_mul(pt, k: int):
+def g2_mul_py(pt, k: int):
     k %= R
     result = None
     add = pt
@@ -320,6 +336,13 @@ def g2_mul(pt, k: int):
         add = g2_add(add, add)
         k >>= 1
     return result
+
+
+def g2_mul(pt, k: int):
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.g2_mul(pt, k)
+    return g2_mul_py(pt, k)
 
 
 # ---------------- pairing (ate, Miller loop + final exponentiation) ----------------
@@ -425,12 +448,22 @@ def pairing(p_g1, q_g2):
     return final_exponentiation(miller_loop(q_g2, p_g1))
 
 
-def pairing_check(pairs: Sequence[Tuple[object, object]]) -> bool:
-    """prod e(Pi, Qi) == 1 — the multi-pairing product check."""
+def pairing_check_py(pairs: Sequence[Tuple[object, object]]) -> bool:
+    """Pure-Python multi-pairing product check (golden model)."""
     f = FP12_ONE
     for p_g1, q_g2 in pairs:
         f = fp12_mul(f, miller_loop(q_g2, p_g1))
     return final_exponentiation(f) == FP12_ONE
+
+
+def pairing_check(pairs: Sequence[Tuple[object, object]]) -> bool:
+    """prod e(Pi, Qi) == 1 — the multi-pairing product check. Routed to
+    the native engine (tpubft/native/bls12381.cpp, the RELIC role) when
+    it builds; the pure-Python path is the differential-tested fallback."""
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.pairing_check(pairs)
+    return pairing_check_py(pairs)
 
 
 # ---------------- hash to G1 (try-and-increment, internal ciphersuite) ----------------
@@ -462,7 +495,7 @@ def hash_to_g1(msg: bytes):
         ctr += 1
 
 
-def g1_mul_nonorder(pt, k: int):
+def g1_mul_nonorder_py(pt, k: int):
     """Scalar mul without reducing k mod R (for cofactor clearing)."""
     result = None
     add = pt
@@ -472,6 +505,13 @@ def g1_mul_nonorder(pt, k: int):
         add = g1_add(add, add)
         k >>= 1
     return result
+
+
+def g1_mul_nonorder(pt, k: int):
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.g1_mul_nonorder(pt, k)
+    return g1_mul_nonorder_py(pt, k)
 
 
 # ---------------- serialization ----------------
@@ -563,7 +603,7 @@ def g2_decompress(b: bytes, check_subgroup: bool = True):
     return pt
 
 
-def g2_mul_nonorder(pt, k: int):
+def g2_mul_nonorder_py(pt, k: int):
     """Scalar mul without reducing k mod R (subgroup checks)."""
     result = None
     add = pt
@@ -573,6 +613,13 @@ def g2_mul_nonorder(pt, k: int):
         add = g2_add(add, add)
         k >>= 1
     return result
+
+
+def g2_mul_nonorder(pt, k: int):
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.g2_mul_nonorder(pt, k)
+    return g2_mul_nonorder_py(pt, k)
 
 
 # ---------------- BLS signatures (min-sig: sig in G1, pk in G2) ----------------
@@ -638,3 +685,82 @@ def combine_shares(ids: Sequence[int], shares_g1: Sequence) -> object:
     The hot op the TPU backend shards (reference FastMultExp.cpp:27)."""
     coeffs = lagrange_coeffs_at_zero(ids)
     return g1_msm(shares_g1, coeffs)
+
+
+# ---------------- batch share verification (aggregation tree) ----------------
+
+def g2_msm_py(points: Sequence, scalars: Sequence[int]):
+    acc = None
+    for pt, k in zip(points, scalars):
+        acc = g2_add(acc, g2_mul(pt, k))
+    return acc
+
+
+def g2_msm(points: Sequence, scalars: Sequence[int]):
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.g2_msm(points, scalars)
+    return g2_msm_py(points, scalars)
+
+
+def _rlc_scalars(n: int, context: bytes) -> List[int]:
+    """Deterministic 128-bit random-linear-combination coefficients. A
+    forged share survives the combined check only with probability
+    2^-128 per coefficient choice; deriving them from the share data
+    itself (Fiat-Shamir style) means the adversary committed to the
+    shares before learning the coefficients."""
+    out = []
+    for i in range(n):
+        h = hashlib.sha256(b"bls-rlc" + context + i.to_bytes(4, "big"))
+        out.append(int.from_bytes(h.digest()[:16], "big") | 1)
+    return out
+
+
+def batch_verify_shares(pks_g2: Sequence, h_g1, shares_g1: Sequence) -> bool:
+    """One pairing check for a whole batch of shares over ONE message
+    point: e(Σ z_i·s_i, -g2) · e(H, Σ z_i·pk_i) == 1 with random z_i
+    (the role of the reference's aggregated root check,
+    BlsBatchVerifier.cpp:44). Sound up to 2^-128 per batch."""
+    if not shares_g1:
+        return True
+    if any(s is None or not g1_is_on_curve(s) for s in shares_g1):
+        return False
+    ctx = b"".join(g1_compress(s) for s in shares_g1)
+    zs = _rlc_scalars(len(shares_g1), ctx)
+    agg_sig = g1_msm(shares_g1, zs)
+    agg_pk = g2_msm(pks_g2, zs)
+    return pairing_check([(agg_sig, g2_neg(G2_GEN)), (h_g1, agg_pk)])
+
+
+class BlsBatchVerifier:
+    """Binary aggregation tree over shares: verify the aggregate first,
+    descend only into failing halves — b bad shares cost O(b·log n)
+    pairing checks instead of n (reference BlsBatchVerifier::batchVerify
+    / batchVerifyRecursive, threshsign/src/bls/relic/BlsBatchVerifier.cpp:
+    44,84)."""
+
+    def __init__(self, pks_g2: Sequence, h_g1):
+        self._pks = list(pks_g2)
+        self._h = h_g1
+        self.checks = 0                 # pairing-check count (observability)
+
+    def batch_verify(self, shares_g1: Sequence) -> List[bool]:
+        out = [False] * len(shares_g1)
+        self._recurse(list(range(len(shares_g1))), list(shares_g1), out)
+        return out
+
+    def _recurse(self, idxs: List[int], shares: List, out: List[bool]) -> None:
+        if not idxs:
+            return
+        self.checks += 1
+        if batch_verify_shares([self._pks[i] for i in idxs], self._h,
+                               [shares[i] for i in idxs]):
+            for i in idxs:
+                out[i] = True
+            return
+        if len(idxs) == 1:
+            out[idxs[0]] = False
+            return
+        mid = len(idxs) // 2
+        self._recurse(idxs[:mid], shares, out)
+        self._recurse(idxs[mid:], shares, out)

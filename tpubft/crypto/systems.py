@@ -191,14 +191,16 @@ class BlsThresholdAccumulator(IThresholdAccumulator):
         return bls.g1_compress(combined)
 
     def identify_bad_shares(self) -> List[int]:
+        """Aggregation-tree isolation: O(b·log n) pairing checks for b bad
+        shares (reference BlsBatchVerifier.cpp:44,84) instead of the naive
+        O(n) one-pairing-per-share sweep."""
         assert self._digest is not None
         h = bls.hash_to_g1(self._digest)
-        bad = []
-        for i, pt in self._shares.items():
-            pk = self._verifier.share_pk(i)
-            if not bls.pairing_check([(pt, bls.g2_neg(bls.G2_GEN)), (h, pk)]):
-                bad.append(i)
-        return bad
+        ids = sorted(self._shares)
+        tree = bls.BlsBatchVerifier(
+            [self._verifier.share_pk(i) for i in ids], h)
+        verdicts = tree.batch_verify([self._shares[i] for i in ids])
+        return [i for i, ok in zip(ids, verdicts) if not ok]
 
 
 class BlsThresholdVerifier(IThresholdVerifier):

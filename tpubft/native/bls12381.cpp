@@ -1,0 +1,946 @@
+// BLS12-381 pairing + group arithmetic — native engine.
+//
+// Plays the role RELIC plays in the reference (threshsign/src/bls/relic/:
+// the pairing and exponentiation core under BlsThresholdVerifier /
+// BlsBatchVerifier). This is a from-scratch implementation of the SAME
+// algorithms as the project's pure-Python golden model
+// (tpubft/crypto/bls12381.py) — tower Fp2/Fp6/Fp12 with xi = u+1, ate
+// Miller loop over the D-type twist, signature checks as multi-pairing
+// products — with the two standard speedups the Python model omits:
+//   * Montgomery-form 6x64-limb Fp arithmetic (CIOS multiply);
+//   * fast final exponentiation: easy part (p^6-1)(p^2+1), then the
+//     hard part via the numerically VERIFIED identity
+//       3*(p^4 - p^2 + 1)/r = (x-1)^2 * (x+p) * (x^2 + p^2 - 1) + 3
+//     (cubing the output is sound for equality-with-one checks: the
+//     pre-image lies in the order-r subgroup and r is a prime != 3).
+//
+// The ctypes ABI at the bottom exchanges raw big-endian affine
+// coordinates; all validation beyond range checks stays in Python.
+
+#include <cstdint>
+#include <cstring>
+
+using u64 = uint64_t;
+using u128 = unsigned __int128;
+
+// generated from tpubft/crypto/bls12381.py (python golden model)
+static const uint64_t P_LIMBS[6] = {0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL, 0x6730d2a0f6b0f624ULL, 0x64774b84f38512bfULL, 0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL};
+static const uint64_t N0INV = 0x89f3fffcfffcfffdULL;
+static const uint64_t R2C[6] = {0xf4df1f341c341746ULL, 0x0a76e6a609d104f1ULL, 0x8de5476c4c95b6d5ULL, 0x67eb88a9939d83c0ULL, 0x9a793e85b519952dULL, 0x11988fe592cae3aaULL};
+static const uint64_t ONE_M[6] = {0x760900000002fffdULL, 0xebf4000bc40c0002ULL, 0x5f48985753c758baULL, 0x77ce585370525745ULL, 0x5c071a97a256ec6dULL, 0x15f65ec3fa80e493ULL};
+static const uint64_t G1C1_0[6] = {0x07089552b319d465ULL, 0xc6695f92b50a8313ULL, 0x97e83cccd117228fULL, 0xa35baecab2dc29eeULL, 0x1ce393ea5daace4dULL, 0x08f2220fb0fb66ebULL};
+static const uint64_t G1C1_1[6] = {0xb2f66aad4ce5d646ULL, 0x5842a06bfc497cecULL, 0xcf4895d42599d394ULL, 0xc11b9cba40a8e8d0ULL, 0x2e3813cbe5a0de89ULL, 0x110eefda88847fafULL};
+static const uint64_t G1C2_0[6] = {0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL};
+static const uint64_t G1C2_1[6] = {0xcd03c9e48671f071ULL, 0x5dab22461fcda5d2ULL, 0x587042afd3851b95ULL, 0x8eb60ebe01bacb9eULL, 0x03f97d6e83d050d2ULL, 0x18f0206554638741ULL};
+static const uint64_t G1C3_0[6] = {0x7bcfa7a25aa30fdaULL, 0xdc17dec12a927e7cULL, 0x2f088dd86b4ebef1ULL, 0xd1ca2087da74d4a7ULL, 0x2da2596696cebc1dULL, 0x0e2b7eedbbfd87d2ULL};
+static const uint64_t G1C3_1[6] = {0x7bcfa7a25aa30fdaULL, 0xdc17dec12a927e7cULL, 0x2f088dd86b4ebef1ULL, 0xd1ca2087da74d4a7ULL, 0x2da2596696cebc1dULL, 0x0e2b7eedbbfd87d2ULL};
+static const uint64_t G1C4_0[6] = {0x890dc9e4867545c3ULL, 0x2af322533285a5d5ULL, 0x50880866309b7e2cULL, 0xa20d1b8c7e881024ULL, 0x14e4f04fe2db9068ULL, 0x14e56d3f1564853aULL};
+static const uint64_t G1C4_1[6] = {0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL, 0x0000000000000000ULL};
+static const uint64_t G1C5_0[6] = {0x82d83cf50dbce43fULL, 0xa2813e53df9d018fULL, 0xc6f0caa53c65e181ULL, 0x7525cf528d50fe95ULL, 0x4a85ed50f4798a6bULL, 0x171da0fd6cf8eebdULL};
+static const uint64_t G1C5_1[6] = {0x3726c30af242c66cULL, 0x7c2ac1aad1b6fe70ULL, 0xa04007fbba4b14a2ULL, 0xef517c3266341429ULL, 0x0095ba654ed2226bULL, 0x02e370eccc86f7ddULL};
+static const uint64_t G2C1_0[6] = {0xecfb361b798dba3aULL, 0xc100ddb891865a2cULL, 0x0ec08ff1232bda8eULL, 0xd5c13cc6f1ca4721ULL, 0x47222a47bf7b5c04ULL, 0x0110f184e51c5f59ULL};
+static const uint64_t G2C2_0[6] = {0x30f1361b798a64e8ULL, 0xf3b8ddab7ece5a2aULL, 0x16a8ca3ac61577f7ULL, 0xc26a2ff874fd029bULL, 0x3636b76660701c6eULL, 0x051ba4ab241b6160ULL};
+static const uint64_t G2C3_0[6] = {0x43f5fffffffcaaaeULL, 0x32b7fff2ed47fffdULL, 0x07e83a49a2e99d69ULL, 0xeca8f3318332bb7aULL, 0xef148d1ea0f4c069ULL, 0x040ab3263eff0206ULL};
+static const uint64_t G2C4_0[6] = {0xcd03c9e48671f071ULL, 0x5dab22461fcda5d2ULL, 0x587042afd3851b95ULL, 0x8eb60ebe01bacb9eULL, 0x03f97d6e83d050d2ULL, 0x18f0206554638741ULL};
+static const uint64_t G2C5_0[6] = {0x890dc9e4867545c3ULL, 0x2af322533285a5d5ULL, 0x50880866309b7e2cULL, 0xa20d1b8c7e881024ULL, 0x14e4f04fe2db9068ULL, 0x14e56d3f1564853aULL};
+
+static const u64 X_ABS = 0xd201000000010000ULL;  // |x|, x negative
+
+// ---------------- Fp (Montgomery form) ----------------
+
+struct Fp { u64 l[6]; };
+
+static inline bool fp_is_zero(const Fp& a) {
+    u64 acc = 0;
+    for (int i = 0; i < 6; i++) acc |= a.l[i];
+    return acc == 0;
+}
+
+static inline bool fp_eq(const Fp& a, const Fp& b) {
+    u64 acc = 0;
+    for (int i = 0; i < 6; i++) acc |= a.l[i] ^ b.l[i];
+    return acc == 0;
+}
+
+static inline int fp_cmp_p(const u64* a) {  // a >= P ?
+    for (int i = 5; i >= 0; i--) {
+        if (a[i] < P_LIMBS[i]) return -1;
+        if (a[i] > P_LIMBS[i]) return 1;
+    }
+    return 0;
+}
+
+static inline void fp_sub_p(u64* a) {
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)a[i] - P_LIMBS[i] - borrow;
+        a[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+static void fp_add(Fp& r, const Fp& a, const Fp& b) {
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)a.l[i] + b.l[i];
+        r.l[i] = (u64)c;
+        c >>= 64;
+    }
+    if (c || fp_cmp_p(r.l) >= 0) fp_sub_p(r.l);
+}
+
+static void fp_sub(Fp& r, const Fp& a, const Fp& b) {
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)a.l[i] - b.l[i] - borrow;
+        r.l[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+    if (borrow) {  // add P back
+        u128 c = 0;
+        for (int i = 0; i < 6; i++) {
+            c += (u128)r.l[i] + P_LIMBS[i];
+            r.l[i] = (u64)c;
+            c >>= 64;
+        }
+    }
+}
+
+static void fp_neg(Fp& r, const Fp& a) {
+    if (fp_is_zero(a)) { r = a; return; }
+    u128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 d = (u128)P_LIMBS[i] - a.l[i] - borrow;
+        r.l[i] = (u64)d;
+        borrow = (d >> 64) & 1;
+    }
+}
+
+// CIOS Montgomery multiplication: r = a*b*R^-1 mod p
+static void fp_mul(Fp& r, const Fp& a, const Fp& b) {
+    u64 t[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 6; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 6; j++) {
+            c += (u128)t[j] + (u128)a.l[j] * b.l[i];
+            t[j] = (u64)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[6] = (u64)c;
+        t[7] = (u64)(c >> 64);
+        u64 m = t[0] * N0INV;
+        c = (u128)t[0] + (u128)m * P_LIMBS[0];
+        c >>= 64;
+        for (int j = 1; j < 6; j++) {
+            c += (u128)t[j] + (u128)m * P_LIMBS[j];
+            t[j - 1] = (u64)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[5] = (u64)c;
+        t[6] = t[7] + (u64)(c >> 64);
+    }
+    if (t[6] || fp_cmp_p(t) >= 0) fp_sub_p(t);
+    memcpy(r.l, t, 48);
+}
+
+static inline void fp_sqr(Fp& r, const Fp& a) { fp_mul(r, a, a); }
+
+static void fp_pow(Fp& r, const Fp& a, const u64* e, int nlimbs) {
+    Fp result;
+    memcpy(result.l, ONE_M, 48);
+    Fp base = a;
+    for (int i = 0; i < nlimbs; i++) {
+        u64 w = e[i];
+        for (int b = 0; b < 64; b++) {
+            if (i * 64 + b >= nlimbs * 64) break;
+            if (w & 1) fp_mul(result, result, base);
+            fp_sqr(base, base);
+            w >>= 1;
+        }
+    }
+    r = result;
+}
+
+static void fp_inv(Fp& r, const Fp& a) {  // a^(p-2)
+    u64 e[6];
+    memcpy(e, P_LIMBS, 48);
+    // P - 2 (no borrow past limb 0: low limb is ...aaab)
+    e[0] -= 2;
+    fp_pow(r, a, e, 6);
+}
+
+static void fp_from_be(Fp& r, const uint8_t* be48) {
+    Fp raw;
+    for (int i = 0; i < 6; i++) {
+        u64 w = 0;
+        for (int j = 0; j < 8; j++) w = (w << 8) | be48[(5 - i) * 8 + j];
+        raw.l[i] = w;
+    }
+    Fp r2;
+    memcpy(r2.l, R2C, 48);
+    fp_mul(r, raw, r2);               // to Montgomery form
+}
+
+static void fp_to_be(uint8_t* be48, const Fp& a) {
+    Fp one = {{1, 0, 0, 0, 0, 0}};
+    Fp plain;
+    fp_mul(plain, a, one);            // from Montgomery form
+    for (int i = 0; i < 6; i++) {
+        u64 w = plain.l[5 - i];
+        for (int j = 0; j < 8; j++) {
+            be48[i * 8 + j] = (uint8_t)(w >> (56 - 8 * j));
+        }
+    }
+}
+
+static Fp FP_ZERO_C, FP_ONE_C;
+
+// ---------------- Fp2 = Fp[u]/(u^2+1) ----------------
+
+struct Fp2 { Fp c0, c1; };
+
+static void fp2_add(Fp2& r, const Fp2& a, const Fp2& b) {
+    fp_add(r.c0, a.c0, b.c0);
+    fp_add(r.c1, a.c1, b.c1);
+}
+
+static void fp2_sub(Fp2& r, const Fp2& a, const Fp2& b) {
+    fp_sub(r.c0, a.c0, b.c0);
+    fp_sub(r.c1, a.c1, b.c1);
+}
+
+static void fp2_neg(Fp2& r, const Fp2& a) {
+    fp_neg(r.c0, a.c0);
+    fp_neg(r.c1, a.c1);
+}
+
+static void fp2_mul(Fp2& r, const Fp2& a, const Fp2& b) {
+    Fp t0, t1, t2, s0, s1;
+    fp_mul(t0, a.c0, b.c0);
+    fp_mul(t1, a.c1, b.c1);
+    fp_add(s0, a.c0, a.c1);
+    fp_add(s1, b.c0, b.c1);
+    fp_mul(t2, s0, s1);
+    fp_sub(r.c0, t0, t1);
+    fp_sub(t2, t2, t0);
+    fp_sub(r.c1, t2, t1);
+}
+
+static void fp2_sqr(Fp2& r, const Fp2& a) {
+    Fp t0, t1, t2;
+    fp_add(t0, a.c0, a.c1);
+    fp_sub(t1, a.c0, a.c1);
+    fp_mul(t2, a.c0, a.c1);
+    fp_mul(r.c0, t0, t1);
+    fp_add(r.c1, t2, t2);
+}
+
+static void fp2_conj(Fp2& r, const Fp2& a) {
+    r.c0 = a.c0;
+    fp_neg(r.c1, a.c1);
+}
+
+static void fp2_inv(Fp2& r, const Fp2& a) {
+    Fp n, t0, t1;
+    fp_sqr(t0, a.c0);
+    fp_sqr(t1, a.c1);
+    fp_add(n, t0, t1);
+    fp_inv(n, n);
+    fp_mul(r.c0, a.c0, n);
+    fp_mul(t0, a.c1, n);
+    fp_neg(r.c1, t0);
+}
+
+static void fp2_mul_fp(Fp2& r, const Fp2& a, const Fp& k) {
+    fp_mul(r.c0, a.c0, k);
+    fp_mul(r.c1, a.c1, k);
+}
+
+static void fp2_mul_xi(Fp2& r, const Fp2& a) {  // * (u+1)
+    Fp t0, t1;
+    fp_sub(t0, a.c0, a.c1);
+    fp_add(t1, a.c0, a.c1);
+    r.c0 = t0;
+    r.c1 = t1;
+}
+
+static bool fp2_is_zero(const Fp2& a) {
+    return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+
+static bool fp2_eq(const Fp2& a, const Fp2& b) {
+    return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+
+static Fp2 FP2_ZERO_C, FP2_ONE_C;
+
+// ---------------- Fp6 = Fp2[v]/(v^3 - (u+1)) ----------------
+
+struct Fp6 { Fp2 c0, c1, c2; };
+
+static void fp6_add(Fp6& r, const Fp6& a, const Fp6& b) {
+    fp2_add(r.c0, a.c0, b.c0);
+    fp2_add(r.c1, a.c1, b.c1);
+    fp2_add(r.c2, a.c2, b.c2);
+}
+
+static void fp6_sub(Fp6& r, const Fp6& a, const Fp6& b) {
+    fp2_sub(r.c0, a.c0, b.c0);
+    fp2_sub(r.c1, a.c1, b.c1);
+    fp2_sub(r.c2, a.c2, b.c2);
+}
+
+static void fp6_neg(Fp6& r, const Fp6& a) {
+    fp2_neg(r.c0, a.c0);
+    fp2_neg(r.c1, a.c1);
+    fp2_neg(r.c2, a.c2);
+}
+
+static void fp6_mul(Fp6& r, const Fp6& a, const Fp6& b) {
+    Fp2 t0, t1, t2, s0, s1, u0, u1;
+    fp2_mul(t0, a.c0, b.c0);
+    fp2_mul(t1, a.c1, b.c1);
+    fp2_mul(t2, a.c2, b.c2);
+    // c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+    fp2_add(s0, a.c1, a.c2);
+    fp2_add(s1, b.c1, b.c2);
+    fp2_mul(u0, s0, s1);
+    fp2_sub(u0, u0, t1);
+    fp2_sub(u0, u0, t2);
+    fp2_mul_xi(u0, u0);
+    Fp2 c0;
+    fp2_add(c0, t0, u0);
+    // c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+    fp2_add(s0, a.c0, a.c1);
+    fp2_add(s1, b.c0, b.c1);
+    fp2_mul(u0, s0, s1);
+    fp2_sub(u0, u0, t0);
+    fp2_sub(u0, u0, t1);
+    fp2_mul_xi(u1, t2);
+    Fp2 c1;
+    fp2_add(c1, u0, u1);
+    // c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    fp2_add(s0, a.c0, a.c2);
+    fp2_add(s1, b.c0, b.c2);
+    fp2_mul(u0, s0, s1);
+    fp2_sub(u0, u0, t0);
+    fp2_sub(u0, u0, t2);
+    fp2_add(r.c2, u0, t1);
+    r.c0 = c0;
+    r.c1 = c1;
+}
+
+static void fp6_inv(Fp6& r, const Fp6& a) {
+    Fp2 c0, c1, c2, t0, t1;
+    fp2_sqr(t0, a.c0);
+    fp2_mul(t1, a.c1, a.c2);
+    fp2_mul_xi(t1, t1);
+    fp2_sub(c0, t0, t1);
+    fp2_sqr(t0, a.c2);
+    fp2_mul_xi(t0, t0);
+    fp2_mul(t1, a.c0, a.c1);
+    fp2_sub(c1, t0, t1);
+    fp2_sqr(t0, a.c1);
+    fp2_mul(t1, a.c0, a.c2);
+    fp2_sub(c2, t0, t1);
+    Fp2 t;
+    fp2_mul(t0, a.c2, c1);
+    fp2_mul(t1, a.c1, c2);
+    fp2_add(t0, t0, t1);
+    fp2_mul_xi(t0, t0);
+    fp2_mul(t1, a.c0, c0);
+    fp2_add(t, t1, t0);
+    fp2_inv(t, t);
+    fp2_mul(r.c0, c0, t);
+    fp2_mul(r.c1, c1, t);
+    fp2_mul(r.c2, c2, t);
+}
+
+static Fp6 FP6_ZERO_C, FP6_ONE_C;
+
+// ---------------- Fp12 = Fp6[w]/(w^2 - v) ----------------
+
+struct Fp12 { Fp6 c0, c1; };
+
+static void fp6_mul_v(Fp6& r, const Fp6& a) {  // multiply by v
+    Fp2 t;
+    fp2_mul_xi(t, a.c2);
+    r.c2 = a.c1;
+    r.c1 = a.c0;
+    r.c0 = t;
+}
+
+static void fp12_mul(Fp12& r, const Fp12& a, const Fp12& b) {
+    Fp6 t0, t1, s0, s1, vt1;
+    fp6_mul(t0, a.c0, b.c0);
+    fp6_mul(t1, a.c1, b.c1);
+    fp6_mul_v(vt1, t1);
+    Fp6 c0;
+    fp6_add(c0, t0, vt1);
+    fp6_add(s0, a.c0, a.c1);
+    fp6_add(s1, b.c0, b.c1);
+    fp6_mul(s0, s0, s1);
+    fp6_sub(s0, s0, t0);
+    fp6_sub(r.c1, s0, t1);
+    r.c0 = c0;
+}
+
+static void fp12_sqr(Fp12& r, const Fp12& a) { fp12_mul(r, a, a); }
+
+static void fp12_conj(Fp12& r, const Fp12& a) {
+    r.c0 = a.c0;
+    fp6_neg(r.c1, a.c1);
+}
+
+static void fp12_inv(Fp12& r, const Fp12& a) {
+    Fp6 t0, t1, vt1;
+    fp6_mul(t0, a.c0, a.c0);
+    fp6_mul(t1, a.c1, a.c1);
+    fp6_mul_v(vt1, t1);
+    fp6_sub(t0, t0, vt1);
+    fp6_inv(t0, t0);
+    fp6_mul(r.c0, a.c0, t0);
+    Fp6 t2;
+    fp6_mul(t2, a.c1, t0);
+    fp6_neg(r.c1, t2);
+}
+
+static bool fp12_is_one(const Fp12& a) {
+    return fp2_eq(a.c0.c0, FP2_ONE_C) && fp2_is_zero(a.c0.c1)
+        && fp2_is_zero(a.c0.c2) && fp2_is_zero(a.c1.c0)
+        && fp2_is_zero(a.c1.c1) && fp2_is_zero(a.c1.c2);
+}
+
+// Frobenius: conj each Fp2 coefficient, multiply the w^i coefficient by
+// gamma1[i] (w-power basis order: c0.c0=w^0, c1.c0=w^1, c0.c1=w^2,
+// c1.c1=w^3, c0.c2=w^4, c1.c2=w^5)
+static Fp2 G1C[6], G2C[6];
+
+static void fp12_frob1(Fp12& r, const Fp12& a) {
+    Fp2 t;
+    fp2_conj(r.c0.c0, a.c0.c0);
+    fp2_conj(t, a.c1.c0); fp2_mul(r.c1.c0, t, G1C[1]);
+    fp2_conj(t, a.c0.c1); fp2_mul(r.c0.c1, t, G1C[2]);
+    fp2_conj(t, a.c1.c1); fp2_mul(r.c1.c1, t, G1C[3]);
+    fp2_conj(t, a.c0.c2); fp2_mul(r.c0.c2, t, G1C[4]);
+    fp2_conj(t, a.c1.c2); fp2_mul(r.c1.c2, t, G1C[5]);
+}
+
+static void fp12_frob2(Fp12& r, const Fp12& a) {
+    // gamma2 coefficients are real: plain Fp2-by-Fp scalar multiplies
+    r.c0.c0 = a.c0.c0;
+    fp2_mul_fp(r.c1.c0, a.c1.c0, G2C[1].c0);
+    fp2_mul_fp(r.c0.c1, a.c0.c1, G2C[2].c0);
+    fp2_mul_fp(r.c1.c1, a.c1.c1, G2C[3].c0);
+    fp2_mul_fp(r.c0.c2, a.c0.c2, G2C[4].c0);
+    fp2_mul_fp(r.c1.c2, a.c1.c2, G2C[5].c0);
+}
+
+// m^x for the curve parameter x (negative): conj(m^|x|); cyclotomic
+// subgroup makes conj the inverse
+static void fp12_pow_x(Fp12& r, const Fp12& m) {
+    Fp12 result = m;                      // consume the msb implicitly
+    for (int i = 62; i >= 0; i--) {
+        fp12_sqr(result, result);
+        if ((X_ABS >> i) & 1) fp12_mul(result, result, m);
+    }
+    fp12_conj(r, result);
+}
+
+// ---------------- Miller loop (affine, twist coordinates) ----------------
+// Lines are scaled by powers of w (killed by the final exponentiation):
+//   regular: (lam*x1 - y1) + (-lam*xP)*w^2 + yP*w^3
+//   vertical: (-x1) + xP*w^2
+// w-basis placement: w^0 -> c0.c0, w^2 -> c0.c1, w^3 -> c1.c1.
+
+struct G1A { Fp x, y; bool inf; };
+struct G2A { Fp2 x, y; bool inf; };
+
+static void line_eval(Fp12& l, const Fp2& lam, const Fp2& x1, const Fp2& y1,
+                      const Fp& xp, const Fp& yp) {
+    memset(&l, 0, sizeof(l));
+    Fp2 t;
+    fp2_mul(t, lam, x1);
+    fp2_sub(l.c0.c0, t, y1);
+    fp2_mul_fp(t, lam, xp);
+    fp2_neg(l.c0.c1, t);
+    l.c1.c1.c0 = yp;
+    l.c1.c1.c1 = FP_ZERO_C;
+}
+
+static void line_vertical(Fp12& l, const Fp2& x1, const Fp& xp) {
+    memset(&l, 0, sizeof(l));
+    fp2_neg(l.c0.c0, x1);
+    l.c0.c1.c0 = xp;
+    l.c0.c1.c1 = FP_ZERO_C;
+}
+
+// Montgomery batch inversion over Fp2: ONE field inversion for n
+// denominators (the classic prefix-product trick) — inversions dominate
+// an affine Miller loop, and the lockstep multi-pair loop below shares
+// one per step across all pairs.
+static void fp2_batch_inv(Fp2* vals, int n) {
+    if (n == 0) return;
+    Fp2 prefix[17];
+    prefix[0] = FP2_ONE_C;
+    for (int i = 0; i < n; i++) fp2_mul(prefix[i + 1], prefix[i], vals[i]);
+    Fp2 inv_all;
+    fp2_inv(inv_all, prefix[n]);
+    for (int i = n - 1; i >= 0; i--) {
+        Fp2 vi;
+        fp2_mul(vi, inv_all, prefix[i]);
+        fp2_mul(inv_all, inv_all, vals[i]);
+        vals[i] = vi;
+    }
+}
+
+// Lockstep multi-Miller: computes f = prod_i f_{|x|,Q_i}(P_i) directly
+// (what pairing_check needs), batching each step's denominators into a
+// single inversion. At most 16 pairs per call (callers chunk).
+// Returns false on degenerate inputs (zero denominator / T==Q collision
+// reachable only with non-subgroup points) — callers must REJECT: a
+// malformed point must never produce an arbitrary verdict.
+static const int MAX_PAIRS = 16;
+
+static bool multi_miller(Fp12& f, const G2A* qs, const G1A* ps, int n) {
+    Fp2 tx[MAX_PAIRS], ty[MAX_PAIRS];
+    bool live[MAX_PAIRS], t_inf[MAX_PAIRS];
+    for (int k = 0; k < n; k++) {
+        live[k] = !(qs[k].inf || ps[k].inf);
+        t_inf[k] = false;
+        if (live[k]) { tx[k] = qs[k].x; ty[k] = qs[k].y; }
+    }
+    memset(&f, 0, sizeof(f));
+    f.c0.c0 = FP2_ONE_C;
+    Fp12 l;
+    Fp2 lam, t0, t1;
+    Fp2 dens[MAX_PAIRS];
+    int idx[MAX_PAIRS];
+    for (int i = 62; i >= 0; i--) {       // |x| has 64 bits; start msb-1
+        fp12_sqr(f, f);
+        // tangent step, all pairs: denominators 2*y_T
+        int m = 0;
+        for (int k = 0; k < n; k++) {
+            if (!live[k] || t_inf[k]) continue;
+            fp2_add(dens[m], ty[k], ty[k]);
+            if (fp2_is_zero(dens[m])) return false;   // order-2 point
+            idx[m++] = k;
+        }
+        fp2_batch_inv(dens, m);
+        for (int j = 0; j < m; j++) {
+            int k = idx[j];
+            fp2_sqr(t0, tx[k]);
+            fp2_add(t1, t0, t0);
+            fp2_add(t1, t1, t0);              // 3 x^2
+            fp2_mul(lam, t1, dens[j]);
+            line_eval(l, lam, tx[k], ty[k], ps[k].x, ps[k].y);
+            fp12_mul(f, f, l);
+            Fp2 x3, y3;
+            fp2_sqr(x3, lam);
+            fp2_sub(x3, x3, tx[k]);
+            fp2_sub(x3, x3, tx[k]);
+            fp2_sub(t0, tx[k], x3);
+            fp2_mul(y3, lam, t0);
+            fp2_sub(y3, y3, ty[k]);
+            tx[k] = x3;
+            ty[k] = y3;
+        }
+        if (!((X_ABS >> i) & 1)) continue;
+        // addition step: denominators x_Q - x_T (verticals handled
+        // inline; T==Q — unreachable for r-subgroup inputs inside the
+        // ate loop — would zero the denominator, so REJECT)
+        m = 0;
+        for (int k = 0; k < n; k++) {
+            if (!live[k] || t_inf[k]) continue;
+            if (fp2_eq(tx[k], qs[k].x)) {
+                Fp2 sum_y;
+                fp2_add(sum_y, ty[k], qs[k].y);
+                if (fp2_is_zero(sum_y)) {
+                    line_vertical(l, tx[k], ps[k].x);
+                    fp12_mul(f, f, l);
+                    t_inf[k] = true;
+                    continue;
+                }
+                return false;                  // T == Q: non-subgroup input
+            }
+            fp2_sub(dens[m], qs[k].x, tx[k]);
+            idx[m++] = k;
+        }
+        fp2_batch_inv(dens, m);
+        for (int j = 0; j < m; j++) {
+            int k = idx[j];
+            fp2_sub(t0, qs[k].y, ty[k]);
+            fp2_mul(lam, t0, dens[j]);
+            line_eval(l, lam, tx[k], ty[k], ps[k].x, ps[k].y);
+            fp12_mul(f, f, l);
+            Fp2 x3, y3;
+            fp2_sqr(x3, lam);
+            fp2_sub(x3, x3, tx[k]);
+            fp2_sub(x3, x3, qs[k].x);
+            fp2_sub(t0, tx[k], x3);
+            fp2_mul(y3, lam, t0);
+            fp2_sub(y3, y3, ty[k]);
+            tx[k] = x3;
+            ty[k] = y3;
+        }
+    }
+    Fp12 fc;
+    fp12_conj(fc, f);                     // x < 0
+    f = fc;
+    return true;
+}
+
+// ---------------- final exponentiation ----------------
+
+static void final_exp(Fp12& r, const Fp12& f) {
+    // easy part: f^((p^6-1)(p^2+1))
+    Fp12 t0, t1, m;
+    fp12_conj(t0, f);
+    fp12_inv(t1, f);
+    fp12_mul(m, t0, t1);                  // f^(p^6-1)
+    fp12_frob2(t0, m);
+    fp12_mul(m, t0, m);                   // ^(p^2+1); now cyclotomic
+    // hard part (exponent 3*(p^4-p^2+1)/r, verified identity):
+    //   m^((x-1)^2 * (x+p) * (x^2+p^2-1)) * m^3
+    Fp12 a, b;
+    fp12_pow_x(t0, m);
+    fp12_conj(t1, m);
+    fp12_mul(a, t0, t1);                  // m^(x-1)
+    fp12_pow_x(t0, a);
+    fp12_conj(t1, a);
+    fp12_mul(a, t0, t1);                  // m^((x-1)^2)
+    fp12_pow_x(t0, a);
+    fp12_frob1(t1, a);
+    fp12_mul(b, t0, t1);                  // a^(x+p)
+    fp12_pow_x(t0, b);
+    fp12_pow_x(t0, t0);                   // b^(x^2)
+    fp12_frob2(t1, b);
+    fp12_mul(t0, t0, t1);                 // * b^(p^2)
+    fp12_conj(t1, b);
+    fp12_mul(b, t0, t1);                  // b^(x^2+p^2-1)
+    Fp12 m3;
+    fp12_sqr(m3, m);
+    fp12_mul(m3, m3, m);
+    fp12_mul(r, b, m3);
+}
+
+// ---------------- jacobian group ops (for mul / msm) ----------------
+// Generic over the coordinate field via macros would be noise; G1 and G2
+// versions are written out (same dbl-1998-cmo / add-2007-bl shapes).
+
+struct G1J { Fp x, y, z; bool inf; };
+struct G2J { Fp2 x, y, z; bool inf; };
+
+static void g1j_dbl(G1J& r, const G1J& in) {
+    const G1J a = in;                  // r may alias in
+    if (a.inf || fp_is_zero(a.y)) { r.inf = true; return; }
+    Fp xx, yy, yyyy, zz, s, mm, t;
+    fp_sqr(xx, a.x);
+    fp_sqr(yy, a.y);
+    fp_sqr(yyyy, yy);
+    fp_sqr(zz, a.z);
+    fp_add(s, a.x, yy);
+    fp_sqr(s, s);
+    fp_sub(s, s, xx);
+    fp_sub(s, s, yyyy);
+    fp_add(s, s, s);
+    fp_add(mm, xx, xx);
+    fp_add(mm, mm, xx);
+    fp_sqr(t, mm);
+    fp_sub(t, t, s);
+    fp_sub(r.x, t, s);
+    fp_sub(t, s, r.x);
+    fp_mul(t, mm, t);
+    Fp y8;
+    fp_add(y8, yyyy, yyyy);
+    fp_add(y8, y8, y8);
+    fp_add(y8, y8, y8);
+    fp_sub(r.y, t, y8);
+    fp_mul(t, a.y, a.z);
+    fp_add(r.z, t, t);
+    r.inf = false;
+}
+
+static void g1j_add_affine(G1J& r, const G1J& in, const G1A& b) {
+    const G1J a = in;                  // r may alias in
+    if (b.inf) { r = a; return; }
+    if (a.inf) {
+        r.x = b.x; r.y = b.y;
+        memcpy(r.z.l, ONE_M, 48);
+        r.inf = false;
+        return;
+    }
+    Fp z2, u2, s2, h, hh, i, j, rr, v, t;
+    fp_sqr(z2, a.z);
+    fp_mul(u2, b.x, z2);
+    fp_mul(s2, b.y, z2);
+    fp_mul(s2, s2, a.z);
+    fp_sub(h, u2, a.x);
+    fp_sub(rr, s2, a.y);
+    if (fp_is_zero(h)) {
+        if (fp_is_zero(rr)) { g1j_dbl(r, a); return; }
+        r.inf = true;
+        return;
+    }
+    fp_sqr(hh, h);
+    fp_add(i, hh, hh);
+    fp_add(i, i, i);
+    fp_mul(j, h, i);
+    fp_add(rr, rr, rr);
+    fp_mul(v, a.x, i);
+    fp_sqr(t, rr);
+    fp_sub(t, t, j);
+    fp_sub(t, t, v);
+    fp_sub(r.x, t, v);
+    fp_sub(t, v, r.x);
+    fp_mul(t, rr, t);
+    Fp t2;
+    fp_mul(t2, a.y, j);
+    fp_add(t2, t2, t2);
+    fp_sub(r.y, t, t2);
+    fp_mul(r.z, a.z, h);
+    fp_add(r.z, r.z, r.z);
+    r.inf = false;
+}
+
+static void g1j_to_affine(G1A& r, const G1J& a) {
+    if (a.inf) { r.inf = true; return; }
+    Fp zi, zi2, zi3;
+    fp_inv(zi, a.z);
+    fp_sqr(zi2, zi);
+    fp_mul(zi3, zi2, zi);
+    fp_mul(r.x, a.x, zi2);
+    fp_mul(r.y, a.y, zi3);
+    r.inf = false;
+}
+
+static void g2j_dbl(G2J& r, const G2J& in) {
+    const G2J a = in;                  // r may alias in
+    if (a.inf || fp2_is_zero(a.y)) { r.inf = true; return; }
+    Fp2 xx, yy, yyyy, s, mm, t;
+    fp2_sqr(xx, a.x);
+    fp2_sqr(yy, a.y);
+    fp2_sqr(yyyy, yy);
+    fp2_add(s, a.x, yy);
+    fp2_sqr(s, s);
+    fp2_sub(s, s, xx);
+    fp2_sub(s, s, yyyy);
+    fp2_add(s, s, s);
+    fp2_add(mm, xx, xx);
+    fp2_add(mm, mm, xx);
+    fp2_sqr(t, mm);
+    fp2_sub(t, t, s);
+    fp2_sub(r.x, t, s);
+    fp2_sub(t, s, r.x);
+    fp2_mul(t, mm, t);
+    Fp2 y8;
+    fp2_add(y8, yyyy, yyyy);
+    fp2_add(y8, y8, y8);
+    fp2_add(y8, y8, y8);
+    fp2_sub(r.y, t, y8);
+    fp2_mul(t, a.y, a.z);
+    fp2_add(r.z, t, t);
+    r.inf = false;
+}
+
+static void g2j_add_affine(G2J& r, const G2J& in, const G2A& b) {
+    const G2J a = in;                  // r may alias in
+    if (b.inf) { r = a; return; }
+    if (a.inf) {
+        r.x = b.x; r.y = b.y;
+        memcpy(r.z.c0.l, ONE_M, 48);
+        r.z.c1 = FP_ZERO_C;
+        r.inf = false;
+        return;
+    }
+    Fp2 z2, u2, s2, h, hh, i, j, rr, v, t;
+    fp2_sqr(z2, a.z);
+    fp2_mul(u2, b.x, z2);
+    fp2_mul(s2, b.y, z2);
+    fp2_mul(s2, s2, a.z);
+    fp2_sub(h, u2, a.x);
+    fp2_sub(rr, s2, a.y);
+    if (fp2_is_zero(h)) {
+        if (fp2_is_zero(rr)) { g2j_dbl(r, a); return; }
+        r.inf = true;
+        return;
+    }
+    fp2_sqr(hh, h);
+    fp2_add(i, hh, hh);
+    fp2_add(i, i, i);
+    fp2_mul(j, h, i);
+    fp2_add(rr, rr, rr);
+    fp2_mul(v, a.x, i);
+    fp2_sqr(t, rr);
+    fp2_sub(t, t, j);
+    fp2_sub(t, t, v);
+    fp2_sub(r.x, t, v);
+    fp2_sub(t, v, r.x);
+    fp2_mul(t, rr, t);
+    Fp2 t2;
+    fp2_mul(t2, a.y, j);
+    fp2_add(t2, t2, t2);
+    fp2_sub(r.y, t, t2);
+    fp2_mul(r.z, a.z, h);
+    fp2_add(r.z, r.z, r.z);
+    r.inf = false;
+}
+
+static void g2j_to_affine(G2A& r, const G2J& a) {
+    if (a.inf) { r.inf = true; return; }
+    Fp2 zi, zi2, zi3;
+    fp2_inv(zi, a.z);
+    fp2_sqr(zi2, zi);
+    fp2_mul(zi3, zi2, zi);
+    fp2_mul(r.x, a.x, zi2);
+    fp2_mul(r.y, a.y, zi3);
+    r.inf = false;
+}
+
+// ---------------- init ----------------
+
+static bool g_ready = false;
+
+static void ensure_init() {
+    if (g_ready) return;
+    memset(&FP_ZERO_C, 0, sizeof(FP_ZERO_C));
+    memcpy(FP_ONE_C.l, ONE_M, 48);
+    FP2_ZERO_C.c0 = FP_ZERO_C; FP2_ZERO_C.c1 = FP_ZERO_C;
+    FP2_ONE_C.c0 = FP_ONE_C; FP2_ONE_C.c1 = FP_ZERO_C;
+    memset(&FP6_ZERO_C, 0, sizeof(FP6_ZERO_C));
+    FP6_ONE_C = FP6_ZERO_C;
+    FP6_ONE_C.c0 = FP2_ONE_C;
+    const u64* g1p[6][2] = {{nullptr, nullptr},
+                            {G1C1_0, G1C1_1}, {G1C2_0, G1C2_1},
+                            {G1C3_0, G1C3_1}, {G1C4_0, G1C4_1},
+                            {G1C5_0, G1C5_1}};
+    const u64* g2p[6] = {nullptr, G2C1_0, G2C2_0, G2C3_0, G2C4_0, G2C5_0};
+    for (int i = 1; i < 6; i++) {
+        memcpy(G1C[i].c0.l, g1p[i][0], 48);
+        memcpy(G1C[i].c1.l, g1p[i][1], 48);
+        memcpy(G2C[i].c0.l, g2p[i], 48);
+        G2C[i].c1 = FP_ZERO_C;
+    }
+    g_ready = true;
+}
+
+// ---------------- byte-boundary helpers ----------------
+
+static bool load_g1(G1A& p, const uint8_t* xy96, int inf) {
+    p.inf = inf != 0;
+    if (p.inf) return true;
+    fp_from_be(p.x, xy96);
+    fp_from_be(p.y, xy96 + 48);
+    return true;
+}
+
+static bool load_g2(G2A& q, const uint8_t* c192, int inf) {
+    q.inf = inf != 0;
+    if (q.inf) return true;
+    fp_from_be(q.x.c0, c192);
+    fp_from_be(q.x.c1, c192 + 48);
+    fp_from_be(q.y.c0, c192 + 96);
+    fp_from_be(q.y.c1, c192 + 144);
+    return true;
+}
+
+extern "C" {
+
+// prod_i e(P_i, Q_i) == 1 ?  (multi-pairing: miller loops multiplied,
+// ONE final exponentiation — the multi-pair structure VERDICT asks for)
+int bls381_pairing_check(const uint8_t* g1s, const uint8_t* g2s,
+                         const uint8_t* infs, int n) {
+    ensure_init();
+    Fp12 f, chunk_f;
+    memset(&f, 0, sizeof(f));
+    f.c0.c0 = FP2_ONE_C;
+    G1A ps[MAX_PAIRS];
+    G2A qs[MAX_PAIRS];
+    for (int base = 0; base < n; base += MAX_PAIRS) {
+        int m = n - base < MAX_PAIRS ? n - base : MAX_PAIRS;
+        for (int i = 0; i < m; i++) {
+            load_g1(ps[i], g1s + (size_t)(base + i) * 96,
+                    infs[base + i] & 1);
+            load_g2(qs[i], g2s + (size_t)(base + i) * 192,
+                    infs[base + i] & 2);
+        }
+        if (!multi_miller(chunk_f, qs, ps, m)) return 0;  // reject
+        fp12_mul(f, f, chunk_f);
+    }
+    if (n == 0) { return 1; }
+    final_exp(f, f);
+    return fp12_is_one(f) ? 1 : 0;
+}
+
+// out = sum_i [k_i] P_i over G1 (affine in/out, 96B points, 32B BE
+// scalars); returns 1, out_inf set if the sum is infinity.
+// Interleaved (Straus) chain: ONE shared 256-doubling run, a mixed add
+// per set bit, and a single Jacobian->affine inversion at the end —
+// the fastMultExp role (reference FastMultExp.cpp:27).
+int bls381_g1_msm(uint8_t* out96, uint8_t* out_inf, const uint8_t* pts,
+                  const uint8_t* infs, const uint8_t* ks, int n) {
+    ensure_init();
+    G1J acc;
+    acc.inf = true;
+    G1A* aff = new G1A[n > 0 ? n : 1];
+    for (int i = 0; i < n; i++) {
+        load_g1(aff[i], pts + (size_t)i * 96, infs[i]);
+    }
+    for (int bit = 255; bit >= 0; bit--) {
+        if (!acc.inf) g1j_dbl(acc, acc);
+        int byte = 31 - bit / 8;
+        int sh = bit % 8;
+        for (int i = 0; i < n; i++) {
+            if (aff[i].inf) continue;
+            if ((ks[(size_t)i * 32 + byte] >> sh) & 1) {
+                g1j_add_affine(acc, acc, aff[i]);
+            }
+        }
+    }
+    delete[] aff;
+    G1A r;
+    g1j_to_affine(r, acc);
+    *out_inf = r.inf ? 1 : 0;
+    if (!r.inf) {
+        fp_to_be(out96, r.x);
+        fp_to_be(out96 + 48, r.y);
+    }
+    return 1;
+}
+
+int bls381_g2_msm(uint8_t* out192, uint8_t* out_inf, const uint8_t* pts,
+                  const uint8_t* infs, const uint8_t* ks, int n) {
+    ensure_init();
+    G2J acc;
+    acc.inf = true;
+    G2A* aff = new G2A[n > 0 ? n : 1];
+    for (int i = 0; i < n; i++) {
+        load_g2(aff[i], pts + (size_t)i * 192, infs[i]);
+    }
+    for (int bit = 255; bit >= 0; bit--) {
+        if (!acc.inf) g2j_dbl(acc, acc);
+        int byte = 31 - bit / 8;
+        int sh = bit % 8;
+        for (int i = 0; i < n; i++) {
+            if (aff[i].inf) continue;
+            if ((ks[(size_t)i * 32 + byte] >> sh) & 1) {
+                g2j_add_affine(acc, acc, aff[i]);
+            }
+        }
+    }
+    delete[] aff;
+    G2A r;
+    g2j_to_affine(r, acc);
+    *out_inf = r.inf ? 1 : 0;
+    if (!r.inf) {
+        fp_to_be(out192, r.x.c0);
+        fp_to_be(out192 + 48, r.x.c1);
+        fp_to_be(out192 + 96, r.y.c0);
+        fp_to_be(out192 + 144, r.y.c1);
+    }
+    return 1;
+}
+
+}  // extern "C"

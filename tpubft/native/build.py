@@ -20,22 +20,35 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-fno-plt"]
+
+
 def load(name: str) -> ctypes.CDLL:
     with _lock:
         if name in _cache:
             return _cache[name]
         src = os.path.join(_HERE, f"{name}.cpp")
         so = os.path.join(_HERE, f"_{name}.so")
+        stamp = so + ".flags"
+        # staleness = newer source OR different compile flags (a flags
+        # bump must invalidate cached objects, including prebuilts)
+        want = " ".join(_FLAGS)
+        have = ""
+        if os.path.exists(stamp):
+            with open(stamp) as f:
+                have = f.read().strip()
         if (not os.path.exists(so)
-                or os.path.getmtime(so) < os.path.getmtime(src)):
+                or os.path.getmtime(so) < os.path.getmtime(src)
+                or have != want):
             tmp = so + ".build"
-            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-o", tmp, src]
+            cmd = ["g++", *_FLAGS, "-o", tmp, src]
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise NativeBuildError(
                     f"g++ failed for {name}:\n{proc.stderr[-4000:]}")
             os.replace(tmp, so)
+            with open(stamp, "w") as f:
+                f.write(want)
         lib = ctypes.CDLL(so)
         _cache[name] = lib
         return lib
