@@ -100,8 +100,7 @@ def test_checkpoint_ack_status_roundtrip():
 def test_view_change_new_view_roundtrip():
     cert = m.PreparedCertificate(seq_num=4, view=0, kind=0,
                                  pp_digest=b"\x33" * 32,
-                                 combined_sig=b"combined",
-                                 pre_prepare=b"packed-pp")
+                                 combined_sig=b"combined")
     vc = m.ViewChangeMsg(sender_id=2, new_view=1, last_stable_seq=0,
                          prepared=[cert], signature=b"sig")
     out = rt(vc)

@@ -133,8 +133,12 @@ class KeyExchangeManager:
 
     def initiate(self) -> int:
         """Generate a candidate key and submit the exchange op
-        (sendInitialKey / sendKeyExchange)."""
-        signer = Ed25519Signer.generate(seed=os.urandom(32))
+        (sendInitialKey / sendKeyExchange). The rotated-in key keeps the
+        cluster's replica signature scheme — verifiers derive theirs from
+        it per principal."""
+        from tpubft.crypto.cpu import make_signer
+        signer = make_signer(self._replica.keys.replica_sig_scheme,
+                             seed=os.urandom(32))
         self._generation += 1
         self._candidates[self._generation] = signer
         op = KeyExchangeOp(replica_id=self._replica.id,

@@ -16,9 +16,10 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from tpubft.crypto.cpu import Ed25519Signer, Ed25519Verifier
-from tpubft.crypto.interfaces import (Cryptosystem, IThresholdSigner,
-                                      IThresholdVerifier)
+from tpubft.crypto.cpu import make_signer, make_verifier
+from tpubft.crypto.interfaces import (Cryptosystem, ISigner,
+                                      IThresholdSigner, IThresholdVerifier,
+                                      IVerifier)
 from tpubft.utils.config import ReplicaConfig
 
 
@@ -37,6 +38,13 @@ class ClusterKeys:
     f: int
     c: int
     threshold_scheme: str
+    # per-principal-class signature schemes (reference SigManager builds a
+    # scheme-specific verifier per principal from the keyfile — RSA/EdDSA
+    # for replicas, optionally ECDSA-secp256k1 for clients, the BASELINE
+    # config-3/5 mix): replicas sign consensus msgs with one scheme,
+    # external clients (and the operator) may use another
+    replica_sig_scheme: str = "ed25519"
+    client_sig_scheme: str = "ed25519"
     # per-message signing (SigManager principals)
     replica_pubkeys: Dict[int, bytes] = field(default_factory=dict)
     client_pubkeys: Dict[int, bytes] = field(default_factory=dict)
@@ -56,13 +64,17 @@ class ClusterKeys:
         """Generate the full cluster's material (test/keygen-tool path —
         the reference's GenerateConcordKeys writes one file per replica)."""
         n, f, c = cfg.n_val, cfg.f_val, cfg.c_val
-        ck = cls(n=n, f=f, c=c, threshold_scheme=cfg.threshold_scheme)
+        ck = cls(n=n, f=f, c=c, threshold_scheme=cfg.threshold_scheme,
+                 replica_sig_scheme=cfg.replica_sig_scheme,
+                 client_sig_scheme=cfg.client_sig_scheme)
         for r in range(n):
-            s = Ed25519Signer.generate(seed=_derive_seed(seed, "replica", r))
+            s = make_signer(ck.replica_sig_scheme,
+                            seed=_derive_seed(seed, "replica", r))
             ck.replica_pubkeys[r] = s.public_bytes()
         first_client = n + cfg.num_ro_replicas
         for cl in range(first_client, first_client + num_clients):
-            s = Ed25519Signer.generate(seed=_derive_seed(seed, "client", cl))
+            s = make_signer(ck.client_sig_scheme,
+                            seed=_derive_seed(seed, "client", cl))
             ck.client_pubkeys[cl] = s.public_bytes()
         # operator principal (reconfiguration commands): its id must match
         # ReplicasInfo.operator_id, which derives from the CONFIG's client
@@ -70,8 +82,8 @@ class ClusterKeys:
         # generate extra client keys). Distinct seed label so no client
         # enumeration can ever mint the operator's keypair.
         operator_id = first_client + cfg.num_of_client_proxies + n
-        s = Ed25519Signer.generate(seed=_derive_seed(seed, "operator",
-                                                     operator_id))
+        s = make_signer(ck.client_sig_scheme,
+                        seed=_derive_seed(seed, "operator", operator_id))
         ck.client_pubkeys[operator_id] = s.public_bytes()
         ck.operator_id = operator_id
         scheme = cfg.threshold_scheme
@@ -95,6 +107,8 @@ class ClusterKeys:
         me = ClusterKeys(
             n=self.n, f=self.f, c=self.c,
             threshold_scheme=self.threshold_scheme,
+            replica_sig_scheme=self.replica_sig_scheme,
+            client_sig_scheme=self.client_sig_scheme,
             replica_pubkeys=self.replica_pubkeys,
             client_pubkeys=self.client_pubkeys,
             my_id=node_id, operator_id=self.operator_id,
@@ -106,15 +120,25 @@ class ClusterKeys:
         return me
 
     # ---- accessors ----
-    def my_signer(self) -> Ed25519Signer:
-        assert self.my_sign_seed is not None
-        return Ed25519Signer.generate(seed=self.my_sign_seed)
+    def scheme_of(self, node: int) -> str:
+        """Signature scheme for a principal: replicas (incl. read-only ids
+        below the first client) sign with the replica scheme, every client
+        principal (operator included) with the client scheme."""
+        return (self.replica_sig_scheme if node in self.replica_pubkeys
+                else self.client_sig_scheme)
 
-    def verifier_of(self, node: int) -> Ed25519Verifier:
+    def my_signer(self) -> ISigner:
+        assert self.my_sign_seed is not None
+        return make_signer(self.scheme_of(self.my_id)
+                           if self.my_id is not None
+                           else self.replica_sig_scheme,
+                           seed=self.my_sign_seed)
+
+    def verifier_of(self, node: int) -> IVerifier:
         pk = self.replica_pubkeys.get(node) or self.client_pubkeys.get(node)
         if pk is None:
             raise KeyError(f"no public key for node {node}")
-        return Ed25519Verifier(pk)
+        return make_verifier(self.scheme_of(node), pk)
 
     def threshold_signer(self, system: Cryptosystem,
                          replica_id: int) -> IThresholdSigner:

@@ -50,6 +50,7 @@ class MsgCode(enum.IntEnum):
     RestartProof = 20
     PreProcessRequest = 21
     PreProcessReply = 22
+    ReqViewPrePrepare = 23
 
 
 class RequestFlag(enum.IntFlag):
@@ -406,18 +407,20 @@ def preexec_digest(client_id: int, req_seq: int, original: bytes,
 @dataclass
 class PreparedCertificate:
     """Evidence inside ViewChangeMsg that a seqnum may have committed in an
-    earlier view (reference ViewChangeMsg element + PrepareFull proof)."""
+    earlier view (reference ViewChangeMsg element + PrepareFull proof).
+
+    Carries only the PrePrepare DIGEST, not the batch body — the reference
+    ships digests and fetches missing PrePrepares during view entry
+    (ReplicaImp.cpp:1078 addPotentiallyMissingPP); embedding bodies made a
+    ViewChangeMsg O(batch x window) bytes."""
     seq_num: int
     view: int                     # view in which it was prepared
     kind: int                     # which threshold system signed it
                                   # (view_change.CERT_* constants)
     pp_digest: bytes
     combined_sig: bytes           # PrepareFull/FullCommitProof combined sig
-    pre_prepare: bytes            # packed PrePrepareMsg (so the new primary
-                                  # can re-propose without refetching)
     SPEC = [("seq_num", "u64"), ("view", "u64"), ("kind", "u8"),
-            ("pp_digest", "bytes"), ("combined_sig", "bytes"),
-            ("pre_prepare", "bytes")]
+            ("pp_digest", "bytes"), ("combined_sig", "bytes")]
 
 
 @register
@@ -492,6 +495,26 @@ class ReqMissingDataMsg(ConsensusMsg):
                                   # 4=CommitFull, 8=FullCommitProof
     SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
             ("missing", "u32")]
+
+
+@register
+@dataclass
+class ReqViewPrePrepareMsg(ConsensusMsg):
+    """Fetch an old-view PrePrepare body referenced (by digest) from a
+    view-change restriction (reference addPotentiallyMissingPP,
+    ReplicaImp.cpp:1078): ViewChangeMsgs carry digests only, so a replica
+    entering `new_view` must obtain any batch body it lacks before it can
+    re-propose or validate re-proposals. Unsigned like ReqMissingData —
+    a spoofed request costs a bounded resend. The response is the raw
+    packed original PrePrepareMsg; the requester authenticates it by
+    digest, which the threshold certificate already certifies."""
+    CODE = MsgCode.ReqViewPrePrepare
+    sender_id: int
+    new_view: int                 # view being entered (routing/context)
+    seq_num: int
+    pp_digest: bytes
+    SPEC = [("sender_id", "u32"), ("new_view", "u64"), ("seq_num", "u64"),
+            ("pp_digest", "bytes")]
 
 
 @register

@@ -43,6 +43,9 @@ class PersistedState:
     # packed view_change.Restriction / messages.PreparedCertificate blobs
     restrictions: List[bytes] = field(default_factory=list)
     carried_certs: List[bytes] = field(default_factory=list)
+    # packed PrePrepare bodies for the digests in carried_certs — certs
+    # travel digest-only, so the bodies that must survive a crash live here
+    carried_bodies: List[bytes] = field(default_factory=list)
 
     def seq(self, seq_num: int) -> PersistedSeqState:
         st = self.seq_states.get(seq_num)
@@ -137,6 +140,7 @@ class FilePersistentStorage(PersistentStorage):
             } for k, v in st.seq_states.items()},
             "restr": [b64(r) for r in st.restrictions],
             "certs": [b64(c) for c in st.carried_certs],
+            "bodies": [b64(c) for c in st.carried_bodies],
         }
 
     @staticmethod
@@ -150,7 +154,9 @@ class FilePersistentStorage(PersistentStorage):
                             restrictions=[unb64(r)
                                           for r in d.get("restr", [])],
                             carried_certs=[unb64(c)
-                                           for c in d.get("certs", [])])
+                                           for c in d.get("certs", [])],
+                            carried_bodies=[unb64(c)
+                                            for c in d.get("bodies", [])])
         for k, v in d.get("seqs", {}).items():
             st.seq_states[int(k)] = PersistedSeqState(
                 pre_prepare=unb64(v["pp"]), prepare_full=unb64(v["pf"]),

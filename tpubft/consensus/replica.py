@@ -122,7 +122,7 @@ class Replica(IReceiver):
         batch_fn = None
         if backend == "tpu":
             from tpubft.crypto import tpu as tpu_backend
-            batch_fn = tpu_backend.verify_batch_items
+            batch_fn = tpu_backend.verify_batch_mixed
         # singleton verifies stay on the CPU verifiers even with the TPU
         # backend (latency-critical, can't amortize a dispatch); batches
         # of >= device_min_verify_batch ride the device kernel
@@ -187,6 +187,17 @@ class Replica(IReceiver):
         for raw in st.carried_certs:
             cert = unpack_cert(raw)
             self.carried_certs[(cert.seq_num, cert.kind == CERT_SIGNED)] = cert
+        # pp_digest -> packed PrePrepare for every digest-only certificate
+        # we hold evidence for: certs travel without bodies (the VERDICT's
+        # O(batch x window) ViewChangeMsg fix), so bodies live here to
+        # resolve our own restrictions and answer peers' fetches
+        self.vc_bodies: Dict[bytes, bytes] = {}
+        for raw in st.carried_bodies:
+            pp = m.unpack(raw)
+            self.vc_bodies[pp.digest()] = raw
+        # (new_view, restrictions, missing pp_digest set) when view entry
+        # is blocked on fetching restricted batch bodies
+        self._pending_entry: Optional[tuple] = None
         self._my_vc_msg: Optional[m.ViewChangeMsg] = None
         # proof of the view we're in, kept for status-driven retransmission
         # to lagging peers (reference: RetransmissionsManager + status)
@@ -510,6 +521,10 @@ class Replica(IReceiver):
             if self.info.is_replica(sender):
                 self._on_req_missing_data(sender, msg)
             return
+        if isinstance(msg, m.ReqViewPrePrepareMsg):
+            if self.info.is_replica(sender):
+                self._on_req_view_pp(sender, msg)
+            return
         if isinstance(msg, m.ReplicaRestartReadyMsg):
             if self.info.is_replica(msg.sender_id):
                 self._on_restart_ready(msg)
@@ -531,6 +546,9 @@ class Replica(IReceiver):
             if self.preprocessor and self.info.is_replica(sender):
                 self.preprocessor.on_preprocess_reply(sender, msg)
             return
+        if isinstance(msg, m.PrePrepareMsg) and self._pending_entry \
+                and self._try_resolve_body(msg):
+            return                  # old-view body answering our fetch
         if self.in_view_change:
             return
         if isinstance(msg, m.PrePrepareMsg):
@@ -1556,6 +1574,24 @@ class Replica(IReceiver):
             del self.carried_certs[key]
         for s in [s for s in self.restrictions if s <= seq]:
             del self.restrictions[s]
+        # bodies are only needed while a cert references them
+        live = {c.pp_digest for c in self.carried_certs.values()}
+        for d in [d for d in self.vc_bodies if d not in live]:
+            del self.vc_bodies[d]
+        # a view entry parked on bodies for now-stable seqnums must not
+        # wedge: those batches already executed cluster-wide (and peers
+        # have pruned the bodies), so they need no re-proposal — drop them
+        # and enter if nothing else is missing
+        if self._pending_entry is not None:
+            new_view, restrictions, missing = self._pending_entry
+            stale = [s for s, r in restrictions.items()
+                     if s <= seq and not r.resolved]
+            for s in stale:
+                missing.discard(restrictions[s].pp_digest)
+                del restrictions[s]
+            if stale and not missing:
+                self._pending_entry = None
+                self._enter_view(new_view, restrictions)
         with self._tran() as st:
             st.last_stable_seq = seq
             for s in [s for s in st.seq_states if s <= seq]:
@@ -1564,6 +1600,7 @@ class Replica(IReceiver):
                                for r in self.restrictions.values()]
             st.carried_certs = [pack_cert(c)
                                 for c in self.carried_certs.values()]
+            st.carried_bodies = list(self.vc_bodies.values())
 
     # ------------------------------------------------------------------
     # view change (ReplicaImp.cpp:3771,544,2900,2978,3094 + ViewsManager)
@@ -1586,6 +1623,11 @@ class Replica(IReceiver):
         now = time.monotonic()
         timeout = self.cfg.view_change_timer_ms / 1e3
         if self.in_view_change:
+            if self._pending_entry is not None \
+                    and now - self._vc_started_at > timeout / 4:
+                # entry parked on missing bodies: re-fetch aggressively
+                # (the escalation below still fires if nothing arrives)
+                self._fetch_missing_bodies()
             if now - self._vc_started_at > timeout:
                 self._vc_started_at = now
                 # escalate AND retransmit: UDP may have dropped our
@@ -1654,6 +1696,8 @@ class Replica(IReceiver):
             return
         self.in_view_change = True
         self.pending_view = target
+        self._pending_entry = None      # a parked entry for a lower view
+                                        # is superseded by this change
         self._vc_started_at = time.monotonic()
         # harvest evidence: current window + evidence carried from earlier
         # views (a cert or signed report must survive cascading view
@@ -1670,14 +1714,19 @@ class Replica(IReceiver):
         with self._tran() as st:
             st.in_view_change = True
             st.carried_certs = [pack_cert(c) for c in certs]
+            st.carried_bodies = list(self.vc_bodies.values())
         self._broadcast(vc)
         self._try_complete_view_change(target)
 
     def _harvest_evidence(self) -> None:
         """Merge the window's current certs/reports into carried_certs
-        (keyed by (seq, is_signed_element); higher view wins)."""
-        for c in build_certificates(self.window.items(), self.last_stable,
-                                    lambda pp: pp.first_path):
+        (keyed by (seq, is_signed_element); higher view wins); retain the
+        batch bodies locally (certs are digest-only on the wire)."""
+        certs, bodies = build_certificates(self.window.items(),
+                                           self.last_stable,
+                                           lambda pp: pp.first_path)
+        self.vc_bodies.update(bodies)
+        for c in certs:
             key = (c.seq_num, c.kind == CERT_SIGNED)
             cur = self.carried_certs.get(key)
             if cur is None or c.view > cur.view:
@@ -1724,7 +1773,7 @@ class Replica(IReceiver):
                 quorum, share_digest, self._verifier_for_cert_kind,
                 self.info.f + self.info.c + 1)
             self._entered_view_proof = (nv, list(quorum))
-            self._enter_view(new_view, restrictions)
+            self._resolve_and_enter(new_view, restrictions)
         else:
             nv = self.vc.pending_new_view
             if nv is None or nv.new_view != new_view:
@@ -1736,7 +1785,83 @@ class Replica(IReceiver):
                 matched, share_digest, self._verifier_for_cert_kind,
                 self.info.f + self.info.c + 1)
             self._entered_view_proof = (nv, list(matched))
+            self._resolve_and_enter(new_view, restrictions)
+
+    # ------------------------------------------------------------------
+    # restricted-batch body resolution (reference addPotentiallyMissingPP,
+    # ReplicaImp.cpp:1078 — ViewChangeMsgs carry digests; bodies are
+    # fetched before the view activates)
+    # ------------------------------------------------------------------
+    def _resolve_and_enter(self, new_view: int,
+                           restrictions: Dict[int, Restriction]) -> None:
+        """Fill each restriction's batch body from local evidence; if any
+        is missing, park the entry and fetch (the view is entered when the
+        last body arrives — reference ViewsManager obtainMissingInfo)."""
+        # harvest first so our own window's PrePrepares can resolve
+        self._harvest_evidence()
+        missing = set()
+        for r in restrictions.values():
+            if r.resolved:
+                continue
+            body = self.vc_bodies.get(r.pp_digest)
+            if body is None or not r.resolve(body):
+                missing.add(r.pp_digest)
+        if not missing:
+            self._pending_entry = None
             self._enter_view(new_view, restrictions)
+            return
+        self._pending_entry = (new_view, restrictions, missing)
+        log.info("view %d entry blocked on %d missing batch bodies — "
+                 "fetching", new_view, len(missing))
+        self._fetch_missing_bodies()
+
+    def _fetch_missing_bodies(self) -> None:
+        if self._pending_entry is None:
+            return
+        new_view, restrictions, missing = self._pending_entry
+        by_digest = {r.pp_digest: r for r in restrictions.values()}
+        for d in missing:
+            r = by_digest[d]
+            req = m.ReqViewPrePrepareMsg(sender_id=self.id,
+                                         new_view=new_view,
+                                         seq_num=r.seq_num, pp_digest=d)
+            self._broadcast(req)
+
+    def _on_req_view_pp(self, sender: int,
+                        msg: m.ReqViewPrePrepareMsg) -> None:
+        """Serve a peer's restricted-body fetch from harvested evidence or
+        the live window. The response is the raw packed original
+        PrePrepare — authenticated at the requester by digest."""
+        body = self.vc_bodies.get(msg.pp_digest)
+        if body is None:
+            info = self.window.peek(msg.seq_num)
+            if info is not None and info.pre_prepare is not None \
+                    and info.pre_prepare.digest() == msg.pp_digest:
+                body = info.pre_prepare.pack()
+        if body is not None:
+            self.comm.send(sender, body)
+
+    def _try_resolve_body(self, pp: m.PrePrepareMsg) -> bool:
+        """A PrePrepare arriving while entry is parked: if it is a body we
+        are fetching, adopt it (digest check inside resolve) and enter the
+        view once complete. Returns True iff consumed."""
+        if self._pending_entry is None:
+            return False
+        new_view, restrictions, missing = self._pending_entry
+        d = pp.digest()
+        if d not in missing:
+            return False
+        r = next(x for x in restrictions.values() if x.pp_digest == d)
+        if not r.resolve(pp.pack()):
+            return False
+        self.vc_bodies[d] = r.pre_prepare
+        missing.discard(d)
+        log.info("resolved restricted batch body for seq %d "
+                 "(%d still missing)", r.seq_num, len(missing))
+        if not missing:
+            self._pending_entry = None
+            self._enter_view(new_view, restrictions)
+        return True
 
     def _on_new_view(self, msg: m.NewViewMsg) -> None:
         if msg.new_view <= self.view:
@@ -1755,12 +1880,14 @@ class Replica(IReceiver):
         re-proposal restrictions; the new primary re-proposes."""
         if new_view <= self.view:
             return
-        # harvest one last time: local certs may be stronger than what the
-        # VC quorum carried (e.g. we committed on the fast path)
-        self._harvest_evidence()
+        # evidence was harvested by _resolve_and_enter in this same view
+        # change (ordering msgs are frozen, so the window cannot have
+        # gained certs since) — carried_certs already holds the strongest
+        # local certs before the window wipe below
         self.view = new_view
         self.in_view_change = False
         self.pending_view = None
+        self._pending_entry = None
         self.restrictions = restrictions
         self.m_view.set(new_view)
         log.info("entered view %d (primary=%d, %d restricted seqnums)",
@@ -1793,6 +1920,7 @@ class Replica(IReceiver):
                                for r in restrictions.values()]
             st.carried_certs = [pack_cert(c)
                                 for c in self.carried_certs.values()]
+            st.carried_bodies = list(self.vc_bodies.values())
         if self.is_primary:
             self._repropose()
 

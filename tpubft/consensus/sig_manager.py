@@ -34,8 +34,9 @@ class SigManager:
                      List[bool]]] = None,
                  device_min_batch: int = 1):
         self._keys = keys
-        # cross-principal batch backend: [(pubkey, data, sig)] -> verdicts
-        # in ONE dispatch (the TPU path; None = per-principal loop)
+        # cross-principal batch backend: [(scheme, pubkey, data, sig)] ->
+        # verdicts in ONE dispatch per scheme (the TPU path; None =
+        # per-principal loop)
         self._batch_fn = batch_fn
         # batches smaller than this verify on the per-principal CPU
         # verifiers — a device dispatch only pays off once it amortizes
@@ -109,11 +110,18 @@ class SigManager:
         self._signer = signer
 
     # ---- verification ----
-    def _make_verifier(self, pk: bytes) -> IVerifier:
+    def _scheme_of(self, principal: int) -> str:
+        """Per-principal signature scheme (reference SigManager builds a
+        scheme-specific verifier per principal from the keyfile; BASELINE
+        configs 3/5 mix secp256k1 clients with EdDSA replicas)."""
+        scheme = getattr(self._keys, "scheme_of", None)
+        return scheme(principal) if scheme is not None else "ed25519"
+
+    def _make_verifier(self, pk: bytes, principal: int) -> IVerifier:
         if self._verifier_factory is not None:
             return self._verifier_factory(pk)
-        from tpubft.crypto.cpu import Ed25519Verifier
-        return Ed25519Verifier(pk)
+        from tpubft.crypto.cpu import make_verifier
+        return make_verifier(self._scheme_of(principal), pk)
 
     def _pubkey_of(self, principal: int) -> Optional[bytes]:
         return (self._replica_pubkeys.get(principal)
@@ -130,7 +138,8 @@ class SigManager:
                 pk = self._pubkey_of(principal)
                 if pk is None:
                     raise KeyError(f"no public key for principal {principal}")
-                v = self._verifiers[principal] = self._make_verifier(pk)
+                v = self._verifiers[principal] = self._make_verifier(
+                    pk, principal)
             return v
 
     def _grace_verifier(self, principal: int, seq: Optional[int],
@@ -161,7 +170,8 @@ class SigManager:
                 return None
             v = self._prev_verifiers.get(principal)
             if v is None:
-                v = self._prev_verifiers[principal] = self._make_verifier(pk)
+                v = self._prev_verifiers[principal] = self._make_verifier(
+                    pk, principal)
             return v
 
     def has_principal(self, principal: int) -> bool:
@@ -220,8 +230,9 @@ class SigManager:
 
     def _verify_batch_cross(self, items: Sequence[Tuple[int, bytes, bytes]],
                             seq: Optional[int]) -> List[bool]:
-        """Resolve principals to pubkeys, run the whole batch through the
-        backend in one call; failed items retry against grace keys."""
+        """Resolve principals to (scheme, pubkey), run the whole batch
+        through the backend in one call (one device dispatch per scheme
+        present); failed items retry against grace keys."""
         entries = []
         keyed = []
         with self._lock:
@@ -229,9 +240,10 @@ class SigManager:
             # key rotation into treating the rotated-away key as current
             resolved = [self._pubkey_of(self._alias(p))
                         for p, _, _ in items]
-        for i, ((_, data, sig), pk) in enumerate(zip(items, resolved)):
+        for i, ((p, data, sig), pk) in enumerate(zip(items, resolved)):
             if pk is not None:
-                entries.append((pk, data, sig))
+                entries.append((self._scheme_of(self._alias(p)), pk,
+                                data, sig))
                 keyed.append(i)
         verdicts = self._batch_fn(entries)
         # counts only what actually reached the device dispatch

@@ -50,13 +50,34 @@ _CERT_TAG = {CERT_PREPARE: "prepare", CERT_COMMIT: "commit",
 
 @dataclass
 class Restriction:
-    """What the new primary MUST re-propose for one seqnum."""
+    """What the new primary MUST re-propose for one seqnum.
+
+    Born digest-only from the view-change evidence (certificates carry no
+    batch bodies); `resolve` fills the body once the original PrePrepare
+    is found locally or fetched (ReqViewPrePrepareMsg). Only the certified
+    pp_digest is trusted: requests_digest/pre_prepare are derived from a
+    body that hashes to it, never from a peer's claim."""
     seq_num: int
     view: int                     # view of the strongest certificate
-    requests_digest: bytes        # batch identity that must be re-proposed
-    pre_prepare: bytes            # packed original PrePrepareMsg
-    SPEC = [("seq_num", "u64"), ("view", "u64"),
+    pp_digest: bytes              # certified digest of the original PP
+    requests_digest: bytes        # filled by resolve(); b"" = unresolved
+    pre_prepare: bytes            # packed original PP; b"" = unresolved
+    SPEC = [("seq_num", "u64"), ("view", "u64"), ("pp_digest", "bytes"),
             ("requests_digest", "bytes"), ("pre_prepare", "bytes")]
+
+    @property
+    def resolved(self) -> bool:
+        return bool(self.pre_prepare)
+
+    def resolve(self, packed_pp: bytes) -> bool:
+        """Adopt a candidate body iff it is structurally a PrePrepare for
+        this (seq, view) hashing to the certified digest."""
+        pp = _parse_pp(packed_pp, self.seq_num, self.view, self.pp_digest)
+        if pp is None:
+            return False
+        self.requests_digest = pp.requests_digest
+        self.pre_prepare = packed_pp
+        return True
 
 
 def pack_restriction(r: Restriction) -> bytes:
@@ -75,54 +96,59 @@ def unpack_cert(data: bytes) -> m.PreparedCertificate:
     return ser.decode_msg(data, m.PreparedCertificate)
 
 
-def build_certificates(window_items, last_stable: int,
-                       fast_path_of) -> List[m.PreparedCertificate]:
+def build_certificates(window_items, last_stable: int, fast_path_of
+                       ) -> Tuple[List[m.PreparedCertificate], Dict[bytes, bytes]]:
     """Collect evidence from the in-flight window (what the reference's
     ViewsManager harvests from SeqNumInfo before emitting a
     ViewChangeMsg): a threshold certificate where one exists, plus a
-    SIGNED element for every PrePrepare we signed shares over."""
+    SIGNED element for every PrePrepare we signed shares over.
+
+    Returns (certs, bodies): certs are digest-only (the wire form);
+    bodies maps pp_digest -> packed PrePrepare, retained LOCALLY so this
+    replica can resolve its own restrictions and serve peers' fetches."""
     certs: List[m.PreparedCertificate] = []
+    bodies: Dict[bytes, bytes] = {}
     for seq, info in window_items:
         if seq <= last_stable or info.pre_prepare is None:
             continue
         pp = info.pre_prepare
-        packed = pp.pack()
+        bodies[pp.digest()] = pp.pack()
         if info.full_commit_proof is not None:
             path = fast_path_of(pp)
             kind = CERT_FAST_OPT if path == int(m.CommitPath.OPTIMISTIC_FAST) \
                 else CERT_FAST_THR
             certs.append(m.PreparedCertificate(
                 seq_num=seq, view=pp.view, kind=kind, pp_digest=pp.digest(),
-                combined_sig=info.full_commit_proof.sig, pre_prepare=packed))
+                combined_sig=info.full_commit_proof.sig))
         elif info.commit_full is not None:
             certs.append(m.PreparedCertificate(
                 seq_num=seq, view=pp.view, kind=CERT_COMMIT,
-                pp_digest=pp.digest(),
-                combined_sig=info.commit_full.sig, pre_prepare=packed))
+                pp_digest=pp.digest(), combined_sig=info.commit_full.sig))
         elif info.prepare_full is not None:
             certs.append(m.PreparedCertificate(
                 seq_num=seq, view=pp.view, kind=CERT_PREPARE,
-                pp_digest=pp.digest(),
-                combined_sig=info.prepare_full.sig, pre_prepare=packed))
+                pp_digest=pp.digest(), combined_sig=info.prepare_full.sig))
         # always also report that we signed this PrePrepare — fast-path
         # commits are only provable by counting these reports
         certs.append(m.PreparedCertificate(
             seq_num=seq, view=pp.view, kind=CERT_SIGNED,
-            pp_digest=pp.digest(), combined_sig=b"", pre_prepare=packed))
-    return certs
+            pp_digest=pp.digest(), combined_sig=b""))
+    return certs, bodies
 
 
-def _check_embedded_pp(cert: m.PreparedCertificate) -> Optional[m.PrePrepareMsg]:
-    """Structural consistency of the PrePrepare embedded in a cert."""
+def _parse_pp(packed: bytes, seq_num: int, view: int,
+              pp_digest: bytes) -> Optional[m.PrePrepareMsg]:
+    """Structural consistency of a candidate PrePrepare body against the
+    certified (seq, view, digest) triple."""
     try:
-        pp = m.unpack(cert.pre_prepare)
+        pp = m.unpack(packed)
     except m.MsgError:
         return None
     if not isinstance(pp, m.PrePrepareMsg):
         return None
-    if pp.seq_num != cert.seq_num or pp.view != cert.view:
+    if pp.seq_num != seq_num or pp.view != view:
         return None
-    if pp.digest() != cert.pp_digest:
+    if pp.digest() != pp_digest:
         return None
     return pp
 
@@ -130,8 +156,9 @@ def _check_embedded_pp(cert: m.PreparedCertificate) -> Optional[m.PrePrepareMsg]
 def validate_certificate(cert: m.PreparedCertificate, share_digest_fn,
                          verifier_for_kind) -> Optional[Restriction]:
     """Check a threshold-backed PreparedCertificate; returns the
-    Restriction it proves, or None if bogus. SIGNED elements carry no
-    proof and are handled by the report rule in compute_restrictions.
+    (unresolved, digest-only) Restriction it proves, or None if bogus.
+    SIGNED elements carry no proof and are handled by the report rule in
+    compute_restrictions.
 
     `share_digest_fn(tag, view, seq, pp_digest)` must be the replica's
     share-digest derivation; `verifier_for_kind(kind)` returns the
@@ -140,9 +167,6 @@ def validate_certificate(cert: m.PreparedCertificate, share_digest_fn,
     tag = _CERT_TAG.get(cert.kind)
     if tag is None:
         return None
-    pp = _check_embedded_pp(cert)
-    if pp is None:
-        return None
     verifier = verifier_for_kind(cert.kind)
     if verifier is None:
         return None
@@ -150,8 +174,8 @@ def validate_certificate(cert: m.PreparedCertificate, share_digest_fn,
     if not verifier.verify(d, cert.combined_sig):
         return None
     return Restriction(seq_num=cert.seq_num, view=cert.view,
-                       requests_digest=pp.requests_digest,
-                       pre_prepare=cert.pre_prepare)
+                       pp_digest=cert.pp_digest,
+                       requests_digest=b"", pre_prepare=b"")
 
 
 def compute_restrictions(vc_msgs: List[m.ViewChangeMsg], share_digest_fn,
@@ -174,16 +198,13 @@ def compute_restrictions(vc_msgs: List[m.ViewChangeMsg], share_digest_fn,
     for vc in vc_msgs:
         for cert in vc.prepared:
             if cert.kind == CERT_SIGNED:
-                pp = _check_embedded_pp(cert)
-                if pp is None:
-                    continue
                 slot = reports.setdefault(cert.seq_num, {})
                 key = (cert.view, cert.pp_digest)
                 if key not in slot:
                     slot[key] = (set(), Restriction(
                         seq_num=cert.seq_num, view=cert.view,
-                        requests_digest=pp.requests_digest,
-                        pre_prepare=cert.pre_prepare))
+                        pp_digest=cert.pp_digest,
+                        requests_digest=b"", pre_prepare=b""))
                 slot[key][0].add(vc.sender_id)
                 continue
             r = validate_certificate(cert, share_digest_fn, verifier_for_kind)

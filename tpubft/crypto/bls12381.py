@@ -277,10 +277,12 @@ def g1_mul(pt, k: int):
 
 
 def g1_msm_py(points: Sequence, scalars: Sequence[int]):
-    """Pure-Python MSM (golden model)."""
+    """Pure-Python MSM (golden model). Must stay independent of the
+    native engine — it is the differential oracle the engine is tested
+    against, so it composes g1_mul_py, never the routed g1_mul."""
     acc = None
     for pt, k in zip(points, scalars):
-        acc = g1_add(acc, g1_mul(pt, k))
+        acc = g1_add(acc, g1_mul_py(pt, k))
     return acc
 
 
@@ -690,9 +692,11 @@ def combine_shares(ids: Sequence[int], shares_g1: Sequence) -> object:
 # ---------------- batch share verification (aggregation tree) ----------------
 
 def g2_msm_py(points: Sequence, scalars: Sequence[int]):
+    """Pure-Python golden model — composes g2_mul_py, never the routed
+    g2_mul (same independence rule as g1_msm_py)."""
     acc = None
     for pt, k in zip(points, scalars):
-        acc = g2_add(acc, g2_mul(pt, k))
+        acc = g2_add(acc, g2_mul_py(pt, k))
     return acc
 
 

@@ -39,12 +39,48 @@ from tpubft.crypto.systems import (BlsThresholdAccumulator,
 
 def verify_batch_items(items: Sequence[Tuple[bytes, bytes, bytes]]
                        ) -> List[bool]:
-    """One kernel call over (pubkey, data, sig) triples — principals may
-    all differ. The cross-principal entry point SigManager uses so a whole
-    PrePrepare's client signatures verify in a single device dispatch."""
+    """One kernel call over ed25519 (pubkey, data, sig) triples —
+    principals may all differ. Used by the multisig share paths (replica
+    shares are always the replica scheme)."""
     from tpubft.ops import ed25519 as ops
     return [bool(x) for x in
             ops.verify_batch([(d, s, pk) for pk, d, s in items])]
+
+
+def verify_batch_mixed(items: Sequence[Tuple[str, bytes, bytes, bytes]]
+                       ) -> List[bool]:
+    """SigManager's cross-principal batch entry: (scheme, pubkey, data,
+    sig) tuples, one device dispatch per scheme present. This is how the
+    secp256k1/P-256 client-auth mix of BASELINE configs 3/5 rides the
+    device: EdDSA through the windowed ed25519 kernel, ECDSA through the
+    Shamir-ladder kernel (tpubft/ops/ecdsa.py — the batched counterpart of
+    the reference's per-message ECDSAVerifier, crypto_utils.hpp:57-73)."""
+    groups = {}
+    for i, (scheme, pk, data, sig) in enumerate(items):
+        groups.setdefault(scheme, []).append(i)
+    out = [False] * len(items)
+    for scheme, idxs in groups.items():
+        sub = [items[i] for i in idxs]
+        if scheme == "ed25519":
+            verdicts = verify_batch_items([(pk, d, s)
+                                           for _, pk, d, s in sub])
+        elif scheme in ("ecdsa-secp256k1", "secp256k1",
+                        "ecdsa-secp256r1", "secp256r1", "ecdsa-p256"):
+            from tpubft.ops import ecdsa as ops_ecdsa
+            curve = ("secp256k1" if "k1" in scheme else "secp256r1")
+            verdicts = [bool(x) for x in ops_ecdsa.verify_batch(
+                curve, [(d, s, pk) for _, pk, d, s in sub])]
+        else:                       # unknown scheme: CPU fallback
+            from tpubft.crypto.cpu import make_verifier
+            verdicts = []
+            for _, pk, d, s in sub:
+                try:
+                    verdicts.append(make_verifier(scheme, pk).verify(d, s))
+                except Exception:
+                    verdicts.append(False)
+        for i, ok in zip(idxs, verdicts):
+            out[i] = ok
+    return out
 
 
 class TpuEd25519Verifier(IVerifier):

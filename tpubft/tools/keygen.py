@@ -33,6 +33,8 @@ def keyfile_dict(keys: ClusterKeys) -> dict:
     return {
         "n": keys.n, "f": keys.f, "c": keys.c,
         "threshold_scheme": keys.threshold_scheme,
+        "replica_sig_scheme": keys.replica_sig_scheme,
+        "client_sig_scheme": keys.client_sig_scheme,
         "my_id": keys.my_id,
         "my_sign_seed": _b64(keys.my_sign_seed),
         "operator_id": keys.operator_id,
@@ -92,6 +94,8 @@ def load_keyfile(path: str, password: Optional[str] = None) -> ClusterKeys:
     keys = ClusterKeys(
         n=d["n"], f=d["f"], c=d["c"],
         threshold_scheme=d["threshold_scheme"], my_id=d["my_id"],
+        replica_sig_scheme=d.get("replica_sig_scheme", "ed25519"),
+        client_sig_scheme=d.get("client_sig_scheme", "ed25519"),
         my_sign_seed=base64.b64decode(d["my_sign_seed"]),
         operator_id=d.get("operator_id"),
         replica_pubkeys={int(k): base64.b64decode(v)
@@ -106,17 +110,17 @@ def load_keyfile(path: str, password: Optional[str] = None) -> ClusterKeys:
 def verify(args) -> int:
     """TestGeneratedKeys role: the private seed must produce the public
     key the file claims for this principal."""
-    from tpubft.crypto.cpu import Ed25519Signer
     keys = load_keyfile(args.keyfile, args.password)
-    signer = Ed25519Signer.generate(seed=keys.my_sign_seed)
+    signer = keys.my_signer()
     expect = (keys.replica_pubkeys.get(keys.my_id)
               or keys.client_pubkeys.get(keys.my_id))
     if signer.public_bytes() != expect:
         print("MISMATCH: private seed does not produce the claimed pubkey")
         return 1
     payload = b"keygen-selftest"
-    from tpubft.crypto.cpu import Ed25519Verifier
-    if not Ed25519Verifier(expect).verify(payload, signer.sign(payload)):
+    from tpubft.crypto.cpu import make_verifier
+    if not make_verifier(keys.scheme_of(keys.my_id),
+                         expect).verify(payload, signer.sign(payload)):
         print("MISMATCH: sign/verify roundtrip failed")
         return 1
     print(f"keyfile OK (principal {keys.my_id}, n={keys.n}, f={keys.f})")
