@@ -1,0 +1,108 @@
+"""End-to-end simpleKVBC ordering throughput (BASELINE configs 1-2).
+
+The consensus-level number the reference never published: ops/sec a
+client sees against a live cluster (reference measurement path:
+tests/simpleKVBC TesterClient + Apollo's bft.py; kvbc add-block
+throughput harness kvbc/benchmark/kvbcbench/main.cpp).
+
+Configs (BASELINE.md):
+  1. n=4 (f=1), multisig-ed25519 commit certs   — config 1
+  2. n=7 (f=2), threshold-bls commit certs      — config 2
+Each runs with crypto_backend cpu and (if a device is reachable) tpu.
+
+Usage: python -m benchmarks.bench_e2e [--secs 10] [--clients 4]
+       [--configs 1,2] [--backends cpu,tpu]
+Prints one JSON line per (config, backend).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import threading
+import time
+from typing import List
+
+from tpubft.apps import skvbc
+from tpubft.kvbc import KeyValueBlockchain
+from tpubft.storage import MemoryDB
+from tpubft.testing.cluster import InProcessCluster
+
+CONFIGS = {
+    1: dict(f=1, threshold_scheme="multisig-ed25519"),
+    2: dict(f=2, threshold_scheme="threshold-bls"),
+}
+
+
+def _handler_factory(_r=None):
+    return skvbc.SkvbcHandler(KeyValueBlockchain(MemoryDB()))
+
+
+def run_config(config: int, backend: str, secs: float,
+               clients: int) -> dict:
+    cfg = CONFIGS[config]
+    overrides = {"threshold_scheme": cfg["threshold_scheme"],
+                 "crypto_backend": backend}
+    cluster = InProcessCluster(f=cfg["f"], num_clients=clients,
+                               handler_factory=_handler_factory,
+                               cfg_overrides=overrides)
+    counts = [0] * clients
+    lats: List[List[float]] = [[] for _ in range(clients)]
+    stop_at = [0.0]
+
+    def worker(idx: int) -> None:
+        kv = skvbc.SkvbcClient(cluster.client(idx))
+        i = 0
+        while time.monotonic() < stop_at[0]:
+            t0 = time.monotonic()
+            reply = kv.write([(b"bench-%d-%d" % (idx, i % 64),
+                               b"v%d" % i)])
+            dt = time.monotonic() - t0
+            if reply.success:
+                counts[idx] += 1
+                lats[idx].append(dt)
+            i += 1
+
+    with cluster:
+        # warmup: first write pays kernel compiles on the tpu backend
+        kv0 = skvbc.SkvbcClient(cluster.client(0))
+        assert kv0.write([(b"warmup", b"w")]).success, \
+            "cluster failed to order the warmup write"
+        stop_at[0] = time.monotonic() + secs
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+    total = sum(counts)
+    all_lats = sorted(x for ls in lats for x in ls)
+    return {
+        "config": config, "n": 3 * cfg["f"] + 1, "f": cfg["f"],
+        "threshold_scheme": cfg["threshold_scheme"], "backend": backend,
+        "clients": clients, "secs": round(wall, 2), "ops": total,
+        "ops_per_sec": round(total / wall, 1),
+        "mean_latency_ms": round(statistics.mean(all_lats) * 1e3, 2)
+        if all_lats else None,
+        "p90_latency_ms": round(all_lats[int(len(all_lats) * 0.9)] * 1e3, 2)
+        if all_lats else None,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--secs", type=float, default=10.0)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--configs", default="1,2")
+    ap.add_argument("--backends", default="cpu")
+    args = ap.parse_args()
+    for config in [int(x) for x in args.configs.split(",")]:
+        for backend in args.backends.split(","):
+            row = run_config(config, backend, args.secs, args.clients)
+            print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

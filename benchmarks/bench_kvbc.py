@@ -1,0 +1,98 @@
+"""kvbcbench — ledger write/read throughput per engine.
+
+Rebuild of the reference's kvbc benchmark harness
+(/root/reference/kvbc/benchmark/kvbcbench/main.cpp): block-add throughput
+with mixed category types, latest/versioned read rates, and the
+pre-execution conflict-detection cost (readset validation against the
+latest index), for both the categorized and v4 engines over both the
+memory and native log-structured DBs.
+
+Usage: python -m benchmarks.bench_kvbc [--blocks 2000] [--keys-per-block 8]
+Prints one JSON line per (engine, db) combination.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+from tpubft.kvbc import BLOCK_MERKLE, VERSIONED_KV, BlockUpdates, \
+    create_blockchain
+from tpubft.storage.memorydb import MemoryDB
+
+
+def _db(kind: str, tmp: str):
+    if kind == "memory":
+        return MemoryDB()
+    from tpubft.storage.native import NativeDB
+    return NativeDB(os.path.join(tmp, f"bench-{time.time_ns()}.kvlog"))
+
+
+def bench(engine: str, db_kind: str, blocks: int, keys_per_block: int,
+          tmp: str) -> dict:
+    db = _db(db_kind, tmp)
+    # the categorized engine pays Merkle maintenance only for
+    # block_merkle categories — benchmark the mixed-shape block the
+    # reference's kvbcbench writes (merkle + versioned)
+    bc = create_blockchain(db, version=engine, use_device_hashing=False)
+    t0 = time.perf_counter()
+    for b in range(blocks):
+        up = BlockUpdates()
+        for i in range(keys_per_block):
+            k = b"k-%d" % ((b * keys_per_block + i) % (blocks * 2))
+            up.put("bench", k, b"v-%d-%d" % (b, i), VERSIONED_KV)
+        if engine != "v4":
+            up.put("proven", b"m-%d" % (b % 64), b"mv-%d" % b, BLOCK_MERKLE)
+        else:
+            up.put("proven", b"m-%d" % (b % 64), b"mv-%d" % b, VERSIONED_KV)
+        bc.add_block(up)
+    add_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    reads = blocks
+    for b in range(reads):
+        k = b"k-%d" % ((b * keys_per_block) % (blocks * 2))
+        bc.get_latest("bench", k)
+    latest_s = time.perf_counter() - t0
+
+    # pre-execution conflict detection: validate a readset of
+    # keys-per-block keys against the latest index (skvbc conflict rule)
+    t0 = time.perf_counter()
+    checks = blocks
+    conflicts = 0
+    for b in range(checks):
+        rv = bc.last_block_id // 2
+        for i in range(keys_per_block):
+            k = b"k-%d" % ((b * keys_per_block + i) % (blocks * 2))
+            got = bc.get_latest("bench", k)
+            if got is not None and got[0] > rv:
+                conflicts += 1
+                break
+    conflict_s = time.perf_counter() - t0
+    db.close()
+    return {
+        "engine": engine, "db": db_kind, "blocks": blocks,
+        "keys_per_block": keys_per_block,
+        "add_blocks_per_sec": round(blocks / add_s, 1),
+        "latest_reads_per_sec": round(reads / latest_s, 1),
+        "conflict_checks_per_sec": round(checks / conflict_s, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--blocks", type=int, default=2000)
+    ap.add_argument("--keys-per-block", type=int, default=8)
+    args = ap.parse_args()
+    with tempfile.TemporaryDirectory() as tmp:
+        for engine in ("categorized", "v4"):
+            for db_kind in ("memory", "native"):
+                print(json.dumps(bench(engine, db_kind, args.blocks,
+                                       args.keys_per_block, tmp)),
+                      flush=True)
+
+
+if __name__ == "__main__":
+    main()

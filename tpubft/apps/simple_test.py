@@ -27,10 +27,15 @@ from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.metrics import Aggregator, UdpMetricsServer
 
 
-def endpoint_table(base_port: int, n: int, num_clients: int) -> Dict[int, Tuple[str, int]]:
+def endpoint_table(base_port: int, n: int, num_clients: int,
+                   operator_id: int = None) -> Dict[int, Tuple[str, int]]:
     eps = {r: ("127.0.0.1", base_port + r) for r in range(n)}
     for i in range(num_clients):
         eps[n + i] = ("127.0.0.1", base_port + n + i)
+    if operator_id is not None:
+        # the operator principal is addressable too (reconfiguration
+        # commands over the real transport)
+        eps[operator_id] = ("127.0.0.1", base_port + operator_id)
     return eps
 
 

@@ -28,10 +28,17 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f, c_val=args.c,
                         num_ro_replicas=args.ro,
                         num_of_client_proxies=args.clients,
-                        view_change_timer_ms=args.view_change_timeout_ms)
+                        view_change_timer_ms=args.view_change_timeout_ms,
+                        crypto_backend=args.crypto_backend,
+                        pre_execution_enabled=args.pre_execution,
+                        checkpoint_window_size=args.checkpoint_window,
+                        work_window_size=args.work_window,
+                        kvbc_version=args.kvbc_version)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()).for_node(args.replica)
-    eps = endpoint_table(args.base_port, cfg.n_val + args.ro, args.clients)
+    from tpubft.consensus.replicas_info import ReplicasInfo
+    eps = endpoint_table(args.base_port, cfg.n_val + args.ro, args.clients,
+                         operator_id=ReplicasInfo.from_config(cfg).operator_id)
     if args.transport == "tls":
         from tpubft.comm.tls import TlsConfig
         comm_cfg = TlsConfig(self_id=args.replica, endpoints=eps,
@@ -73,6 +80,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--view-change-timeout-ms", type=int, default=4000)
     p.add_argument("--strategy", default=None,
                    help="byzantine strategy name (testing)")
+    p.add_argument("--crypto-backend", default="cpu",
+                   choices=("cpu", "tpu"))
+    p.add_argument("--pre-execution", action="store_true")
+    p.add_argument("--fault-port", type=int, default=None,
+                   help="per-link fault-injection control port "
+                        "(Apollo iptables-partitioning analog)")
+    p.add_argument("--checkpoint-window", type=int, default=150)
+    p.add_argument("--work-window", type=int, default=300)
+    p.add_argument("--kvbc-version", default="categorized",
+                   choices=("categorized", "v4"))
     return p
 
 
@@ -84,7 +101,21 @@ def main() -> None:
     if args.strategy:
         from tpubft.testing.byzantine import strategy_wrapper
         comm_wrapper = strategy_wrapper(args.strategy)
+    fault_ctl = None
+    if args.fault_port is not None:
+        from tpubft.testing.faults import FaultyComm
+
+        def wrap_faulty(inner, _prev=comm_wrapper):
+            return FaultyComm(_prev(inner) if _prev is not None else inner)
+
+        comm_wrapper = wrap_faulty
     kr = build_replica(args, comm_wrapper)
+    if args.fault_port is not None:
+        # the FaultyComm is the outermost transport handed to the replica
+        from tpubft.testing.faults import FaultControlServer
+        fault_ctl = FaultControlServer(kr.replica.comm,
+                                       port=args.fault_port)
+        fault_ctl.start()
     metrics = UdpMetricsServer(kr.replica.aggregator,
                                port=args.metrics_port)
     metrics.start()
@@ -107,6 +138,8 @@ def main() -> None:
         metrics.stop()
         if diag is not None:
             diag.stop()
+        if fault_ctl is not None:
+            fault_ctl.stop()
 
 
 if __name__ == "__main__":

@@ -260,6 +260,12 @@ class Replica(IReceiver):
         self.m_view = self.metrics.register_gauge("view")
         self.m_last_executed = self.metrics.register_gauge("last_executed_seq")
         self.m_last_stable = self.metrics.register_gauge("last_stable_seq")
+        # a recovered replica must REPORT its recovered position — these
+        # gauges otherwise read 0 until the next execution, making an
+        # idle-after-restart replica look like it lost its state
+        self.m_view.set(self.view)
+        self.m_last_executed.set(self.last_executed)
+        self.m_last_stable.set(self.last_stable)
 
         # state transfer (attached by the kvbc layer via set_state_transfer;
         # reference: ReplicaForStateTransfer owning an IStateTransfer)
@@ -1751,6 +1757,13 @@ class Replica(IReceiver):
         """New primary: form NewViewMsg once the quorum is in. Backup:
         enter once a pending NewViewMsg resolves."""
         if new_view <= self.view:
+            return
+        if self._pending_entry is not None \
+                and self._pending_entry[0] == new_view:
+            # entry already parked on body fetches: the restriction set is
+            # FIXED (the primary must not re-form a different NewViewMsg
+            # from late ViewChangeMsgs — backups matched the first one and
+            # would diverge on the re-proposal set)
             return
         if self.info.primary_of_view(new_view) == self.id:
             if not self.vc.has_view_change_quorum(new_view):

@@ -11,6 +11,23 @@ from tpubft.kvbc.blockchain import KeyValueBlockchain
 from tpubft.kvbc.categories import (BLOCK_MERKLE, IMMUTABLE, VERSIONED_KV,
                                     BlockUpdates, CategoryUpdates)
 from tpubft.kvbc.sparse_merkle import SparseMerkleTree
+from tpubft.kvbc.v4 import V4KeyValueBlockchain
 
-__all__ = ["KeyValueBlockchain", "SparseMerkleTree", "BlockUpdates",
+
+def create_blockchain(db, version: str = "categorized",
+                      use_device_hashing: bool = True):
+    """Engine-selecting facade (reference kvbc_adapter,
+    /root/reference/kvbc/src/kvbc_adapter/): one call site picks the
+    categorized engine (multi-version reads + sparse-Merkle proofs) or
+    the v4 engine (latest-keys-native, write-optimized) behind the same
+    interface."""
+    if version in ("categorized", "v2"):
+        return KeyValueBlockchain(db, use_device_hashing=use_device_hashing)
+    if version == "v4":
+        return V4KeyValueBlockchain(db)
+    raise ValueError(f"unknown kvbc version {version!r}")
+
+
+__all__ = ["KeyValueBlockchain", "V4KeyValueBlockchain", "create_blockchain",
+           "SparseMerkleTree", "BlockUpdates",
            "CategoryUpdates", "BLOCK_MERKLE", "VERSIONED_KV", "IMMUTABLE"]

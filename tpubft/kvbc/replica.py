@@ -43,8 +43,10 @@ class KvbcReplica:
                  use_device_hashing: bool = False,
                  thin_replica_port: Optional[int] = None) -> None:
         self.db = open_db(db_path)
-        self.blockchain = KeyValueBlockchain(
-            self.db, use_device_hashing=use_device_hashing)
+        from tpubft.kvbc import create_blockchain
+        self.blockchain = create_blockchain(
+            self.db, version=getattr(cfg, "kvbc_version", "categorized"),
+            use_device_hashing=use_device_hashing)
         if handler_factory is None:
             from tpubft.apps.skvbc import SkvbcHandler
             handler_factory = SkvbcHandler

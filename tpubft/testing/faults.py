@@ -1,0 +1,149 @@
+"""Runtime-controllable per-link fault injection for replica processes.
+
+Rebuild of Apollo's network partitioning layer
+(/root/reference/tests/apollo/util/bft_network_partitioning.py:52 —
+iptables per-link DROP rules) without requiring iptables/root: the fault
+plane lives INSIDE the replica process as a transport wrapper
+(the reference's WrapCommunication.cpp role) whose drop sets are mutated
+at runtime through a tiny UDP control server. This gives the harness
+ASYMMETRIC partitions (A→B dropped while B→A flows), full isolation, and
+probabilistic loss per link — per replica, per direction.
+
+Control protocol (JSON over UDP, one datagram per command):
+  {"cmd": "set", "drop_to": [ids], "drop_from": [ids], "loss": 0.3}
+  {"cmd": "clear"}
+  {"cmd": "get"}
+Every command answers with the current fault state.
+"""
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+from typing import Optional, Set
+
+from tpubft.comm.interfaces import ICommunication, IReceiver, NodeNum
+from tpubft.testing.byzantine import WrapCommunication
+
+
+class FaultyComm(WrapCommunication):
+    """Transport wrapper with runtime-mutable drop sets: outbound drops by
+    destination, inbound drops by transport sender, and uniform
+    probabilistic loss (both directions)."""
+
+    def __init__(self, inner: ICommunication) -> None:
+        super().__init__(inner, self._mutate_send)
+        self.drop_to: Set[int] = set()
+        self.drop_from: Set[int] = set()
+        self.loss = 0.0
+        self._rng = random.Random(0xFA017)
+
+    def _mutate_send(self, dest: NodeNum, data: bytes) -> Optional[bytes]:
+        if int(dest) in self.drop_to:
+            return None
+        if self.loss and self._rng.random() < self.loss:
+            return None
+        return data
+
+    def start(self, receiver: IReceiver) -> None:
+        self._inner.start(_FilteringReceiver(self, receiver))
+
+    # control-server entry
+    def configure(self, drop_to=None, drop_from=None,
+                  loss: Optional[float] = None) -> None:
+        if drop_to is not None:
+            self.drop_to = {int(x) for x in drop_to}
+        if drop_from is not None:
+            self.drop_from = {int(x) for x in drop_from}
+        if loss is not None:
+            self.loss = float(loss)
+
+    def state(self) -> dict:
+        return {"drop_to": sorted(self.drop_to),
+                "drop_from": sorted(self.drop_from), "loss": self.loss}
+
+
+class _FilteringReceiver(IReceiver):
+    def __init__(self, faults: FaultyComm, inner: IReceiver) -> None:
+        self._faults = faults
+        self._inner = inner
+
+    def on_new_message(self, sender: NodeNum, data: bytes) -> None:
+        f = self._faults
+        if int(sender) in f.drop_from:
+            return
+        if f.loss and f._rng.random() < f.loss:
+            return
+        self._inner.on_new_message(sender, data)
+
+    def on_connection_status_change(self, node, status) -> None:
+        fn = getattr(self._inner, "on_connection_status_change", None)
+        if fn is not None:
+            fn(node, status)
+
+
+class FaultControlServer:
+    """One-datagram-per-command UDP control endpoint mutating a
+    FaultyComm's drop state (the harness's handle into the process)."""
+
+    def __init__(self, faults: FaultyComm, port: int = 0,
+                 host: str = "127.0.0.1") -> None:
+        self._faults = faults
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fault-ctl")
+        self._thread.start()
+
+    def _run(self) -> None:
+        self._sock.settimeout(0.5)
+        while self._running:
+            try:
+                data, addr = self._sock.recvfrom(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                cmd = json.loads(data.decode())
+                if cmd.get("cmd") == "clear":
+                    self._faults.configure(drop_to=(), drop_from=(), loss=0)
+                elif cmd.get("cmd") == "set":
+                    self._faults.configure(cmd.get("drop_to"),
+                                           cmd.get("drop_from"),
+                                           cmd.get("loss"))
+                reply = json.dumps(self._faults.state()).encode()
+            except (ValueError, KeyError) as e:
+                reply = json.dumps({"error": str(e)}).encode()
+            try:
+                self._sock.sendto(reply, addr)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        self._sock.close()
+
+
+def fault_command(port: int, timeout: float = 2.0, **cmd) -> Optional[dict]:
+    """Harness side: send one control command, return the replica's fault
+    state (None on timeout — e.g. the process is paused)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    try:
+        s.sendto(json.dumps(cmd).encode(), ("127.0.0.1", port))
+        data, _ = s.recvfrom(1 << 16)
+        return json.loads(data.decode())
+    except (OSError, ValueError):
+        return None
+    finally:
+        s.close()

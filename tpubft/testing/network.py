@@ -67,15 +67,24 @@ class BftTestNetwork:
                  base_port: Optional[int] = None,
                  db_dir: Optional[str] = None,
                  seed: str = "apollo-net",
-                 view_change_timeout_ms: int = 3000) -> None:
+                 view_change_timeout_ms: int = 3000,
+                 crypto_backend: str = "cpu",
+                 pre_execution: bool = False,
+                 checkpoint_window: int = 150,
+                 work_window: int = 300) -> None:
         self.f, self.c = f, c
         self.n = 3 * f + 2 * c + 1
         self.num_clients = num_clients
         self.seed = seed
         self.base_port = base_port or random.randint(20000, 50000)
         self.metrics_base = self.base_port + 1000
+        self.fault_base = self.base_port + 2000
         self.db_dir = db_dir
         self.view_change_timeout_ms = view_change_timeout_ms
+        self.crypto_backend = crypto_backend
+        self.pre_execution = pre_execution
+        self.checkpoint_window = checkpoint_window
+        self.work_window = work_window
         self.procs: Dict[int, subprocess.Popen] = {}
         self.paused: set = set()
         self._clients: Dict[int, BftClient] = {}
@@ -91,7 +100,13 @@ class BftTestNetwork:
 
     def start_replica(self, r: int) -> None:
         assert r not in self.procs or self.procs[r].poll() is not None
-        env = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu")
+        # persistent kernel cache: device-backend replicas (crypto tpu)
+        # otherwise pay a cold XLA compile per process — the dominant
+        # source of system-test flakiness
+        env = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu",
+                   JAX_COMPILATION_CACHE_DIR=os.path.join(_REPO_ROOT,
+                                                          ".jax_cache"),
+                   JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="2")
         args = [sys.executable, "-m", "tpubft.apps.skvbc_replica",
                 "--replica", str(r), "--f", str(self.f), "--c", str(self.c),
                 "--clients", str(self.num_clients),
@@ -99,12 +114,27 @@ class BftTestNetwork:
                 "--metrics-port", str(self.metrics_base + r),
                 "--seed", self.seed,
                 "--view-change-timeout-ms",
-                str(self.view_change_timeout_ms)]
+                str(self.view_change_timeout_ms),
+                "--fault-port", str(self.fault_base + r),
+                "--crypto-backend", self.crypto_backend,
+                "--checkpoint-window", str(self.checkpoint_window),
+                "--work-window", str(self.work_window)]
+        if self.pre_execution:
+            args += ["--pre-execution"]
         if self.db_dir:
             args += ["--db-dir", self.db_dir]
-        self.procs[r] = subprocess.Popen(args, env=env,
-                                         stdout=subprocess.DEVNULL,
-                                         stderr=subprocess.DEVNULL)
+        # per-replica log files (Apollo keeps logs under
+        # build/tests/apollo/logs — CMakeLists.txt:27)
+        if self.db_dir:
+            log = open(os.path.join(self.db_dir,
+                                    f"replica-{r}.log"), "ab")
+            out = err = log
+        else:
+            out = err = subprocess.DEVNULL
+        self.procs[r] = subprocess.Popen(args, env=env, stdout=out,
+                                         stderr=err)
+        if out is not subprocess.DEVNULL:
+            out.close()                   # child keeps its own fd
 
     def stop_all(self) -> None:
         for r, p in self.procs.items():
@@ -143,6 +173,40 @@ class BftTestNetwork:
     def resume_replica(self, r: int) -> None:
         self.procs[r].send_signal(signal.SIGCONT)
         self.paused.discard(r)
+
+    # ---- per-link faults (Apollo bft_network_partitioning.py analog,
+    # via the in-process FaultControlServer instead of iptables) ----
+    def drop_link(self, frm: int, to: int) -> None:
+        """Asymmetric partition: frm stops SENDING to `to` (traffic
+        to→frm still flows)."""
+        from tpubft.testing.faults import fault_command
+        state = fault_command(self.fault_base + frm, cmd="get") or {}
+        drops = set(state.get("drop_to", [])) | {to}
+        assert fault_command(self.fault_base + frm, cmd="set",
+                             drop_to=sorted(drops)) is not None
+
+    def isolate_replica(self, r: int, peers: Optional[List[int]] = None
+                        ) -> None:
+        """Symmetric isolation of r from `peers` (default: all replicas)
+        without stopping the process — unlike SIGSTOP the replica keeps
+        running (timers fire, complaints accumulate)."""
+        from tpubft.testing.faults import fault_command
+        others = [p for p in (peers if peers is not None
+                              else range(self.n)) if p != r]
+        assert fault_command(self.fault_base + r, cmd="set",
+                             drop_to=others, drop_from=others) is not None
+
+    def set_loss(self, r: int, loss: float) -> None:
+        """Uniform probabilistic message loss at replica r."""
+        from tpubft.testing.faults import fault_command
+        assert fault_command(self.fault_base + r, cmd="set",
+                             loss=loss) is not None
+
+    def heal(self, r: Optional[int] = None) -> None:
+        """Clear all injected faults (for one replica or all)."""
+        from tpubft.testing.faults import fault_command
+        for rr in ([r] if r is not None else list(range(self.n))):
+            fault_command(self.fault_base + rr, cmd="clear")
 
     # ------------------------------------------------------------------
     # observation
@@ -204,6 +268,30 @@ class BftTestNetwork:
 
     def skvbc_client(self, idx: int = 0, **cfg_kw) -> SkvbcClient:
         return SkvbcClient(self.client(idx, **cfg_kw))
+
+    def operator_client(self, **cfg_kw):
+        """Operator principal over the real transport (reconfiguration
+        commands: wedge, key rotation, pruning — reference TesterCRE/
+        concord-ctl roles)."""
+        from tpubft.consensus.replicas_info import ReplicasInfo
+        from tpubft.reconfiguration import OperatorClient
+        cfg = ReplicaConfig(f_val=self.f, c_val=self.c,
+                            num_of_client_proxies=self.num_clients)
+        op_id = ReplicasInfo.from_config(cfg).operator_id
+        cl = self._clients.get(op_id)
+        if cl is None:
+            keys = ClusterKeys.generate(
+                cfg, self.num_clients,
+                seed=self.seed.encode()).for_node(op_id)
+            eps = endpoint_table(self.base_port, self.n, self.num_clients,
+                                 operator_id=op_id)
+            comm = PlainUdpCommunication(CommConfig(self_id=op_id,
+                                                    endpoints=eps))
+            cl = BftClient(ClientConfig(client_id=op_id, f_val=self.f,
+                                        c_val=self.c, **cfg_kw), keys, comm)
+            cl.start()
+            self._clients[op_id] = cl
+        return OperatorClient(cl)
 
     def __enter__(self) -> "BftTestNetwork":
         return self.start_all()

@@ -53,6 +53,7 @@ class ReplicaConfig:
 
     # crypto
     crypto_backend: str = "cpu"         # "cpu" | "tpu"
+    kvbc_version: str = "categorized"   # ledger engine: "categorized" | "v4"
     replica_sig_scheme: str = "ed25519"  # per-message replica signatures
     client_sig_scheme: str = "ed25519"
     threshold_scheme: str = "multisig-ed25519"  # or "threshold-bls"
