@@ -1,0 +1,47 @@
+"""Operator workflows demo: wedge, key rotation, unwedge, pruning.
+
+The reconfiguration surface (reference reconfiguration/ +
+AddRemoveWithWedgeCommand + KeyExchangeManager flows), driven by the
+operator principal's signed commands through consensus.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tpubft.apps import skvbc                                    # noqa: E402
+from tpubft.kvbc import KeyValueBlockchain                       # noqa: E402
+from tpubft.storage import MemoryDB                              # noqa: E402
+from tpubft.testing.cluster import InProcessCluster              # noqa: E402
+
+
+def main() -> None:
+    def factory(_r=None):
+        return skvbc.SkvbcHandler(KeyValueBlockchain(
+            MemoryDB(), use_device_hashing=False))
+
+    with InProcessCluster(f=1, handler_factory=factory) as cluster:
+        kv = skvbc.SkvbcClient(cluster.client())
+        for i in range(3):
+            kv.write([(b"k%d" % i, b"v%d" % i)])
+        print("ordered 3 writes")
+
+        op = cluster.operator_client()
+        r = op.wedge(timeout_ms=15000)
+        print("wedge ->", r.success, "(stop point", r.data, ")")
+
+        r = op.key_exchange(timeout_ms=15000)
+        print("key rotation ->", r.success)
+
+        r = op.unwedge(timeout_ms=15000)
+        print("unwedge ->", r.success)
+
+        r2 = kv.write([(b"after", b"wedge")], timeout_ms=15000)
+        print("ordering after unwedge -> success =", r2.success)
+        print("read:", kv.read([b"after"]))
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,29 @@ def test_sig_manager_verify_batch_mixed(keys):
     assert verifier.verify_batch(items) == [True, True, False, False]
 
 
+def test_rotation_grace_expires_by_checkpoint_era(keys):
+    """A superseded key verifies in-flight protocol messages only until
+    stability passes its grace window (reference: per-checkpoint-era
+    CryptoManager key lookup) — not on a wall clock."""
+    from tpubft.crypto.cpu import Ed25519Signer
+    sm0 = SigManager(keys.for_node(0))
+    verifier = SigManager(keys.for_node(1), grace_seq_window=10)
+    old_sig = sm0.sign(b"msg")
+    new = Ed25519Signer.generate(seed=b"rotated")
+    verifier.set_replica_key(0, new.public_bytes(), rotation_seq=100)
+    # in grace: protocol messages near the rotation still verify
+    assert verifier.verify(0, b"msg", old_sig, seq=105)
+    # beyond the seq window: rejected
+    assert not verifier.verify(0, b"msg", old_sig, seq=111)
+    # context-free traffic never accepts the rotated-away key
+    assert not verifier.verify(0, b"msg", old_sig)
+    # checkpoint era passes the window: the old key is dropped entirely
+    verifier.on_stable(110)
+    assert not verifier.verify(0, b"msg", old_sig, seq=105)
+    # ... and the new key verifies
+    assert verifier.verify(0, b"msg2", new.sign(b"msg2"), seq=120)
+
+
 def test_batch_verifier_async(keys):
     sm0 = SigManager(keys.for_node(0))
     verifier = SigManager(keys.for_node(1))

@@ -115,6 +115,26 @@ def test_tracing_spans_and_context_propagation():
     assert SpanContext.parse("garbage") is None
 
 
+def test_tracing_wired_through_live_cluster():
+    """The protocol call sites actually emit spans (tracing is product
+    code, not a dead module): a client write produces client_send →
+    client_request → consensus_slot spans joined under ONE trace id."""
+    from tpubft.apps import counter
+    from tpubft.testing import InProcessCluster
+    with InProcessCluster(f=1) as cluster:
+        cl = cluster.client()
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(2), timeout_ms=20000)) == 2
+        spans = get_tracer().finished_spans()
+        send = [s for s in spans if s.name == "client_send"][-1]
+        joined = {s.name for s in spans
+                  if s.context.trace_id == send.context.trace_id}
+        assert {"client_send", "client_request", "consensus_slot"} <= joined
+        slot = next(s for s in spans if s.name == "consensus_slot"
+                    and s.context.trace_id == send.context.trace_id)
+        assert slot.end is not None and slot.tags.get("committed_path")
+
+
 # ---------------- slowdown ----------------
 
 def test_slowdown_policy():

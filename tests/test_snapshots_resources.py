@@ -1,0 +1,122 @@
+"""State snapshots (provisioning) + resources manager (adaptive pruning)
+— reference kvbc state_snapshot_interface.hpp + resources-manager/."""
+import os
+
+import pytest
+
+from tpubft.kvbc import BlockUpdates, create_blockchain
+from tpubft.kvbc.resources import ResourceConfig, ResourceManager, attach
+from tpubft.kvbc.snapshots import (SnapshotError, create_snapshot,
+                                   read_manifest, restore_snapshot)
+from tpubft.storage.memorydb import MemoryDB
+
+
+def _populated_chain(db, blocks=5):
+    bc = create_blockchain(db, version="categorized",
+                           use_device_hashing=False)
+    for i in range(blocks):
+        bc.add_block(BlockUpdates().put("kv", b"k%d" % (i % 3), b"v%d" % i))
+    return bc
+
+
+# ---------------- snapshots ----------------
+
+def test_snapshot_roundtrip_provisions_fresh_replica(tmp_path):
+    src_db = MemoryDB()
+    bc = _populated_chain(src_db)
+    path = str(tmp_path / "state.snap")
+    man = create_snapshot(src_db, path, head_block=bc.last_block_id,
+                          state_digest=bc.state_digest())
+    assert man["entries"] > 0
+    assert read_manifest(path)["head_block"] == 5
+
+    dst_db = MemoryDB()
+    man2 = restore_snapshot(path, dst_db)
+    assert man2 == man
+    # the provisioned replica serves the same state WITHOUT history replay
+    bc2 = create_blockchain(dst_db, version="categorized",
+                            use_device_hashing=False)
+    assert bc2.last_block_id == 5
+    assert bc2.state_digest() == bc.state_digest()
+    assert bc2.get_latest("kv", b"k1") == bc.get_latest("kv", b"k1")
+
+
+def test_snapshot_excludes_consensus_metadata(tmp_path):
+    db = MemoryDB()
+    _populated_chain(db)
+    db.put(b"obj-1", b"private-consensus-state", b"metadata")
+    path = str(tmp_path / "state.snap")
+    create_snapshot(db, path)
+    dst = MemoryDB()
+    restore_snapshot(path, dst)
+    assert dst.get(b"obj-1", b"metadata") is None
+
+
+def test_snapshot_detects_corruption(tmp_path):
+    db = MemoryDB()
+    _populated_chain(db)
+    path = str(tmp_path / "state.snap")
+    create_snapshot(db, path)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0x01
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(SnapshotError):
+        restore_snapshot(path, MemoryDB())
+
+
+def test_snapshot_native_db_scan(tmp_path):
+    from tpubft.storage.native import NativeDB
+    src = NativeDB(os.path.join(str(tmp_path), "src.kvlog"))
+    bc = _populated_chain(src, blocks=4)
+    path = str(tmp_path / "state.snap")
+    create_snapshot(src, path, head_block=bc.last_block_id)
+    dst = NativeDB(os.path.join(str(tmp_path), "dst.kvlog"))
+    restore_snapshot(path, dst)
+    bc2 = create_blockchain(dst, version="categorized",
+                            use_device_hashing=False)
+    assert bc2.last_block_id == 4
+    assert bc2.state_digest() == bc.state_digest()
+    src.close()
+    dst.close()
+
+
+# ---------------- resources manager ----------------
+
+def test_prune_rate_scales_with_backlog_and_business():
+    cfg = ResourceConfig(retention_blocks=100, max_prune_rate=100.0,
+                         busy_add_rate=10.0, window_s=1.0)
+    rm = ResourceManager(cfg)
+    # no backlog: no pruning
+    assert rm.prune_blocks_per_second(1, 50, now=100.0) == 0.0
+    # deep backlog, idle: full rate
+    assert rm.prune_blocks_per_second(1, 300, now=100.0) == 100.0
+    # deep backlog, fully busy: backs off
+    for i in range(10):
+        rm.on_block_added(now=99.5 + i * 0.05)
+    busy_rate = rm.prune_blocks_per_second(1, 300, now=100.0)
+    assert busy_rate < 10.0
+    # half-pressure scales proportionally
+    mid = rm.prune_blocks_per_second(1, 151, now=200.0)  # backlog 50 = 0.5x
+    assert 40.0 <= mid <= 60.0
+
+
+def test_recommended_prune_until_honors_retention():
+    cfg = ResourceConfig(retention_blocks=10, max_prune_rate=1000.0)
+    rm = ResourceManager(cfg)
+    # huge budget but retention clamps: never prune into the last 10
+    until = rm.recommended_prune_until(1, 50, interval_s=60.0, now=1.0)
+    assert until == 40
+    # tiny interval: budget clamps instead
+    cfg2 = ResourceConfig(retention_blocks=10, max_prune_rate=2.0)
+    rm2 = ResourceManager(cfg2)
+    until2 = rm2.recommended_prune_until(1, 50, interval_s=1.0, now=1.0)
+    assert until2 == 3                      # genesis + 2*1
+
+
+def test_attach_tracks_commit_stream():
+    db = MemoryDB()
+    bc = create_blockchain(db, version="v4")
+    rm = attach(bc, ResourceConfig(window_s=60.0))
+    for i in range(5):
+        bc.add_block(BlockUpdates().put("c", b"k", b"%d" % i))
+    assert rm.add_rate() > 0

@@ -86,3 +86,42 @@ def test_threshold_bls_bad_share_identification():
     combined = acc.get_full_signed_data()
     assert not verifier.verify(digest, combined)
     assert acc.identify_bad_shares() == [2]
+
+
+def test_bls_verify_batch_certs_rlc():
+    """Aggregated combined-cert verification: one RLC'd pairing check for
+    a clean batch; byzantine members isolated on the rare failure path."""
+    from tpubft.crypto import bls12381 as bls
+    from tpubft.crypto.interfaces import Cryptosystem
+    sys_ = Cryptosystem("threshold-bls", 3, 4, seed=b"batchcert")
+    v = sys_.create_threshold_verifier()
+    signers = [sys_.create_threshold_signer(i) for i in range(1, 4)]
+    digests = [bytes([i]) * 32 for i in range(5)]
+    sigs = []
+    for d in digests:
+        acc = v.new_accumulator(False)
+        acc.set_expected_digest(d)
+        for i, s in enumerate(signers, 1):
+            acc.add(i, s.sign_share(d))
+        sigs.append(acc.get_full_signed_data())
+    items = list(zip(digests, sigs))
+    assert v.verify_batch_certs(items) == [True] * 5
+    # one forged cert: the rest still verify, the forgery is isolated
+    bad = list(items)
+    bad[2] = (digests[2], bls.g1_compress(bls.G1_GEN))
+    assert v.verify_batch_certs(bad) == [True, True, False, True, True]
+    # undecodable and infinity sigs rejected without raising
+    weird = [(digests[0], b"\x00" * 48),
+             (digests[1], bytes([0xC0]) + b"\x00" * 47),
+             (digests[2], sigs[2])]
+    assert v.verify_batch_certs(weird) == [False, False, True]
+    # default (non-BLS) backends fall back to the per-cert loop
+    from tpubft.crypto.interfaces import IThresholdVerifier
+    ms = Cryptosystem("multisig-ed25519", 3, 4, seed=b"ms")
+    mv = ms.create_threshold_verifier()
+    macc = mv.new_accumulator(False)
+    d0 = digests[0]
+    for i in range(1, 4):
+        macc.add(i, ms.create_threshold_signer(i).sign_share(d0))
+    msig = macc.get_full_signed_data()
+    assert mv.verify_batch_certs([(d0, msig), (d0, b"junk")]) == [True, False]

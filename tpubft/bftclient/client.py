@@ -118,9 +118,17 @@ class BftClient(IReceiver):
             req_seq = self._req_seq
             evt = self._done[req_seq] = threading.Event()
             self._quorum_needed[req_seq] = self.quorum_size(quorum)
+        # the cid carries a serialized span context so the request's trace
+        # joins across every replica (reference: spanContext inside
+        # ClientRequestMsg; OpenTracing.hpp)
+        from tpubft.utils.tracing import get_tracer
+        span = get_tracer().start_span("client_send")
+        span.set_tag("client", self.cfg.client_id).set_tag("req_seq",
+                                                           req_seq)
         req = m.ClientRequestMsg(sender_id=self.cfg.client_id,
                                  req_seq_num=req_seq, flags=flags,
-                                 request=request, cid=f"c{req_seq}",
+                                 request=request,
+                                 cid=span.context.serialize(),
                                  signature=b"")
         req.signature = self._signer.sign(req.signed_payload())
         raw = req.pack()
@@ -137,6 +145,7 @@ class BftClient(IReceiver):
                 f"client {self.cfg.client_id} req {req_seq}: no quorum "
                 f"within {timeout_ms or self.cfg.request_timeout_ms}ms")
         finally:
+            span.finish()
             with self._lock:
                 self._done.pop(req_seq, None)
                 self._replies.pop(req_seq, None)

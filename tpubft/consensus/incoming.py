@@ -112,10 +112,17 @@ class Dispatcher:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        from tpubft.utils.racecheck import get_watchdog
+        get_watchdog().unregister(self._name)
 
     def _loop(self) -> None:
         set_mdc(**self._thread_mdc)
+        # liveness heartbeat: a wedged dispatcher (deadlock, hung handler)
+        # gets a full-process stack dump from the watchdog (§5.2 role)
+        from tpubft.utils.racecheck import get_watchdog
+        watchdog = get_watchdog()
         while self._running:
+            watchdog.beat(self._name)
             now = time.monotonic()
             next_due = min((t[2] for t in self._timers), default=now + 0.05)
             timeout = max(0.0, min(next_due - now, 0.05))

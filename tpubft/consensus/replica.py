@@ -226,6 +226,14 @@ class Replica(IReceiver):
                                   self._send_status)
         self.collector_pool = CollectorPool(
             lambda res: self.incoming.push_internal("combine", res))
+        # cross-seqnum combined-cert verification batcher: certs arriving
+        # within a flush window verify in ONE aggregated check per
+        # verifier (BLS: single RLC'd pairing check)
+        from tpubft.consensus.collectors import CertBatchVerifier
+        self.cert_batcher = CertBatchVerifier(
+            lambda cookie, ok: self.incoming.push_internal(
+                "cert_verified", (cookie[0], cookie[1], ok)),
+            flush_us=cfg.verify_batch_flush_us)
 
         # retransmissions (reference RetransmissionsManager +
         # sendRetransmittableMsgToReplica, ReplicaImp.cpp:2531)
@@ -445,6 +453,7 @@ class Replica(IReceiver):
                      self.last_executed, self.last_stable)
         self.dispatcher.stop()
         self.collector_pool.shutdown()
+        self.cert_batcher.stop()
         if self.preprocessor:
             self.preprocessor.shutdown()
         self.comm.stop()
@@ -578,6 +587,18 @@ class Replica(IReceiver):
     # client requests (ReplicaImp.cpp:397)
     # ------------------------------------------------------------------
     def _on_client_request(self, req: m.ClientRequestMsg) -> None:
+        """Traced entry (reference: child span per message handler,
+        ReplicaImp.cpp:409-413 — the span context rides the cid field,
+        MessageBase::spanContext<T>())."""
+        from tpubft.utils.tracing import SpanContext, get_tracer
+        with get_tracer().start_span(
+                "client_request",
+                parent=SpanContext.parse(req.cid or "")) as span:
+            span.set_tag("r", self.id).set_tag("client", req.sender_id) \
+                .set_tag("req_seq", req.req_seq_num)
+            self._handle_client_request(req)
+
+    def _handle_client_request(self, req: m.ClientRequestMsg) -> None:
         client = req.sender_id
         if not self.clients.is_valid_client(client):
             return
@@ -824,6 +845,20 @@ class Replica(IReceiver):
         info.pre_prepare = pp
         info.commit_path = pp.first_path
         info.received_at = time.monotonic()
+        # consensus-slot span: accept → executed, joined to the first
+        # request's trace (reference: per-stage child spans carrying the
+        # PrePrepare's span context, ReplicaImp.cpp:1070)
+        from tpubft.utils.tracing import SpanContext, get_tracer
+        parent = None
+        try:
+            reqs = pp.client_requests()
+            if reqs:
+                parent = SpanContext.parse(reqs[0].cid or "")
+        except m.MsgError:
+            pass
+        info.span = get_tracer().start_span("consensus_slot", parent=parent)
+        info.span.set_tag("r", self.id).set_tag("seq", pp.seq_num) \
+            .set_tag("view", pp.view).set_tag("path", pp.first_path)
         with self._tran() as st:
             st.seq(pp.seq_num).pre_prepare = pp.pack()
         if pp.first_path == int(m.CommitPath.SLOW):
@@ -1033,6 +1068,13 @@ class Replica(IReceiver):
                 info.cert_pending[(kind, msg.sender_id)] = msg
             return
         info.cert_verifying[kind] = msg
+        from tpubft.crypto.interfaces import IThresholdVerifier
+        if type(verifier).verify_batch_certs \
+                is not IThresholdVerifier.verify_batch_certs:
+            # backend has a real aggregated check (BLS RLC pairing):
+            # batch across seqnums/kinds
+            self.cert_batcher.submit(verifier, d, msg.sig, (msg, kind))
+            return
 
         def job():
             try:
@@ -1228,6 +1270,10 @@ class Replica(IReceiver):
             if self.cfg.time_service_enabled and info.pre_prepare.time:
                 self.time_service.on_executed(info.pre_prepare.time)
             info.executed = True
+            if getattr(info, "span", None) is not None:
+                info.span.set_tag("committed_path", info.commit_path)
+                info.span.finish()
+                info.span = None
             self.last_executed = nxt
             self.m_last_executed.set(nxt)
             self._last_progress = time.monotonic()
@@ -1559,6 +1605,8 @@ class Replica(IReceiver):
         if seq <= self.last_stable:
             return
         log.debug("checkpoint stable at seq %d", seq)
+        # checkpoint-era key expiry (reference CryptoManager per-era keys)
+        self.sig.on_stable(seq)
         if self.retrans is not None:
             self.retrans.gc_stable(seq)
         for s in [s for s in self._missing_since if s <= seq]:

@@ -58,6 +58,8 @@ class SeqNumInfo:
     # when evidence (shares/certs) first arrived WITHOUT a PrePrepare —
     # the ReqMissingDataMsg trigger clock
     first_evidence_at: float = 0.0
+    # open consensus-slot tracing span (accept -> executed)
+    span: Optional[object] = None
 
 
 T = TypeVar("T")

@@ -84,9 +84,11 @@ class SigManager:
         return self._keys.my_id
 
     # ---- key rotation (KeyExchangeManager upcalls) ----
-    # wall-clock upper bound on how long a superseded key is retained at
-    # all (cleanup backstop; the real scope is by seqnum below, like the
-    # reference's per-checkpoint-era CryptoManager key lookup)
+    # wall-clock backstop used ONLY for rotations without a seqnum
+    # context; seq-scoped rotations expire by CHECKPOINT ERA instead —
+    # on_stable() drops a superseded key once stability passes its grace
+    # window (the reference's per-checkpoint-era CryptoManager lookup,
+    # CryptoManager.hpp:109)
     GRACE_WINDOW_S = 30.0
 
     def set_replica_key(self, replica_id: int, new_pubkey: bytes,
@@ -108,6 +110,17 @@ class SigManager:
 
     def set_my_signer(self, signer) -> None:
         self._signer = signer
+
+    def on_stable(self, stable_seq: int) -> None:
+        """Checkpoint-era expiry: once stability passes a rotation's
+        grace window, nothing signed under the old key can order anymore
+        — drop it (callers: replica._on_seq_stable)."""
+        with self._lock:
+            for p in [p for p, (_, _, rot_seq) in self._prev_pubkeys.items()
+                      if rot_seq is not None
+                      and stable_seq >= rot_seq + self.grace_seq_window]:
+                self._prev_pubkeys.pop(p, None)
+                self._prev_verifiers.pop(p, None)
 
     # ---- verification ----
     def _scheme_of(self, principal: int) -> str:
@@ -156,9 +169,11 @@ class SigManager:
             if entry is None:
                 return None
             pk, rotated_at, rotation_seq = entry
-            if time.monotonic() - rotated_at > self.GRACE_WINDOW_S:
+            if rotation_seq is None \
+                    and time.monotonic() - rotated_at > self.GRACE_WINDOW_S:
                 # the leaked/old key must stop verifying — that's the
-                # point of rotating
+                # point of rotating. Seq-scoped rotations expire by
+                # checkpoint era (on_stable) instead of wall clock.
                 self._prev_pubkeys.pop(principal, None)
                 self._prev_verifiers.pop(principal, None)
                 return None
@@ -306,7 +321,10 @@ class BatchVerifier:
         verdict = PendingVerdict()
         with self._wake:
             self._pending.append((principal, data, sig, verdict))
-            if len(self._pending) >= self._batch_size:
+            # wake only on empty -> non-empty or a full batch: waking the
+            # flush-window wait on every submit collapses batches
+            if len(self._pending) == 1 \
+                    or len(self._pending) >= self._batch_size:
                 self._wake.notify()
         return verdict
 

@@ -90,6 +90,12 @@ class IThresholdVerifier(abc.ABC):
     def verify(self, data: bytes, sig: bytes) -> bool:
         """Verify a combined threshold signature."""
 
+    def verify_batch_certs(self, items) -> list:
+        """[(data, sig)] -> verdicts. Backends with an aggregated check
+        (BLS random-linear-combination: ONE pairing check for the whole
+        batch) override this; the default is the per-cert loop."""
+        return [self.verify(d, s) for d, s in items]
+
     @property
     @abc.abstractmethod
     def threshold(self) -> int: ...

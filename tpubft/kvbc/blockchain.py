@@ -51,18 +51,25 @@ def _bid(block_id: int) -> bytes:
     return block_id.to_bytes(8, "big")
 
 
-class KeyValueBlockchain:
-    def __init__(self, db: IDBClient, use_device_hashing: bool = True) -> None:
-        self._db = db
-        self._use_device = use_device_hashing
-        self._trees: Dict[str, SparseMerkleTree] = {}
-        # block-commit listeners (thin-replica publishing; reference:
-        # kvbc Replica feeds SubUpdateBuffers from the commit path)
-        self._listeners: List[Callable[[int, "cat.BlockUpdates"], None]] = []
-        last = db.get(_K_LAST, _MISC)
+class BlockStoreMixin:
+    """Shared block-store + ST-staging + pruning plumbing for both ledger
+    engines (categorized and v4 — they differ only in keyspace names and
+    how a block's updates are staged). Engines set the class attributes
+    `_F_BLOCKS`/`_F_MISC`/`_F_ST` and implement `_stage_block(wb,
+    block_id, updates) -> Block`; the mixin provides everything keyed off
+    the shared block format."""
+
+    _F_BLOCKS: bytes
+    _F_MISC: bytes
+    _F_ST: bytes
+
+    def _load_head(self) -> None:
+        last = self._db.get(_K_LAST, self._F_MISC)
         self._last = int.from_bytes(last, "big") if last else 0
-        gen = db.get(_K_GENESIS, _MISC)
+        gen = self._db.get(_K_GENESIS, self._F_MISC)
         self._genesis = int.from_bytes(gen, "big") if gen else 0
+        self._listeners: List[Callable[[int, "cat.BlockUpdates"],
+                                       None]] = []
 
     # ---- properties ----
     @property
@@ -73,30 +80,24 @@ class KeyValueBlockchain:
     def genesis_block_id(self) -> int:
         return self._genesis
 
-    def _tree(self, category: str) -> SparseMerkleTree:
-        t = self._trees.get(category)
-        if t is None:
-            t = SparseMerkleTree(self._db, family=f"smt.{category}".encode(),
-                                 use_device=self._use_device)
-            self._trees[category] = t
-        return t
-
-    # ---- write path ----
+    # ---- commit-stream listeners (thin-replica publishing; reference:
+    # kvbc Replica feeds SubUpdateBuffers from the commit path) ----
     def add_listener(self,
                      fn: Callable[[int, "cat.BlockUpdates"], None]) -> None:
         self._listeners.append(fn)
 
-    def _notify(self, block_id: int, updates: cat.BlockUpdates) -> None:
+    def _notify(self, block_id: int, updates: "cat.BlockUpdates") -> None:
         for fn in self._listeners:
             try:
                 fn(block_id, updates)
             except Exception:  # noqa: BLE001 — listeners must not break commit
                 pass
 
-    def add_block(self, updates: cat.BlockUpdates) -> int:
+    # ---- write path ----
+    def add_block(self, updates: "cat.BlockUpdates") -> int:
         block_id = self._last + 1
         wb = WriteBatch()
-        block = self._stage_block(wb, block_id, updates)
+        self._stage_block(wb, block_id, updates)
         self._db.write(wb)
         self._last = block_id
         if self._genesis == 0:
@@ -104,31 +105,21 @@ class KeyValueBlockchain:
         self._notify(block_id, updates)
         return block_id
 
-    def _stage_block(self, wb: WriteBatch, block_id: int,
-                     updates: cat.BlockUpdates) -> Block:
-        digests: Dict[str, bytes] = {}
-        for name in sorted(updates.categories):
-            cat_type, cu = updates.categories[name]
-            digests[name] = cat.stage_category(
-                self._db, wb, name, cat_type, cu, block_id, self._tree)
-        parent = self.block_digest(block_id - 1) if block_id > 1 else b""
-        block = Block(block_id=block_id, parent_digest=parent,
-                      category_digests=digests,
-                      updates_blob=cat.encode_block_updates(updates))
-        raw = ser.encode_msg(block)
-        wb.put(_bid(block_id), raw, _BLOCKS)
-        wb.put(_K_LAST, _bid(block_id), _MISC)
+    def _put_block_row(self, wb: WriteBatch, block_id: int,
+                       block: "Block") -> None:
+        """Tail shared by every engine's _stage_block."""
+        wb.put(_bid(block_id), ser.encode_msg(block), self._F_BLOCKS)
+        wb.put(_K_LAST, _bid(block_id), self._F_MISC)
         if block_id == 1:
-            wb.put(_K_GENESIS, _bid(1), _MISC)
-        return block
+            wb.put(_K_GENESIS, _bid(1), self._F_MISC)
 
     # ---- read path ----
-    def get_block(self, block_id: int) -> Optional[Block]:
-        raw = self._db.get(_bid(block_id), _BLOCKS)
+    def get_block(self, block_id: int) -> Optional["Block"]:
+        raw = self._db.get(_bid(block_id), self._F_BLOCKS)
         return ser.decode_msg(raw, Block) if raw is not None else None
 
     def get_raw_block(self, block_id: int) -> Optional[bytes]:
-        return self._db.get(_bid(block_id), _BLOCKS)
+        return self._db.get(_bid(block_id), self._F_BLOCKS)
 
     def block_digest(self, block_id: int) -> bytes:
         if block_id == 0:
@@ -143,22 +134,6 @@ class KeyValueBlockchain:
         sign (reference: kv_blockchain state hash)."""
         return self.block_digest(self._last) if self._last else b"\x00" * 32
 
-    def get_latest(self, category: str, key: bytes,
-                   cat_type: str = cat.VERSIONED_KV
-                   ) -> Optional[Tuple[int, bytes]]:
-        return cat.get_latest(self._db, category, cat_type, key)
-
-    def get_versioned(self, category: str, key: bytes,
-                      block_id: int) -> Optional[bytes]:
-        return cat.get_versioned(self._db, category, key, block_id)
-
-    def prove(self, category: str, key: bytes):
-        """Merkle proof for a block_merkle-category key (latest state)."""
-        return self._tree(category).prove(key)
-
-    def merkle_root(self, category: str) -> bytes:
-        return self._tree(category).root()
-
     # ---- pruning (reference: deleteBlocksUntil / pruning_handler) ----
     def delete_blocks_until(self, until_block_id: int) -> int:
         """Delete block bodies in [genesis, until); latest state is kept.
@@ -170,8 +145,8 @@ class KeyValueBlockchain:
             return self._genesis
         wb = WriteBatch()
         for bid in range(start, until_block_id):
-            wb.delete(_bid(bid), _BLOCKS)
-        wb.put(_K_GENESIS, _bid(until_block_id), _MISC)
+            wb.delete(_bid(bid), self._F_BLOCKS)
+        wb.put(_K_GENESIS, _bid(until_block_id), self._F_MISC)
         self._db.write(wb)
         self._genesis = until_block_id
         return self._genesis
@@ -180,10 +155,10 @@ class KeyValueBlockchain:
     def add_raw_st_block(self, block_id: int, raw: bytes) -> None:
         if block_id <= self._last:
             return
-        self._db.put(_bid(block_id), raw, _ST)
+        self._db.put(_bid(block_id), raw, self._F_ST)
 
     def has_st_block(self, block_id: int) -> bool:
-        return self._db.has(_bid(block_id), _ST)
+        return self._db.has(_bid(block_id), self._F_ST)
 
     def link_st_chain(self) -> int:
         """Adopt contiguous staged blocks after the head, re-executing
@@ -191,7 +166,7 @@ class KeyValueBlockchain:
         source can't inject state. Returns the new head."""
         while True:
             nxt = self._last + 1
-            raw = self._db.get(_bid(nxt), _ST)
+            raw = self._db.get(_bid(nxt), self._F_ST)
             if raw is None:
                 return self._last
             try:
@@ -212,11 +187,62 @@ class KeyValueBlockchain:
             except Exception:
                 # drop the bad staged block so retries can re-fetch it from
                 # another source instead of wedging on the same bytes
-                self._db.delete(_bid(nxt), _ST)
+                self._db.delete(_bid(nxt), self._F_ST)
                 raise
-            wb.delete(_bid(nxt), _ST)
+            wb.delete(_bid(nxt), self._F_ST)
             self._db.write(wb)
             self._last = nxt
             if self._genesis == 0:
                 self._genesis = 1
             self._notify(nxt, updates)
+
+
+class KeyValueBlockchain(BlockStoreMixin):
+    _F_BLOCKS = _BLOCKS
+    _F_MISC = _MISC
+    _F_ST = _ST
+
+    def __init__(self, db: IDBClient, use_device_hashing: bool = True) -> None:
+        self._db = db
+        self._use_device = use_device_hashing
+        self._trees: Dict[str, SparseMerkleTree] = {}
+        self._load_head()
+
+    def _tree(self, category: str) -> SparseMerkleTree:
+        t = self._trees.get(category)
+        if t is None:
+            t = SparseMerkleTree(self._db, family=f"smt.{category}".encode(),
+                                 use_device=self._use_device)
+            self._trees[category] = t
+        return t
+
+    def _stage_block(self, wb: WriteBatch, block_id: int,
+                     updates: cat.BlockUpdates) -> Block:
+        digests: Dict[str, bytes] = {}
+        for name in sorted(updates.categories):
+            cat_type, cu = updates.categories[name]
+            digests[name] = cat.stage_category(
+                self._db, wb, name, cat_type, cu, block_id, self._tree)
+        parent = self.block_digest(block_id - 1) if block_id > 1 else b""
+        block = Block(block_id=block_id, parent_digest=parent,
+                      category_digests=digests,
+                      updates_blob=cat.encode_block_updates(updates))
+        self._put_block_row(wb, block_id, block)
+        return block
+
+    # ---- categorized reads ----
+    def get_latest(self, category: str, key: bytes,
+                   cat_type: str = cat.VERSIONED_KV
+                   ) -> Optional[Tuple[int, bytes]]:
+        return cat.get_latest(self._db, category, cat_type, key)
+
+    def get_versioned(self, category: str, key: bytes,
+                      block_id: int) -> Optional[bytes]:
+        return cat.get_versioned(self._db, category, key, block_id)
+
+    def prove(self, category: str, key: bytes):
+        """Merkle proof for a block_merkle-category key (latest state)."""
+        return self._tree(category).prove(key)
+
+    def merkle_root(self, category: str) -> bytes:
+        return self._tree(category).root()

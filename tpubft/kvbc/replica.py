@@ -40,10 +40,15 @@ class KvbcReplica:
                  db_path: Optional[str] = None,
                  handler_factory=None,
                  aggregator: Optional[Aggregator] = None,
-                 use_device_hashing: bool = False,
+                 use_device_hashing: Optional[bool] = None,
                  thin_replica_port: Optional[int] = None) -> None:
         self.db = open_db(db_path)
         from tpubft.kvbc import create_blockchain
+        if use_device_hashing is None:
+            # device-backed crypto implies device-backed bulk hashing —
+            # Merkle levels and block digests ride the batched SHA-256
+            # kernel alongside the signature kernels
+            use_device_hashing = cfg.crypto_backend == "tpu"
         self.blockchain = create_blockchain(
             self.db, version=getattr(cfg, "kvbc_version", "categorized"),
             use_device_hashing=use_device_hashing)

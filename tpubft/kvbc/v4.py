@@ -23,21 +23,18 @@ interchangeable behind ``tpubft.kvbc.create_blockchain``.
 from __future__ import annotations
 
 import hashlib
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from tpubft.kvbc import categories as cat
-from tpubft.kvbc.blockchain import Block, BlockchainError, _bid
+from tpubft.kvbc.blockchain import (Block, BlockchainError, BlockStoreMixin,
+                                    _bid)
 from tpubft.storage.interfaces import IDBClient, WriteBatch
-from tpubft.utils import serialize as ser
 
 _BLOCKS = b"v4.blocks"
 _LATEST = b"v4.latest"
 _TAGS = b"v4.tags"
 _MISC = b"v4.misc"
 _ST = b"v4.st"
-
-_K_LAST = b"last"
-_K_GENESIS = b"genesis"
 
 
 def _lk(category: str, key: bytes) -> bytes:
@@ -47,52 +44,27 @@ def _lk(category: str, key: bytes) -> bytes:
     return len(c).to_bytes(2, "big") + c + key
 
 
-class V4KeyValueBlockchain:
+def _tag_row(category: str, tag: str, key: bytes) -> bytes:
+    """Tag index row: category-scoped like the categorized engine's
+    _fam(category, 'tag') family — tags never leak across categories."""
+    c, t = category.encode(), tag.encode()
+    return (len(c).to_bytes(2, "big") + c
+            + len(t).to_bytes(4, "big") + t + key)
+
+
+class V4KeyValueBlockchain(BlockStoreMixin):
     """Write-optimized engine: one latest-keys write per key per block."""
 
     VERSION = "v4"
+    _F_BLOCKS = _BLOCKS
+    _F_MISC = _MISC
+    _F_ST = _ST
 
     def __init__(self, db: IDBClient,
                  use_device_hashing: bool = False) -> None:
         del use_device_hashing          # no Merkle trees to accelerate
         self._db = db
-        self._listeners: List[Callable[[int, cat.BlockUpdates], None]] = []
-        last = db.get(_K_LAST, _MISC)
-        self._last = int.from_bytes(last, "big") if last else 0
-        gen = db.get(_K_GENESIS, _MISC)
-        self._genesis = int.from_bytes(gen, "big") if gen else 0
-
-    # ---- properties ----
-    @property
-    def last_block_id(self) -> int:
-        return self._last
-
-    @property
-    def genesis_block_id(self) -> int:
-        return self._genesis
-
-    # ---- write path ----
-    def add_listener(self,
-                     fn: Callable[[int, cat.BlockUpdates], None]) -> None:
-        self._listeners.append(fn)
-
-    def _notify(self, block_id: int, updates: cat.BlockUpdates) -> None:
-        for fn in self._listeners:
-            try:
-                fn(block_id, updates)
-            except Exception:  # noqa: BLE001 — listeners must not break commit
-                pass
-
-    def add_block(self, updates: cat.BlockUpdates) -> int:
-        block_id = self._last + 1
-        wb = WriteBatch()
-        self._stage_block(wb, block_id, updates)
-        self._db.write(wb)
-        self._last = block_id
-        if self._genesis == 0:
-            self._genesis = 1
-        self._notify(block_id, updates)
-        return block_id
+        self._load_head()
 
     def _stage_block(self, wb: WriteBatch, block_id: int,
                      updates: cat.BlockUpdates) -> Block:
@@ -112,9 +84,7 @@ class V4KeyValueBlockchain:
                         raise cat.CategoryError(
                             f"immutable key rewrite: {k!r}")
                     for tag in cu.tags.get(k, []):
-                        tb = tag.encode()
-                        wb.put(len(tb).to_bytes(4, "big") + tb + k, v,
-                               _TAGS)
+                        wb.put(_tag_row(name, tag, k), v, _TAGS)
                 if v is None:
                     wb.delete(row, _LATEST)
                     h.update(b"\x00" + len(k).to_bytes(4, "big") + k)
@@ -127,31 +97,10 @@ class V4KeyValueBlockchain:
         block = Block(block_id=block_id, parent_digest=parent,
                       category_digests=digests,
                       updates_blob=cat.encode_block_updates(updates))
-        wb.put(_bid(block_id), ser.encode_msg(block), _BLOCKS)
-        wb.put(_K_LAST, _bid(block_id), _MISC)
-        if block_id == 1:
-            wb.put(_K_GENESIS, _bid(1), _MISC)
+        self._put_block_row(wb, block_id, block)
         return block
 
-    # ---- read path ----
-    def get_block(self, block_id: int) -> Optional[Block]:
-        raw = self._db.get(_bid(block_id), _BLOCKS)
-        return ser.decode_msg(raw, Block) if raw is not None else None
-
-    def get_raw_block(self, block_id: int) -> Optional[bytes]:
-        return self._db.get(_bid(block_id), _BLOCKS)
-
-    def block_digest(self, block_id: int) -> bytes:
-        if block_id == 0:
-            return b""
-        blk = self.get_block(block_id)
-        if blk is None:
-            raise BlockchainError(f"missing block {block_id}")
-        return blk.digest()
-
-    def state_digest(self) -> bytes:
-        return self.block_digest(self._last) if self._last else b"\x00" * 32
-
+    # ---- v4 reads ----
     def get_latest(self, category: str, key: bytes,
                    cat_type: str = cat.VERSIONED_KV
                    ) -> Optional[Tuple[int, bytes]]:
@@ -181,8 +130,7 @@ class V4KeyValueBlockchain:
 
     def get_tagged(self, category: str, tag: str
                    ) -> List[Tuple[bytes, bytes]]:
-        tb = tag.encode()
-        prefix = len(tb).to_bytes(4, "big") + tb
+        prefix = _tag_row(category, tag, b"")
         out = []
         for k, v in self._db.range_iter(_TAGS, start=prefix):
             if not k.startswith(prefix):
@@ -199,58 +147,3 @@ class V4KeyValueBlockchain:
         raise BlockchainError(
             "v4 engine keeps no Merkle trees; configure the categorized "
             "engine for proofs (kvbc_adapter role)")
-
-    # ---- pruning ----
-    def delete_blocks_until(self, until_block_id: int) -> int:
-        if until_block_id > self._last:
-            raise BlockchainError("cannot prune the chain head")
-        start = self._genesis if self._genesis else 1
-        if until_block_id <= start:
-            return self._genesis
-        wb = WriteBatch()
-        for bid in range(start, until_block_id):
-            wb.delete(_bid(bid), _BLOCKS)
-        wb.put(_K_GENESIS, _bid(until_block_id), _MISC)
-        self._db.write(wb)
-        self._genesis = until_block_id
-        return self._genesis
-
-    # ---- state-transfer staging (st_chain.cpp) ----
-    def add_raw_st_block(self, block_id: int, raw: bytes) -> None:
-        if block_id <= self._last:
-            return
-        self._db.put(_bid(block_id), raw, _ST)
-
-    def has_st_block(self, block_id: int) -> bool:
-        return self._db.has(_bid(block_id), _ST)
-
-    def link_st_chain(self) -> int:
-        while True:
-            nxt = self._last + 1
-            raw = self._db.get(_bid(nxt), _ST)
-            if raw is None:
-                return self._last
-            try:
-                blk = ser.decode_msg(raw, Block)
-                if blk.block_id != nxt:
-                    raise BlockchainError(
-                        f"staged block id mismatch: {blk.block_id} != {nxt}")
-                expect_parent = (self.block_digest(self._last)
-                                 if self._last else b"")
-                if blk.parent_digest != expect_parent:
-                    raise BlockchainError(f"parent digest mismatch at {nxt}")
-                updates = cat.decode_block_updates(blk.updates_blob)
-                wb = WriteBatch()
-                rebuilt = self._stage_block(wb, nxt, updates)
-                if rebuilt.category_digests != blk.category_digests:
-                    raise BlockchainError(
-                        f"category digest mismatch at {nxt}")
-            except Exception:
-                self._db.delete(_bid(nxt), _ST)
-                raise
-            wb.delete(_bid(nxt), _ST)
-            self._db.write(wb)
-            self._last = nxt
-            if self._genesis == 0:
-                self._genesis = 1
-            self._notify(nxt, updates)

@@ -98,6 +98,13 @@ class IDBClient(abc.ABC):
     @abc.abstractmethod
     def close(self) -> None: ...
 
+    def scan_all(self) -> "Iterator[Tuple[bytes, bytes, bytes]]":
+        """Iterate EVERY (family, key, value) in the store — the
+        whole-state snapshot walk (reference: RocksDB checkpoint /
+        state-snapshot streaming). Backends with a physical-order scan
+        override this."""
+        raise NotImplementedError
+
     # ---- conveniences built on the primitives ----
     def put(self, key: bytes, value: bytes,
             family: bytes = DEFAULT_FAMILY) -> None:

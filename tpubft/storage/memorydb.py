@@ -54,5 +54,13 @@ class MemoryDB(IDBClient):
         for k, v in snap:
             yield k[prefix:], v
 
+    def scan_all(self):
+        from tpubft.storage.interfaces import split_fkey
+        with self._lock:
+            snap = [(k, self._map[k]) for k in self._keys]
+        for k, v in snap:
+            fam, key = split_fkey(k)
+            yield fam, key, v
+
     def close(self) -> None:
         pass

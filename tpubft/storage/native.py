@@ -128,6 +128,23 @@ class NativeDB(IDBClient):
         for k, v in _decode_scan(buf):
             yield k[prefix:], v
 
+    def scan_all(self):
+        from tpubft.storage.interfaces import split_fkey
+        self._handle()
+        out = _U8P()
+        outlen = ctypes.c_uint32()
+        rc = self._lib.kvlog_scan(self._h, b"", 0, b"", 0xFFFFFFFF,
+                                  ctypes.byref(out), ctypes.byref(outlen))
+        if rc != 0:
+            raise StorageError(f"kvlog_scan rc={rc}")
+        try:
+            buf = ctypes.string_at(out, outlen.value)
+        finally:
+            self._lib.kvlog_free(out)
+        for k, v in _decode_scan(buf):
+            fam, key = split_fkey(k)
+            yield fam, key, v
+
     def compact(self) -> None:
         rc = self._lib.kvlog_compact(self._handle())
         if rc != 0:

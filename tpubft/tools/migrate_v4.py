@@ -9,7 +9,7 @@ the tool re-derives them and reports both heads.
 
 Usage:
   python -m tpubft.tools.migrate_v4 --src DB --dst DB \
-      --from categorized --to v4 [--verify]
+      --from categorized --to v4 [--no-verify]
 """
 from __future__ import annotations
 
@@ -61,7 +61,9 @@ def main() -> int:
     ap.add_argument("--dst", required=True)
     ap.add_argument("--from", dest="src_version", default="categorized")
     ap.add_argument("--to", dest="dst_version", default="v4")
-    ap.add_argument("--verify", action="store_true", default=True)
+    ap.add_argument("--no-verify", dest="verify", action="store_false",
+                    default=True,
+                    help="skip the full second read-and-compare pass")
     args = ap.parse_args()
     from tpubft.kvbc.replica import open_db
     migrate(open_db(args.src), open_db(args.dst),
