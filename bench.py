@@ -69,26 +69,39 @@ def main() -> None:
         n_base += 1
     cpu_rate = n_base / (time.perf_counter() - t0)
 
-    # ---- batched kernel ----
+    # ---- batched kernels: fused Pallas (TPU) vs XLA formulation ----
     batch = 16384
     items = [(msgs[i % 512], sigs[i % 512], pk) for i in range(batch)]
     prep = ops.prepare_batch(items)
     args = (prep.s_win, prep.h_win, prep.a_y, prep.a_sign,
             prep.r_y, prep.r_sign)
-    out = ops.verify_kernel(*args)
-    out.block_until_ready()                       # compile
-    assert bool(out.all()), "kernel rejected valid signatures"
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = ops.verify_kernel(*args)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / reps
-    tpu_rate = batch / dt
+
+    def measure(kernel) -> float:
+        out = kernel(*args)
+        out.block_until_ready()                   # compile
+        assert bool(out.all()), "kernel rejected valid signatures"
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = kernel(*args)
+        out.block_until_ready()
+        return batch / ((time.perf_counter() - t0) / reps)
+
+    candidates = {}
+    if use_default_platform and jax.devices()[0].platform != "cpu":
+        # the Mosaic kernel only compiles on real TPU hardware
+        try:
+            from tpubft.ops import ed25519_pallas as opsp
+            candidates["pallas-fused"] = measure(opsp.verify_kernel)
+        except Exception:
+            pass
+    candidates["xla"] = measure(ops.verify_kernel)
+    best = max(candidates, key=candidates.get)
+    tpu_rate = candidates[best]
 
     print(json.dumps({
-        "metric": "ed25519-verifies/sec (batch=%d, %s)" % (
-            batch, jax.devices()[0].platform),
+        "metric": "ed25519-verifies/sec (batch=%d, %s, %s)" % (
+            batch, jax.devices()[0].platform, best),
         "value": round(tpu_rate, 1),
         "unit": "verifies/sec",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),

@@ -37,7 +37,12 @@ def main() -> None:
              "--f", "1", "--base-port", str(base_port),
              "--ops", "60", "--concurrency", "2"],
             env=env, capture_output=True, text=True, timeout=120)
-        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        lines = out.stdout.strip().splitlines()
+        if not lines:
+            raise SystemExit(
+                f"tester_client produced no output (rc={out.returncode}):\n"
+                f"{out.stderr.strip()[-2000:]}")
+        summary = json.loads(lines[-1])
         print(json.dumps(summary, indent=2))
         assert summary["ok"], "workload checks failed"
     finally:

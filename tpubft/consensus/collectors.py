@@ -99,59 +99,36 @@ class CertBatchVerifier:
 
     def __init__(self, post: Callable[[object, bool], None],
                  flush_us: int = 500, max_batch: int = 64):
+        from tpubft.utils.batcher import FlushBatcher
         self._post = post              # (cookie, ok) -> None
-        self._flush_s = flush_us / 1e6
-        self._max_batch = max_batch
-        self._pending: List[Tuple[object, bytes, bytes, object]] = []
-        self._wake = threading.Condition(threading.Lock())
-        self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="cert-batch-verify")
-        self._thread.start()
+        self._batcher = FlushBatcher(
+            self._drain, batch_size=max_batch, flush_us=flush_us,
+            on_drop=lambda item: self._post(item[3], False),
+            name="cert-batch-verify")
 
     def submit(self, verifier, digest: bytes, sig: bytes,
                cookie) -> None:
-        with self._wake:
-            self._pending.append((verifier, digest, sig, cookie))
-            # wake the worker's IDLE wait (empty -> non-empty) or a full
-            # batch; submits landing during the flush-window wait must
-            # NOT cut the window short, or batches collapse to ~2 items
-            if len(self._pending) == 1 \
-                    or len(self._pending) >= self._max_batch:
-                self._wake.notify()
+        self._batcher.submit((verifier, digest, sig, cookie))
 
-    def _run(self) -> None:
-        while self._running:
-            with self._wake:
-                if not self._pending:
-                    self._wake.wait(timeout=0.05)
-                    continue
-                if len(self._pending) < self._max_batch:
-                    self._wake.wait(timeout=self._flush_s)
-                batch, self._pending = self._pending, []
-            by_verifier: Dict[int, List[int]] = {}
-            for i, (v, _, _, _) in enumerate(batch):
-                by_verifier.setdefault(id(v), []).append(i)
-            for idxs in by_verifier.values():
-                verifier = batch[idxs[0]][0]
-                items = [(batch[i][1], batch[i][2]) for i in idxs]
-                try:
-                    verdicts = verifier.verify_batch_certs(items)
-                except Exception:  # noqa: BLE001 — failure = reject batch
-                    from tpubft.utils.logging import get_logger
-                    get_logger("collectors").exception(
-                        "cert batch verify raised")
-                    verdicts = [False] * len(items)
-                for i, ok in zip(idxs, verdicts):
-                    self._post(batch[i][3], bool(ok))
+    def _drain(self, batch) -> None:
+        by_verifier: Dict[int, List[int]] = {}
+        for i, (v, _, _, _) in enumerate(batch):
+            by_verifier.setdefault(id(v), []).append(i)
+        for idxs in by_verifier.values():
+            verifier = batch[idxs[0]][0]
+            items = [(batch[i][1], batch[i][2]) for i in idxs]
+            try:
+                verdicts = verifier.verify_batch_certs(items)
+            except Exception:  # noqa: BLE001 — failure = reject batch
+                from tpubft.utils.logging import get_logger
+                get_logger("collectors").exception(
+                    "cert batch verify raised")
+                verdicts = [False] * len(items)
+            for i, ok in zip(idxs, verdicts):
+                self._post(batch[i][3], bool(ok))
 
     def stop(self) -> None:
-        self._running = False
-        with self._wake:
-            self._wake.notify()
-        self._thread.join(timeout=2)
-        for _, _, _, cookie in self._pending:
-            self._post(cookie, False)
+        self._batcher.stop()
 
 
 class CollectorPool:

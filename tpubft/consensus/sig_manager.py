@@ -169,16 +169,23 @@ class SigManager:
             if entry is None:
                 return None
             pk, rotated_at, rotation_seq = entry
-            if rotation_seq is None \
-                    and time.monotonic() - rotated_at > self.GRACE_WINDOW_S:
-                # the leaked/old key must stop verifying — that's the
-                # point of rotating. Seq-scoped rotations expire by
-                # checkpoint era (on_stable) instead of wall clock.
+            expired_wallclock = (time.monotonic() - rotated_at
+                                 > self.GRACE_WINDOW_S)
+            if rotation_seq is None and expired_wallclock:
+                # no seqnum scope exists: the wall clock is the only
+                # bound, and past it the leaked/old key must stop
+                # verifying — that's the point of rotating
                 self._prev_pubkeys.pop(principal, None)
                 self._prev_verifiers.pop(principal, None)
                 return None
             if seq is None:
-                if not view_scoped:
+                # view-change-family messages have no seqnum to scope by,
+                # so the wall clock ALWAYS bounds them — a sustained view
+                # change (no checkpoints stabilizing, on_stable never
+                # firing) must not let a leaked key authenticate
+                # view-scoped traffic indefinitely. The entry itself
+                # survives for seq-scoped lookups until on_stable.
+                if not view_scoped or expired_wallclock:
                     return None
             elif rotation_seq is not None \
                     and seq > rotation_seq + self.grace_seq_window:
@@ -306,47 +313,22 @@ class BatchVerifier:
 
     def __init__(self, sig_manager: SigManager, batch_size: int = 256,
                  flush_us: int = 200):
+        from tpubft.utils.batcher import FlushBatcher
         self._sm = sig_manager
-        self._batch_size = batch_size
-        self._flush_s = flush_us / 1e6
-        self._pending: List[Tuple[int, bytes, bytes, PendingVerdict]] = []
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
-        self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="batch-verifier")
-        self._thread.start()
+        self._batcher = FlushBatcher(
+            self._drain, batch_size=batch_size, flush_us=flush_us,
+            on_drop=lambda item: item[3].set(False),  # waiters must not hang
+            name="batch-verifier")
 
     def submit(self, principal: int, data: bytes, sig: bytes) -> PendingVerdict:
         verdict = PendingVerdict()
-        with self._wake:
-            self._pending.append((principal, data, sig, verdict))
-            # wake only on empty -> non-empty or a full batch: waking the
-            # flush-window wait on every submit collapses batches
-            if len(self._pending) == 1 \
-                    or len(self._pending) >= self._batch_size:
-                self._wake.notify()
+        self._batcher.submit((principal, data, sig, verdict))
         return verdict
 
-    def _run(self) -> None:
-        while self._running:
-            with self._wake:
-                if not self._pending:
-                    self._wake.wait(timeout=0.05)
-                    continue
-                # flush window: wait briefly for the batch to fill
-                if len(self._pending) < self._batch_size:
-                    self._wake.wait(timeout=self._flush_s)
-                batch, self._pending = self._pending, []
-            verdicts = self._sm.verify_batch([(p, d, s) for p, d, s, _ in batch])
-            for (_, _, _, v), ok in zip(batch, verdicts):
-                v.set(ok)
+    def _drain(self, batch) -> None:
+        verdicts = self._sm.verify_batch([(p, d, s) for p, d, s, _ in batch])
+        for (_, _, _, v), ok in zip(batch, verdicts):
+            v.set(ok)
 
     def stop(self) -> None:
-        self._running = False
-        with self._wake:
-            self._wake.notify()
-        self._thread.join(timeout=2)
-        # fail any stragglers so waiters don't hang
-        for _, _, _, v in self._pending:
-            v.set(False)
+        self._batcher.stop()

@@ -46,28 +46,37 @@ def create_snapshot(db: IDBClient, path: str,
                     ) -> dict:
     """Stream the store into `path` (atomic: tmp + rename). Returns the
     manifest."""
-    h = hashlib.sha256()
+    # streamed, O(1) memory: records spill to a spool file first (the
+    # entry count must precede them in the final layout), then the final
+    # file is assembled chunk-wise with an incremental digest — a multi-GB
+    # ledger never materializes in RAM
     count = 0
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
+    dirname = os.path.dirname(path) or "."
+    sfd, spool = tempfile.mkstemp(dir=dirname)
+    fd, tmp = tempfile.mkstemp(dir=dirname)
     try:
-        with os.fdopen(fd, "wb") as out:
-            body = []
+        with os.fdopen(sfd, "wb") as sp:
             for fam, key, val in db.scan_all():
                 if any(fam.startswith(e) for e in exclude):
                     continue
                 if filter_fn is not None and not filter_fn(fam):
                     continue
-                body.append(_rec(fam, key, val))
+                sp.write(_rec(fam, key, val))
                 count += 1
-            manifest = {"version": 1, "head_block": head_block,
-                        "state_digest": state_digest.hex(),
-                        "entries": count}
+        manifest = {"version": 1, "head_block": head_block,
+                    "state_digest": state_digest.hex(),
+                    "entries": count}
+        h = hashlib.sha256()
+        with os.fdopen(fd, "wb") as out, open(spool, "rb") as sp:
             header = MAGIC + json.dumps(manifest).encode() + b"\n"
             out.write(header)
             h.update(header)
-            for rec in body:
-                out.write(rec)
-                h.update(rec)
+            while True:
+                chunk = sp.read(1 << 20)
+                if not chunk:
+                    break
+                out.write(chunk)
+                h.update(chunk)
             out.write(h.digest())
             out.flush()
             os.fsync(out.fileno())
@@ -76,6 +85,9 @@ def create_snapshot(db: IDBClient, path: str,
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    finally:
+        if os.path.exists(spool):
+            os.unlink(spool)
     return manifest
 
 
@@ -89,40 +101,54 @@ def read_manifest(path: str) -> dict:
 
 def restore_snapshot(path: str, db: IDBClient,
                      batch_entries: int = 1024) -> dict:
-    """Verify integrity, then populate `db` (must be empty of the
-    snapshot's families). Returns the manifest."""
-    with open(path, "rb") as f:
-        data = f.read()
-    if not data.startswith(MAGIC):
-        raise SnapshotError("not a tpubft snapshot")
-    if len(data) < 32:
+    """Stream-verify integrity while populating `db` (must be empty of
+    the snapshot's families) — two sequential passes over the file, O(1)
+    memory. Returns the manifest.
+
+    The digest is checked in a FIRST full pass before any write reaches
+    the DB, so a corrupt snapshot never leaves a half-restored store."""
+    size = os.path.getsize(path)
+    if size < len(MAGIC) + 32:
         raise SnapshotError("truncated snapshot")
-    body, tail = data[:-32], data[-32:]
-    if hashlib.sha256(body).digest() != tail:
-        raise SnapshotError("snapshot integrity check failed")
-    nl = body.index(b"\n", len(MAGIC))
-    manifest = json.loads(body[len(MAGIC):nl].decode())
-    off = nl + 1
-    wb = WriteBatch()
-    seen = 0
-    while off < len(body):
-        if off + 10 > len(body):
-            raise SnapshotError("corrupt record header")
-        fl, kl, vl = struct.unpack_from("<HII", body, off)
-        off += 10
-        if off + fl + kl + vl > len(body):
-            raise SnapshotError("corrupt record body")
-        fam = body[off:off + fl]
-        off += fl
-        key = body[off:off + kl]
-        off += kl
-        val = body[off:off + vl]
-        off += vl
-        wb.put(key, val, fam)
-        seen += 1
-        if len(wb) >= batch_entries:
-            db.write(wb)
-            wb = WriteBatch()
+    body_len = size - 32
+    # pass 1: integrity
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise SnapshotError("not a tpubft snapshot")
+        h.update(magic)
+        remaining = body_len - len(MAGIC)
+        while remaining:
+            chunk = f.read(min(1 << 20, remaining))
+            if not chunk:
+                raise SnapshotError("truncated snapshot")
+            h.update(chunk)
+            remaining -= len(chunk)
+        if f.read(32) != h.digest():
+            raise SnapshotError("snapshot integrity check failed")
+    # pass 2: restore
+    with open(path, "rb") as f:
+        f.read(len(MAGIC))
+        manifest = json.loads(f.readline().decode())
+        wb = WriteBatch()
+        seen = 0
+
+        def need(n: int) -> bytes:
+            if f.tell() + n > body_len:
+                raise SnapshotError("corrupt record")
+            return f.read(n)
+
+        while f.tell() < body_len:
+            fl, kl, vl = struct.unpack("<HII", need(10))
+            fam = need(fl)
+            key = need(kl)
+            val = need(vl)
+            wb.put(key, val, fam)
+            seen += 1
+            if len(wb) >= batch_entries:
+                db.write(wb)
+                wb = WriteBatch()
     if len(wb):
         db.write(wb)
     if seen != manifest["entries"]:

@@ -66,7 +66,13 @@ def msm_kernel(bits: jnp.ndarray, px: jnp.ndarray, py: jnp.ndarray,
 
 def msm(points: Sequence, scalars: Sequence[int]):
     """Host-facing MSM: G1 affine int points + int scalars -> affine point.
-    Drop-in for the reference fastMultExp (FastMultExp.cpp:27-59)."""
+    Drop-in for the reference fastMultExp (FastMultExp.cpp:27-59).
+    Multi-device hosts shard the points over the mesh (each device
+    ladders its shard; one tiny all_gather combines — SURVEY §5.7)."""
+    import jax
+    if len(jax.devices()) > 1 and len(points) >= 2 * len(jax.devices()):
+        from tpubft.parallel.sharding import sharded_msm
+        return sharded_msm(points, scalars)
     cv = g1_curve()
     n = len(points)
     if n == 0:

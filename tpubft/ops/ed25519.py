@@ -371,12 +371,33 @@ def _use_pallas() -> bool:
         return False
 
 
+@functools.lru_cache(maxsize=1)
+def _mesh_kernel():
+    """Multi-device data-parallel verify (None on single-device hosts).
+    This is how the product batch path scales across chips: the batch
+    axis shards over the mesh, no cross-device traffic (SURVEY §5.7 —
+    the sharded kernels are the same ones dryrun_multichip validates).
+    On TPU meshes each device runs the fused Pallas kernel on its shard."""
+    import jax
+    if len(jax.devices()) <= 1:
+        return None
+    from tpubft.parallel.sharding import (make_mesh, sharded_verify_ed25519,
+                                          verify_pad_multiple)
+    mesh = make_mesh()
+    return verify_pad_multiple(mesh), sharded_verify_ed25519(mesh)
+
+
 def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
     """End-to-end batched verify: (msg, sig, pk) triples → bool array."""
     if not items:
         return np.zeros(0, bool)
     n = len(items)
-    if _use_pallas():
+    meshed = _mesh_kernel()
+    if meshed is not None:
+        d, kernel = meshed
+        m = _pad_to_class(n)
+        m = ((m + d - 1) // d) * d      # batch must split over the mesh
+    elif _use_pallas():
         from tpubft.ops import ed25519_pallas
         kernel = ed25519_pallas.verify_kernel
         # the fused kernel tiles the batch in TILE-lane grid steps

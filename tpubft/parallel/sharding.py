@@ -57,17 +57,40 @@ def sharded_msm_kernel(mesh: Mesh):
 
 
 def sharded_verify_ed25519(mesh: Mesh):
-    """Data-parallel batched Ed25519 verify: every input sharded on batch."""
+    """Data-parallel batched Ed25519 verify: every input sharded on
+    batch. On TPU platforms each device runs the FUSED Pallas kernel on
+    its shard (the fast single-chip path must not be lost by going
+    multi-chip); elsewhere the XLA formulation."""
     from tpubft.ops import ed25519 as ops
 
-    def fn(s_win, h_win, a_y, a_sign, r_y, r_sign):
-        return ops.verify_kernel(s_win, h_win, a_y, a_sign, r_y, r_sign)
+    if ops._use_pallas():
+        from tpubft.ops import ed25519_pallas as pk
+        kernel = pk.verify_kernel
+        per_device_multiple = pk.TILE
+    else:
+        kernel = ops.verify_kernel
+        per_device_multiple = 1
 
+    def fn(s_win, h_win, a_y, a_sign, r_y, r_sign):
+        return kernel(s_win, h_win, a_y, a_sign, r_y, r_sign)
+
+    del per_device_multiple           # callers pad via verify_pad_multiple
     batch_last = NamedSharding(mesh, P(None, AXIS))
     batch_only = NamedSharding(mesh, P(AXIS))
     return jax.jit(fn, in_shardings=(batch_last, batch_last, batch_last,
                                      batch_only, batch_last, batch_only),
                    out_shardings=batch_only)
+
+
+def verify_pad_multiple(mesh: Mesh) -> int:
+    """Batch-size multiple the sharded verify needs: devices × (the
+    per-device Pallas tile on TPU, 1 on other platforms)."""
+    from tpubft.ops import ed25519 as ops
+    per_dev = 1
+    if ops._use_pallas():
+        from tpubft.ops import ed25519_pallas as pk
+        per_dev = pk.TILE
+    return mesh.devices.size * per_dev
 
 
 def sharded_msm(points: Sequence, scalars: Sequence[int],

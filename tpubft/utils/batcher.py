@@ -1,0 +1,72 @@
+"""FlushBatcher — the one batching dispatcher both verification seams use.
+
+Accumulates submitted items and hands the worker thread a whole batch:
+flush happens when the batch fills OR the flush window after the first
+item elapses (latency-bounded). The wake discipline matters: the worker
+is notified on the empty→non-empty transition and on a full batch ONLY —
+waking it on every submit would cut the flush window short and collapse
+batches to ~2 items under steady arrival (the device/pairing batch then
+never amortizes).
+
+Consumers: SigManager.BatchVerifier (cross-message device signature
+batches) and collectors.CertBatchVerifier (aggregated combined-cert
+pairing checks).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+
+class FlushBatcher(Generic[T]):
+    def __init__(self, drain: Callable[[List[T]], None],
+                 batch_size: int = 64, flush_us: int = 500,
+                 on_drop: Callable[[T], None] = None,
+                 name: str = "flush-batcher"):
+        self._drain = drain
+        self._batch_size = batch_size
+        self._flush_s = flush_us / 1e6
+        self._on_drop = on_drop
+        self._pending: List[T] = []
+        self._wake = threading.Condition(threading.Lock())
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def submit(self, item: T) -> None:
+        with self._wake:
+            self._pending.append(item)
+            if len(self._pending) == 1 \
+                    or len(self._pending) >= self._batch_size:
+                self._wake.notify()
+
+    def _run(self) -> None:
+        while self._running:
+            with self._wake:
+                if not self._pending:
+                    self._wake.wait(timeout=0.05)
+                    continue
+                # flush window: wait once for the batch to fill; submits
+                # during this wait do not re-notify (len > 1)
+                if len(self._pending) < self._batch_size:
+                    self._wake.wait(timeout=self._flush_s)
+                batch, self._pending = self._pending, []
+            try:
+                self._drain(batch)
+            except Exception:  # noqa: BLE001 — a bad batch must not kill
+                from tpubft.utils.logging import get_logger
+                get_logger("batcher").exception("drain raised (%s)",
+                                                self._thread.name)
+
+    def stop(self) -> None:
+        self._running = False
+        with self._wake:
+            self._wake.notify()
+        self._thread.join(timeout=2)
+        if self._on_drop is not None:
+            for item in self._pending:
+                self._on_drop(item)
+        self._pending = []

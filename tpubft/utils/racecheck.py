@@ -115,7 +115,14 @@ class CheckedLock:
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._lock.acquire(blocking, timeout)
         if ok:
-            _checker.on_acquire(self._name)
+            try:
+                _checker.on_acquire(self._name)
+            except LockOrderViolation:
+                # report the POTENTIAL deadlock without creating a real
+                # one: the underlying lock must not stay held by a thread
+                # that unwound past its release
+                self._lock.release()
+                raise
         return ok
 
     def release(self) -> None:
@@ -167,9 +174,10 @@ class StallWatchdog:
             self._reported.discard(name)
 
     def start(self) -> None:
-        if self._running:
-            return
-        self._running = True
+        with self._mu:
+            if self._running:
+                return
+            self._running = True
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="stall-watchdog")
         self._thread.start()
