@@ -180,6 +180,28 @@ def test_tpu_backend_process_cluster(tmp_path):
         net.wait_for(lambda: (net.last_executed(1) or 0) >= 3, timeout=40)
 
 
+def test_config5_ecdsa_bls_tls_view_change_storm(tmp_path):
+    """BASELINE config 5 end-to-end: ECDSA-P256 client authentication +
+    BLS threshold commit certificates + pinned-cert TLS transport, under
+    a view-change storm (two consecutive primaries killed mid-stream).
+    Real replica OS processes, real TLS sockets."""
+    with BftTestNetwork(f=1, db_dir=str(tmp_path), transport="tls",
+                        threshold_scheme="threshold-bls",
+                        client_sig_scheme="ecdsa-p256",
+                        view_change_timeout_ms=2000) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"v0", b"1", timeout_ms=20000)
+        net.kill_replica(0)               # storm part 1: depose view 0
+        assert _commit(kv, b"v1", b"2", timeout_ms=40000)
+        net.kill_replica(1)               # storm part 2: depose view 1+
+        # f=1 tolerates one fault at a time: bring 0 back as a backup
+        net.start_replica(0)
+        net.wait_for_replicas_up(replicas=[0], timeout=30)
+        assert _commit(kv, b"v2", b"3", timeout_ms=60000)
+        assert kv.read([b"v0", b"v1", b"v2"], timeout_ms=20000) == {
+            b"v0": b"1", b"v1": b"2", b"v2": b"3"}
+
+
 def test_lossy_cluster_30pct_commits(tmp_path):
     """30% uniform loss injected at every replica (both directions, via
     the fault plane, not the transport): retransmissions must still drive

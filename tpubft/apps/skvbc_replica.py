@@ -33,7 +33,9 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
                         pre_execution_enabled=args.pre_execution,
                         checkpoint_window_size=args.checkpoint_window,
                         work_window_size=args.work_window,
-                        kvbc_version=args.kvbc_version)
+                        kvbc_version=args.kvbc_version,
+                        threshold_scheme=args.threshold_scheme,
+                        client_sig_scheme=args.client_sig_scheme)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()).for_node(args.replica)
     from tpubft.consensus.replicas_info import ReplicasInfo
@@ -90,6 +92,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--work-window", type=int, default=300)
     p.add_argument("--kvbc-version", default="categorized",
                    choices=("categorized", "v4"))
+    p.add_argument("--threshold-scheme", default="multisig-ed25519")
+    p.add_argument("--client-sig-scheme", default="ed25519")
     return p
 
 

@@ -53,7 +53,7 @@ def create_snapshot(db: IDBClient, path: str,
     count = 0
     dirname = os.path.dirname(path) or "."
     sfd, spool = tempfile.mkstemp(dir=dirname)
-    fd, tmp = tempfile.mkstemp(dir=dirname)
+    tmp = None
     try:
         with os.fdopen(sfd, "wb") as sp:
             for fam, key, val in db.scan_all():
@@ -67,6 +67,7 @@ def create_snapshot(db: IDBClient, path: str,
                     "state_digest": state_digest.hex(),
                     "entries": count}
         h = hashlib.sha256()
+        fd, tmp = tempfile.mkstemp(dir=dirname)
         with os.fdopen(fd, "wb") as out, open(spool, "rb") as sp:
             header = MAGIC + json.dumps(manifest).encode() + b"\n"
             out.write(header)
@@ -82,7 +83,7 @@ def create_snapshot(db: IDBClient, path: str,
             os.fsync(out.fileno())
         os.replace(tmp, path)
     except BaseException:
-        if os.path.exists(tmp):
+        if tmp is not None and os.path.exists(tmp):
             os.unlink(tmp)
         raise
     finally:

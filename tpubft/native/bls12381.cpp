@@ -387,7 +387,22 @@ static void fp12_mul(Fp12& r, const Fp12& a, const Fp12& b) {
     r.c0 = c0;
 }
 
-static void fp12_sqr(Fp12& r, const Fp12& a) { fp12_mul(r, a, a); }
+static void fp12_sqr(Fp12& r, const Fp12& a) {
+    // complex squaring: 2 Fp6 muls instead of fp12_mul's 3 —
+    // (c0 + c1 w)^2 with w^2 = v:
+    //   c0' = c0^2 + v c1^2 = (c0+c1)(c0+v c1) - (1+v) c0 c1
+    //   c1' = 2 c0 c1
+    Fp6 t0, t1, t2, vt0;
+    fp6_mul(t0, a.c0, a.c1);
+    fp6_add(t1, a.c0, a.c1);
+    fp6_mul_v(t2, a.c1);
+    fp6_add(t2, t2, a.c0);
+    fp6_mul(t1, t1, t2);
+    fp6_sub(t1, t1, t0);
+    fp6_mul_v(vt0, t0);
+    fp6_sub(r.c0, t1, vt0);
+    fp6_add(r.c1, t0, t0);
+}
 
 static void fp12_conj(Fp12& r, const Fp12& a) {
     r.c0 = a.c0;
@@ -438,12 +453,64 @@ static void fp12_frob2(Fp12& r, const Fp12& a) {
     fp2_mul_fp(r.c1.c2, a.c1.c2, G2C[5].c0);
 }
 
+// Granger-Scott squaring for elements of the cyclotomic subgroup
+// G_{Phi6(p^2)} (everything after the easy part of the final
+// exponentiation lives there): 9 Fp2 squarings instead of full
+// fp12_sqr's 12 Fp2 multiplications — the dominant cost of pow_x.
+static void fp12_cyc_sqr(Fp12& z, const Fp12& x) {
+    Fp2 t0, t1, t2, t3, t4, t5, t6, t7, t8, u;
+    fp2_sqr(t0, x.c1.c1);
+    fp2_sqr(t1, x.c0.c0);
+    fp2_add(t6, x.c1.c1, x.c0.c0);
+    fp2_sqr(t6, t6);
+    fp2_sub(t6, t6, t0);
+    fp2_sub(t6, t6, t1);                  // 2 x00 x11
+    fp2_sqr(t2, x.c0.c2);
+    fp2_sqr(t3, x.c1.c0);
+    fp2_add(t7, x.c0.c2, x.c1.c0);
+    fp2_sqr(t7, t7);
+    fp2_sub(t7, t7, t2);
+    fp2_sub(t7, t7, t3);                  // 2 x02 x10
+    fp2_sqr(t4, x.c1.c2);
+    fp2_sqr(t5, x.c0.c1);
+    fp2_add(t8, x.c1.c2, x.c0.c1);
+    fp2_sqr(t8, t8);
+    fp2_sub(t8, t8, t4);
+    fp2_sub(t8, t8, t5);
+    fp2_mul_xi(t8, t8);                   // 2 x01 x12 xi
+    fp2_mul_xi(u, t0);
+    fp2_add(t0, u, t1);                   // xi x11^2 + x00^2
+    fp2_mul_xi(u, t2);
+    fp2_add(t2, u, t3);                   // xi x02^2 + x10^2
+    fp2_mul_xi(u, t4);
+    fp2_add(t4, u, t5);                   // xi x12^2 + x01^2
+    fp2_sub(u, t0, x.c0.c0);
+    fp2_add(u, u, u);
+    fp2_add(z.c0.c0, u, t0);
+    fp2_sub(u, t2, x.c0.c1);
+    fp2_add(u, u, u);
+    fp2_add(z.c0.c1, u, t2);
+    fp2_sub(u, t4, x.c0.c2);
+    fp2_add(u, u, u);
+    fp2_add(z.c0.c2, u, t4);
+    fp2_add(u, t8, x.c1.c0);
+    fp2_add(u, u, u);
+    fp2_add(z.c1.c0, u, t8);
+    fp2_add(u, t6, x.c1.c1);
+    fp2_add(u, u, u);
+    fp2_add(z.c1.c1, u, t6);
+    fp2_add(u, t7, x.c1.c2);
+    fp2_add(u, u, u);
+    fp2_add(z.c1.c2, u, t7);
+}
+
 // m^x for the curve parameter x (negative): conj(m^|x|); cyclotomic
-// subgroup makes conj the inverse
+// subgroup makes conj the inverse and enables Granger-Scott squaring
+// (pow_x is only ever applied after the easy part)
 static void fp12_pow_x(Fp12& r, const Fp12& m) {
     Fp12 result = m;                      // consume the msb implicitly
     for (int i = 62; i >= 0; i--) {
-        fp12_sqr(result, result);
+        fp12_cyc_sqr(result, result);
         if ((X_ABS >> i) & 1) fp12_mul(result, result, m);
     }
     fp12_conj(r, result);
@@ -458,43 +525,61 @@ static void fp12_pow_x(Fp12& r, const Fp12& m) {
 struct G1A { Fp x, y; bool inf; };
 struct G2A { Fp2 x, y; bool inf; };
 
-static void line_eval(Fp12& l, const Fp2& lam, const Fp2& x1, const Fp2& y1,
+// A line is sparse in the w-power basis: only w^0 (c0.c0 = A),
+// w^2 (c0.c1 = B) and w^3 (c1.c1 = C) are nonzero.
+struct Line { Fp2 A, B, C; };
+
+static void line_eval(Line& l, const Fp2& lam, const Fp2& x1, const Fp2& y1,
                       const Fp& xp, const Fp& yp) {
-    memset(&l, 0, sizeof(l));
     Fp2 t;
     fp2_mul(t, lam, x1);
-    fp2_sub(l.c0.c0, t, y1);
+    fp2_sub(l.A, t, y1);
     fp2_mul_fp(t, lam, xp);
-    fp2_neg(l.c0.c1, t);
-    l.c1.c1.c0 = yp;
-    l.c1.c1.c1 = FP_ZERO_C;
+    fp2_neg(l.B, t);
+    l.C.c0 = yp;
+    l.C.c1 = FP_ZERO_C;
 }
 
-static void line_vertical(Fp12& l, const Fp2& x1, const Fp& xp) {
-    memset(&l, 0, sizeof(l));
-    fp2_neg(l.c0.c0, x1);
-    l.c0.c1.c0 = xp;
-    l.c0.c1.c1 = FP_ZERO_C;
+
+// a * (b0 + b1 v) over Fp6 — the sparse2 shape both line products need
+static void fp6_mul_sparse2(Fp6& r, const Fp6& a, const Fp2& b0,
+                            const Fp2& b1) {
+    Fp2 t, u, c0, c1, c2;
+    fp2_mul(t, a.c2, b1);
+    fp2_mul_xi(t, t);
+    fp2_mul(u, a.c0, b0);
+    fp2_add(c0, u, t);
+    fp2_mul(t, a.c0, b1);
+    fp2_mul(u, a.c1, b0);
+    fp2_add(c1, t, u);
+    fp2_mul(t, a.c1, b1);
+    fp2_mul(u, a.c2, b0);
+    fp2_add(c2, t, u);
+    r.c0 = c0; r.c1 = c1; r.c2 = c2;
 }
 
-// Montgomery batch inversion over Fp2: ONE field inversion for n
-// denominators (the classic prefix-product trick) — inversions dominate
-// an affine Miller loop, and the lockstep multi-pair loop below shares
-// one per step across all pairs.
-static void fp2_batch_inv(Fp2* vals, int n) {
-    if (n == 0) return;
-    Fp2 prefix[17];
-    prefix[0] = FP2_ONE_C;
-    for (int i = 0; i < n; i++) fp2_mul(prefix[i + 1], prefix[i], vals[i]);
-    Fp2 inv_all;
-    fp2_inv(inv_all, prefix[n]);
-    for (int i = n - 1; i >= 0; i--) {
-        Fp2 vi;
-        fp2_mul(vi, inv_all, prefix[i]);
-        fp2_mul(inv_all, inv_all, vals[i]);
-        vals[i] = vi;
-    }
+// f *= line: 15 Fp2 muls instead of fp12_mul's 18 (line.c0 = A + B v,
+// line.c1 = C v)
+static void fp12_mul_line(Fp12& f, const Line& l) {
+    Fp6 t0, t1, cross, vt1;
+    // t1 = f.c1 * (C v): c0 = xi a2 C, c1 = a0 C, c2 = a1 C
+    Fp2 u;
+    fp2_mul(u, f.c1.c2, l.C);
+    fp2_mul_xi(t1.c0, u);
+    fp2_mul(t1.c1, f.c1.c0, l.C);
+    fp2_mul(t1.c2, f.c1.c1, l.C);
+    fp6_mul_sparse2(t0, f.c0, l.A, l.B);
+    Fp6 s;
+    fp6_add(s, f.c0, f.c1);
+    Fp2 bc;
+    fp2_add(bc, l.B, l.C);
+    fp6_mul_sparse2(cross, s, l.A, bc);
+    fp6_sub(cross, cross, t0);
+    fp6_sub(f.c1, cross, t1);
+    fp6_mul_v(vt1, t1);
+    fp6_add(f.c0, t0, vt1);
 }
+
 
 // Lockstep multi-Miller: computes f = prod_i f_{|x|,Q_i}(P_i) directly
 // (what pairing_check needs), batching each step's denominators into a
@@ -504,86 +589,120 @@ static void fp2_batch_inv(Fp2* vals, int n) {
 // malformed point must never produce an arbitrary verdict.
 static const int MAX_PAIRS = 16;
 
+// Homogeneous projective Miller loop: the affine version paid one Fp2
+// (=Fp) inversion PER ITERATION (~570 muls each, ~63 of them — the
+// dominant cost of a pairing); projective T and polynomial line
+// coefficients eliminate every inversion. Lines are scaled freely by
+// Fp2 factors — the easy part of the final exponentiation kills any
+// Fp2 scalar (c^(p^6-1) = 1 for c in Fp2), so verdicts are unchanged.
 static bool multi_miller(Fp12& f, const G2A* qs, const G1A* ps, int n) {
-    Fp2 tx[MAX_PAIRS], ty[MAX_PAIRS];
-    bool live[MAX_PAIRS], t_inf[MAX_PAIRS];
+    Fp2 TX[MAX_PAIRS], TY[MAX_PAIRS], TZ[MAX_PAIRS];
+    bool live[MAX_PAIRS];
     for (int k = 0; k < n; k++) {
         live[k] = !(qs[k].inf || ps[k].inf);
-        t_inf[k] = false;
-        if (live[k]) { tx[k] = qs[k].x; ty[k] = qs[k].y; }
+        if (live[k]) {
+            TX[k] = qs[k].x;
+            TY[k] = qs[k].y;
+            TZ[k] = FP2_ONE_C;
+        }
     }
     memset(&f, 0, sizeof(f));
     f.c0.c0 = FP2_ONE_C;
-    Fp12 l;
-    Fp2 lam, t0, t1;
-    Fp2 dens[MAX_PAIRS];
-    int idx[MAX_PAIRS];
+    Line l;
+    Fp2 t0, t1, W, S, Bv, H, X2, Y2, S2;
     for (int i = 62; i >= 0; i--) {       // |x| has 64 bits; start msb-1
         fp12_sqr(f, f);
-        // tangent step, all pairs: denominators 2*y_T
-        int m = 0;
         for (int k = 0; k < n; k++) {
-            if (!live[k] || t_inf[k]) continue;
-            fp2_add(dens[m], ty[k], ty[k]);
-            if (fp2_is_zero(dens[m])) return false;   // order-2 point
-            idx[m++] = k;
-        }
-        fp2_batch_inv(dens, m);
-        for (int j = 0; j < m; j++) {
-            int k = idx[j];
-            fp2_sqr(t0, tx[k]);
-            fp2_add(t1, t0, t0);
-            fp2_add(t1, t1, t0);              // 3 x^2
-            fp2_mul(lam, t1, dens[j]);
-            line_eval(l, lam, tx[k], ty[k], ps[k].x, ps[k].y);
-            fp12_mul(f, f, l);
-            Fp2 x3, y3;
-            fp2_sqr(x3, lam);
-            fp2_sub(x3, x3, tx[k]);
-            fp2_sub(x3, x3, tx[k]);
-            fp2_sub(t0, tx[k], x3);
-            fp2_mul(y3, lam, t0);
-            fp2_sub(y3, y3, ty[k]);
-            tx[k] = x3;
-            ty[k] = y3;
+            if (!live[k]) continue;
+            // tangent line at T=(X,Y,Z), scaled by 2YZ^2:
+            //   A = 3X^3 - 2Y^2 Z, B = -3X^2 Z * xP, C = 2YZ^2 * yP
+            fp2_sqr(X2, TX[k]);                   // X^2
+            fp2_add(W, X2, X2);
+            fp2_add(W, W, X2);                    // W = 3X^2
+            fp2_mul(S, TY[k], TZ[k]);             // S = YZ
+            if (fp2_is_zero(S)) return false;     // order-2 / degenerate
+            fp2_sqr(Y2, TY[k]);                   // Y^2
+            fp2_mul(t0, X2, TX[k]);               // X^3
+            fp2_add(l.A, t0, t0);
+            fp2_add(l.A, l.A, t0);                // 3X^3
+            fp2_mul(t1, Y2, TZ[k]);               // Y^2 Z
+            fp2_add(t0, t1, t1);                  // 2Y^2 Z
+            fp2_sub(l.A, l.A, t0);
+            fp2_mul(t0, W, TZ[k]);                // 3X^2 Z
+            fp2_neg(t0, t0);
+            fp2_mul_fp(l.B, t0, ps[k].x);
+            fp2_mul(t0, S, TZ[k]);                // YZ^2
+            fp2_add(t0, t0, t0);                  // 2YZ^2
+            fp2_mul_fp(l.C, t0, ps[k].y);
+            fp12_mul_line(f, l);
+            // projective doubling (a=0): W=3X^2, S=YZ, Bv=XY*S,
+            // H=W^2-8Bv; X'=2HS, Y'=W(4Bv-H)-8(YS)^2, Z'=8S^3
+            fp2_mul(t0, TX[k], TY[k]);
+            fp2_mul(Bv, t0, S);                   // XY*S
+            fp2_sqr(H, W);
+            fp2_add(t0, Bv, Bv);
+            fp2_add(t0, t0, t0);
+            fp2_add(t1, t0, t0);                  // 8Bv
+            fp2_sub(H, H, t1);                    // H = W^2 - 8Bv
+            fp2_mul(t1, H, S);
+            fp2_add(TX[k], t1, t1);               // X' = 2HS
+            fp2_mul(S2, TY[k], S);                // YS
+            fp2_sqr(S2, S2);                      // (YS)^2
+            fp2_sub(t0, t0, H);                   // 4Bv - H
+            fp2_mul(t0, W, t0);
+            fp2_add(t1, S2, S2);
+            fp2_add(t1, t1, t1);
+            fp2_add(t1, t1, t1);                  // 8(YS)^2
+            fp2_sub(TY[k], t0, t1);               // Y'
+            fp2_sqr(t0, S);
+            fp2_mul(t0, t0, S);                   // S^3
+            fp2_add(t0, t0, t0);
+            fp2_add(t0, t0, t0);
+            fp2_add(TZ[k], t0, t0);               // Z' = 8S^3
         }
         if (!((X_ABS >> i) & 1)) continue;
-        // addition step: denominators x_Q - x_T (verticals handled
-        // inline; T==Q — unreachable for r-subgroup inputs inside the
-        // ate loop — would zero the denominator, so REJECT)
-        m = 0;
         for (int k = 0; k < n; k++) {
-            if (!live[k] || t_inf[k]) continue;
-            if (fp2_eq(tx[k], qs[k].x)) {
-                Fp2 sum_y;
-                fp2_add(sum_y, ty[k], qs[k].y);
-                if (fp2_is_zero(sum_y)) {
-                    line_vertical(l, tx[k], ps[k].x);
-                    fp12_mul(f, f, l);
-                    t_inf[k] = true;
-                    continue;
-                }
-                return false;                  // T == Q: non-subgroup input
+            if (!live[k]) continue;
+            // mixed addition T + Q, Q=(x2,y2) affine:
+            //   u = y2 Z - Y, v = x2 Z - X
+            Fp2 u, v, v2, v3, A2;
+            fp2_mul(t0, qs[k].y, TZ[k]);
+            fp2_sub(u, t0, TY[k]);
+            fp2_mul(t0, qs[k].x, TZ[k]);
+            fp2_sub(v, t0, TX[k]);
+            if (fp2_is_zero(v)) {
+                // x_T == x_Q projectively: T == Q (inside the ate loop
+                // only reachable with non-subgroup inputs) or T == -Q;
+                // both REJECT — decompression enforces the subgroup, so
+                // honest inputs never land here
+                return false;
             }
-            fp2_sub(dens[m], qs[k].x, tx[k]);
-            idx[m++] = k;
-        }
-        fp2_batch_inv(dens, m);
-        for (int j = 0; j < m; j++) {
-            int k = idx[j];
-            fp2_sub(t0, qs[k].y, ty[k]);
-            fp2_mul(lam, t0, dens[j]);
-            line_eval(l, lam, tx[k], ty[k], ps[k].x, ps[k].y);
-            fp12_mul(f, f, l);
-            Fp2 x3, y3;
-            fp2_sqr(x3, lam);
-            fp2_sub(x3, x3, tx[k]);
-            fp2_sub(x3, x3, qs[k].x);
-            fp2_sub(t0, tx[k], x3);
-            fp2_mul(y3, lam, t0);
-            fp2_sub(y3, y3, ty[k]);
-            tx[k] = x3;
-            ty[k] = y3;
+            // line through Q and T evaluated at P, scaled by v:
+            //   A = u*x2 - v*y2, B = -u*xP, C = v*yP
+            fp2_mul(t0, u, qs[k].x);
+            fp2_mul(t1, v, qs[k].y);
+            fp2_sub(l.A, t0, t1);
+            fp2_neg(t0, u);
+            fp2_mul_fp(l.B, t0, ps[k].x);
+            fp2_mul_fp(l.C, v, ps[k].y);
+            fp12_mul_line(f, l);
+            // add-1998-cmo-2 mixed addition:
+            //   A2 = u^2 Z - v^3 - 2v^2 X
+            //   X' = v*A2; Y' = u*(v^2 X - A2) - v^3 Y; Z' = v^3 Z
+            fp2_sqr(v2, v);
+            fp2_mul(v3, v2, v);
+            fp2_sqr(t0, u);
+            fp2_mul(t0, t0, TZ[k]);               // u^2 Z
+            fp2_mul(t1, v2, TX[k]);               // v^2 X
+            fp2_sub(A2, t0, v3);
+            fp2_sub(A2, A2, t1);
+            fp2_sub(A2, A2, t1);                  // - 2 v^2 X
+            fp2_mul(TX[k], v, A2);
+            fp2_sub(t1, t1, A2);                  // v^2 X - A2
+            fp2_mul(t0, u, t1);
+            fp2_mul(t1, v3, TY[k]);
+            fp2_sub(TY[k], t0, t1);
+            fp2_mul(TZ[k], v3, TZ[k]);
         }
     }
     Fp12 fc;

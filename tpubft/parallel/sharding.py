@@ -66,15 +66,12 @@ def sharded_verify_ed25519(mesh: Mesh):
     if ops._use_pallas():
         from tpubft.ops import ed25519_pallas as pk
         kernel = pk.verify_kernel
-        per_device_multiple = pk.TILE
     else:
         kernel = ops.verify_kernel
-        per_device_multiple = 1
 
     def fn(s_win, h_win, a_y, a_sign, r_y, r_sign):
         return kernel(s_win, h_win, a_y, a_sign, r_y, r_sign)
 
-    del per_device_multiple           # callers pad via verify_pad_multiple
     batch_last = NamedSharding(mesh, P(None, AXIS))
     batch_only = NamedSharding(mesh, P(AXIS))
     return jax.jit(fn, in_shardings=(batch_last, batch_last, batch_last,
@@ -106,7 +103,10 @@ def sharded_msm(points: Sequence, scalars: Sequence[int],
     if n == 0:
         return None
     d = mesh.devices.size
+    # batch must split evenly over the mesh (non-power-of-two device
+    # counts included)
     m = max(_pad_pow2(n), d)
+    m = ((m + d - 1) // d) * d
     infinity = np.zeros(m, bool)
     pts, ks = [], []
     for i in range(m):

@@ -71,7 +71,10 @@ class BftTestNetwork:
                  crypto_backend: str = "cpu",
                  pre_execution: bool = False,
                  checkpoint_window: int = 150,
-                 work_window: int = 300) -> None:
+                 work_window: int = 300,
+                 transport: str = "udp",
+                 threshold_scheme: str = "multisig-ed25519",
+                 client_sig_scheme: str = "ed25519") -> None:
         self.f, self.c = f, c
         self.n = 3 * f + 2 * c + 1
         self.num_clients = num_clients
@@ -85,6 +88,24 @@ class BftTestNetwork:
         self.pre_execution = pre_execution
         self.checkpoint_window = checkpoint_window
         self.work_window = work_window
+        self.transport = transport
+        self.threshold_scheme = threshold_scheme
+        self.client_sig_scheme = client_sig_scheme
+        self.certs_dir = None
+        if transport == "tls":
+            # pinned-cert material for every principal (replicas +
+            # clients + operator), like keygen --tls-certs
+            assert db_dir, "TLS transport needs db_dir for cert material"
+            from tpubft.comm.tls import generate_tls_material
+            from tpubft.consensus.replicas_info import ReplicasInfo
+            cfg = ReplicaConfig(f_val=f, c_val=c,
+                                num_of_client_proxies=num_clients)
+            op_id = ReplicasInfo.from_config(cfg).operator_id
+            ids = (list(range(self.n))
+                   + list(range(self.n, self.n + num_clients)) + [op_id])
+            self.certs_dir = os.path.join(db_dir, "tls")
+            os.makedirs(self.certs_dir, exist_ok=True)
+            generate_tls_material(self.certs_dir, ids, seed=None)
         self.procs: Dict[int, subprocess.Popen] = {}
         self.paused: set = set()
         self._clients: Dict[int, BftClient] = {}
@@ -118,7 +139,12 @@ class BftTestNetwork:
                 "--fault-port", str(self.fault_base + r),
                 "--crypto-backend", self.crypto_backend,
                 "--checkpoint-window", str(self.checkpoint_window),
-                "--work-window", str(self.work_window)]
+                "--work-window", str(self.work_window),
+                "--threshold-scheme", self.threshold_scheme,
+                "--client-sig-scheme", self.client_sig_scheme,
+                "--transport", self.transport]
+        if self.certs_dir:
+            args += ["--certs-dir", self.certs_dir]
         if self.pre_execution:
             args += ["--pre-execution"]
         if self.db_dir:
@@ -248,18 +274,32 @@ class BftTestNetwork:
     # ------------------------------------------------------------------
     # clients
     # ------------------------------------------------------------------
+    def _node_cfg(self) -> ReplicaConfig:
+        return ReplicaConfig(f_val=self.f, c_val=self.c,
+                             num_of_client_proxies=self.num_clients,
+                             threshold_scheme=self.threshold_scheme,
+                             client_sig_scheme=self.client_sig_scheme)
+
+    def _make_comm(self, node_id: int, eps):
+        if self.transport == "tls":
+            from tpubft.comm import create_communication
+            from tpubft.comm.tls import TlsConfig
+            return create_communication(
+                TlsConfig(self_id=node_id, endpoints=eps,
+                          certs_dir=self.certs_dir), "tls")
+        return PlainUdpCommunication(CommConfig(self_id=node_id,
+                                                endpoints=eps))
+
     def client(self, idx: int = 0, **cfg_kw) -> BftClient:
         client_id = self.n + idx
         cl = self._clients.get(client_id)
         if cl is None:
-            cfg = ReplicaConfig(f_val=self.f, c_val=self.c,
-                                num_of_client_proxies=self.num_clients)
+            cfg = self._node_cfg()
             keys = ClusterKeys.generate(
                 cfg, self.num_clients,
                 seed=self.seed.encode()).for_node(client_id)
             eps = endpoint_table(self.base_port, self.n, self.num_clients)
-            comm = PlainUdpCommunication(CommConfig(self_id=client_id,
-                                                    endpoints=eps))
+            comm = self._make_comm(client_id, eps)
             cl = BftClient(ClientConfig(client_id=client_id, f_val=self.f,
                                         c_val=self.c, **cfg_kw), keys, comm)
             cl.start()
@@ -275,8 +315,7 @@ class BftTestNetwork:
         concord-ctl roles)."""
         from tpubft.consensus.replicas_info import ReplicasInfo
         from tpubft.reconfiguration import OperatorClient
-        cfg = ReplicaConfig(f_val=self.f, c_val=self.c,
-                            num_of_client_proxies=self.num_clients)
+        cfg = self._node_cfg()
         op_id = ReplicasInfo.from_config(cfg).operator_id
         cl = self._clients.get(op_id)
         if cl is None:
@@ -285,8 +324,7 @@ class BftTestNetwork:
                 seed=self.seed.encode()).for_node(op_id)
             eps = endpoint_table(self.base_port, self.n, self.num_clients,
                                  operator_id=op_id)
-            comm = PlainUdpCommunication(CommConfig(self_id=op_id,
-                                                    endpoints=eps))
+            comm = self._make_comm(op_id, eps)
             cl = BftClient(ClientConfig(client_id=op_id, f_val=self.f,
                                         c_val=self.c, **cfg_kw), keys, comm)
             cl.start()

@@ -60,6 +60,13 @@ class FlushBatcher(Generic[T]):
                 from tpubft.utils.logging import get_logger
                 get_logger("batcher").exception("drain raised (%s)",
                                                 self._thread.name)
+                # waiters on the failed batch must still resolve
+                if self._on_drop is not None:
+                    for item in batch:
+                        try:
+                            self._on_drop(item)
+                        except Exception:  # noqa: BLE001
+                            pass
 
     def stop(self) -> None:
         self._running = False
