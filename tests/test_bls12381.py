@@ -138,3 +138,39 @@ def test_share_pk_bounds():
         with pytest.raises(ValueError):
             v.share_pk(bad)
         assert not v.verify_share(bad, b"d", b"s")
+
+
+def test_glv_subgroup_check_equivalent_to_full_order_check():
+    """The fast endomorphism membership test must agree with [R]P == inf
+    on subgroup points AND reject cofactor-polluted points — including
+    small-order components (the G1 cofactor has a factor of 3, which is
+    why probabilistic batch checks are unsound here)."""
+    import random
+    rng = random.Random(0xBE7A)
+    H1 = 0x396C8C005555E1568C00AAAB0000AAAB
+    for trial in range(4):
+        s = bls.g1_mul(bls.G1_GEN, rng.randrange(1, bls.R))
+        assert bls.g1_in_subgroup(s)
+        assert bls.g1_mul_nonorder(s, bls.R) is None
+        # random curve point, cofactor component c = [R]T
+        x = rng.randrange(bls.P)
+        while True:
+            y = bls.fp_sqrt((x * x * x + 4) % bls.P)
+            if y is not None:
+                break
+            x = (x + 1) % bls.P
+        c = bls.g1_mul_nonorder((x, y), bls.R)
+        if c is None:
+            continue
+        assert not bls.g1_in_subgroup(c)
+        polluted = bls.g1_add(s, c)
+        assert not bls.g1_in_subgroup(polluted)
+        # an order-3 cofactor component specifically
+        small = bls.g1_mul_nonorder(c, H1 // 3)
+        if small is not None:
+            assert not bls.g1_in_subgroup(small)
+            assert not bls.g1_in_subgroup(bls.g1_add(s, small))
+        # decompress must reject non-subgroup encodings
+        import pytest
+        with pytest.raises(ValueError):
+            bls.g1_decompress(bls.g1_compress(polluted))

@@ -125,7 +125,11 @@ class CertBatchVerifier:
                     "cert batch verify raised")
                 verdicts = [False] * len(items)
             for i, ok in zip(idxs, verdicts):
-                self._post(batch[i][3], bool(ok))
+                try:
+                    self._post(batch[i][3], bool(ok))
+                except Exception:  # noqa: BLE001 — one failed post (e.g.
+                    pass           # shutdown) must not make the batcher
+                                   # re-resolve the rest as failures
 
     def stop(self) -> None:
         self._batcher.stop()

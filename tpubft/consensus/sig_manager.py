@@ -289,6 +289,10 @@ class PendingVerdict:
         self._ok: Optional[bool] = None
 
     def set(self, ok: bool) -> None:
+        if self._evt.is_set():
+            return                    # first write wins: a late failure
+                                      # path must not flip a delivered
+                                      # verdict under a woken waiter
         self._ok = ok
         self._evt.set()
 

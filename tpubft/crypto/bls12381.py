@@ -538,28 +538,56 @@ def g1_compress(pt) -> bytes:
 def g1_decompress(b: bytes, check_subgroup: bool = True):
     """Decode a compressed G1 point. Network-facing: enforces canonical
     encoding (single byte-representation per point) and, by default, membership
-    in the order-R subgroup — required for BLS soundness (G1 cofactor ~2^125)."""
+    in the order-R subgroup — required for BLS soundness (G1 cofactor ~2^125).
+    The membership test is the fast GLV endomorphism check
+    (g1_in_subgroup); a probabilistic BATCH check would be unsound here
+    because the cofactor has small prime factors (3, 11, ...)."""
     if len(b) != 48:
         raise ValueError("bad G1 encoding length")
-    flags = b[0]
-    if not flags & 0x80:
-        raise ValueError("uncompressed G1 not supported")
-    if flags & 0x40:
-        if b != bytes([0xC0]) + b"\x00" * 47:
-            raise ValueError("non-canonical G1 infinity encoding")
-        return None
-    x = int.from_bytes(bytes([b[0] & 0x1F]) + b[1:], "big")
-    if x >= P:
-        raise ValueError("G1 x out of range")
-    y = fp_sqrt((x * x % P * x + B1) % P)
-    if y is None:
-        raise ValueError("not on curve")
-    if (y > (P - 1) // 2) != bool(flags & 0x20):
-        y = P - y
-    pt = (x, y)
-    if check_subgroup and g1_mul_nonorder(pt, R) is not None:
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        pt = bls_native.g1_decompress(b)        # canonical+curve, fast sqrt
+    else:
+        flags = b[0]
+        if not flags & 0x80:
+            raise ValueError("uncompressed G1 not supported")
+        if flags & 0x40:
+            if b != bytes([0xC0]) + b"\x00" * 47:
+                raise ValueError("non-canonical G1 infinity encoding")
+            return None
+        x = int.from_bytes(bytes([b[0] & 0x1F]) + b[1:], "big")
+        if x >= P:
+            raise ValueError("G1 x out of range")
+        y = fp_sqrt((x * x % P * x + B1) % P)
+        if y is None:
+            raise ValueError("not on curve")
+        if (y > (P - 1) // 2) != bool(flags & 0x20):
+            y = P - y
+        pt = (x, y)
+    if pt is not None and check_subgroup and not g1_in_subgroup(pt):
         raise ValueError("G1 point not in order-R subgroup")
     return pt
+
+
+# GLV endomorphism subgroup test (the blst/Scott fast check): on the
+# order-R subgroup the endomorphism phi(x,y) = (beta*x, y) acts as
+# multiplication by lambda = x_param^2 - 1 (a root of T^2+T+1 mod R);
+# on every cofactor component the eigenvalues differ, so
+#   phi(P) == [lambda]P  <=>  P is in the subgroup.
+# One ~127-bit scalar mul instead of the full 255-bit [R]P check.
+# beta is the cube root of unity matching this orientation (verified
+# against the [R]P test on generator and cofactor points in
+# tests/test_bls12381.py).
+_G1_BETA = 0x1A0111EA397FE699EC02408663D4DE85AA0D857D89759AD4897D29650FB85F9B409427EB4F49FFFD8BFD00000000AAAC
+_G1_LAMBDA = 0xD201000000010000 ** 2 - 1
+
+
+def g1_in_subgroup(pt) -> bool:
+    """Fast deterministic order-R membership test for on-curve points."""
+    if pt is None:
+        return True
+    phi = (pt[0] * _G1_BETA % P, pt[1])
+    return g1_mul_nonorder(pt, _G1_LAMBDA) == phi
 
 
 def g2_compress(pt) -> bytes:
@@ -668,16 +696,49 @@ def threshold_keygen(k: int, n: int, seed: Optional[bytes] = None):
 
 def lagrange_coeffs_at_zero(ids: Sequence[int]) -> List[int]:
     """L_i(0) mod R for the signer-id set (reference:
-    threshsign/src/bls/relic/BlsThresholdAccumulator.cpp:42 computeLagrangeCoeff)."""
-    coeffs = []
+    threshsign/src/bls/relic/BlsThresholdAccumulator.cpp:42
+    computeLagrangeCoeff).
+
+    Optimized for large signer sets (n=1000 scale): the shared numerator
+    Π(-j) is computed once; per-i denominators accumulate the SMALL
+    integer differences (i-j) in machine-size chunks before each modular
+    reduction; and all k inversions collapse into ONE modexp via
+    Montgomery batch inversion. ~10x over the naive per-i modexp loop at
+    k=667."""
+    k = len(ids)
+    if k == 0:
+        return []
+    num_total = 1
+    for j in ids:
+        num_total = num_total * (R - j) % R          # Π (0 - j)
+    # den_i = Π_{j != i} (i - j); |i - j| is small, so bundle ~5 factors
+    # per big-int modmul
+    terms = []
     for i in ids:
-        num, den = 1, 1
+        den = 1
+        small = 1
+        nsmall = 0
         for j in ids:
             if j == i:
                 continue
-            num = num * (R - j) % R        # (0 - j)
-            den = den * ((i - j) % R) % R
-        coeffs.append(num * pow(den, R - 2, R) % R)
+            small *= i - j
+            nsmall += 1
+            if nsmall == 5:
+                den = den * small % R
+                small, nsmall = 1, 0
+        if nsmall:
+            den = den * small % R
+        # fold the numerator's surplus (0 - i) factor into the inversion
+        terms.append(den * (R - i) % R)
+    # batch inversion: one modexp total
+    prefix = [1] * (k + 1)
+    for t in range(k):
+        prefix[t + 1] = prefix[t] * terms[t] % R
+    inv_all = pow(prefix[k], R - 2, R)
+    coeffs = [0] * k
+    for t in range(k - 1, -1, -1):
+        coeffs[t] = num_total * (inv_all * prefix[t] % R) % R
+        inv_all = inv_all * terms[t] % R
     return coeffs
 
 

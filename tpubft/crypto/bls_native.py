@@ -29,6 +29,7 @@ def available() -> bool:
         lib.bls381_pairing_check.restype = ctypes.c_int
         lib.bls381_g1_msm.restype = ctypes.c_int
         lib.bls381_g2_msm.restype = ctypes.c_int
+        lib.bls381_g1_decompress.restype = ctypes.c_int
         _lib = lib
     except Exception:  # noqa: BLE001 — no toolchain: pure-Python fallback
         _lib = None
@@ -92,6 +93,23 @@ def g1_msm(points: Sequence, scalars: Sequence[int]):
 
 def g1_mul(point, k: int):
     return g1_msm([point], [k])
+
+
+def g1_decompress(b: bytes):
+    """Decode one compressed G1 point (canonical + on-curve checks, sqrt
+    in native code; NO subgroup check — bls12381.g1_decompress layers the
+    GLV membership test on top). Returns the affine int tuple, None for
+    canonical infinity; raises ValueError on invalid encodings."""
+    if len(b) != 48:
+        raise ValueError("bad G1 encoding length")
+    out = ctypes.create_string_buffer(96)
+    rc = _lib.bls381_g1_decompress(out, bytes(b))
+    if rc == 2:
+        return None
+    if rc != 1:
+        raise ValueError("invalid G1 encoding")
+    raw = out.raw
+    return (int.from_bytes(raw[:48], "big"), int.from_bytes(raw[48:], "big"))
 
 
 def g1_mul_nonorder(point, k: int):

@@ -45,6 +45,7 @@ static const uint64_t G2C4_0[6] = {0xcd03c9e48671f071ULL, 0x5dab22461fcda5d2ULL,
 static const uint64_t G2C5_0[6] = {0x890dc9e4867545c3ULL, 0x2af322533285a5d5ULL, 0x50880866309b7e2cULL, 0xa20d1b8c7e881024ULL, 0x14e4f04fe2db9068ULL, 0x14e56d3f1564853aULL};
 
 static const u64 X_ABS = 0xd201000000010000ULL;  // |x|, x negative
+static u64 SQRT_EXP[6];                          // (p+1)/4, set in ensure_init
 
 // ---------------- Fp (Montgomery form) ----------------
 
@@ -907,6 +908,94 @@ static void g2j_add_affine(G2J& r, const G2J& in, const G2A& b) {
     r.inf = false;
 }
 
+// Jacobian + Jacobian additions (add-2007-bl) — needed by the Pippenger
+// bucket sweep, where both operands are accumulated sums.
+static void g1j_add(G1J& r, const G1J& ain, const G1J& bin) {
+    const G1J a = ain, b = bin;           // r may alias either
+    if (a.inf) { r = b; return; }
+    if (b.inf) { r = a; return; }
+    Fp z1z1, z2z2, u1, u2, s1, s2, h, i, j, rr, v, t;
+    fp_sqr(z1z1, a.z);
+    fp_sqr(z2z2, b.z);
+    fp_mul(u1, a.x, z2z2);
+    fp_mul(u2, b.x, z1z1);
+    fp_mul(s1, a.y, b.z);
+    fp_mul(s1, s1, z2z2);
+    fp_mul(s2, b.y, a.z);
+    fp_mul(s2, s2, z1z1);
+    fp_sub(h, u2, u1);
+    fp_sub(rr, s2, s1);
+    if (fp_is_zero(h)) {
+        if (fp_is_zero(rr)) { g1j_dbl(r, a); return; }
+        r.inf = true;
+        return;
+    }
+    fp_add(i, h, h);
+    fp_sqr(i, i);
+    fp_mul(j, h, i);
+    fp_add(rr, rr, rr);
+    fp_mul(v, u1, i);
+    fp_sqr(t, rr);
+    fp_sub(t, t, j);
+    fp_sub(t, t, v);
+    fp_sub(r.x, t, v);
+    fp_sub(t, v, r.x);
+    fp_mul(t, rr, t);
+    Fp t2;
+    fp_mul(t2, s1, j);
+    fp_add(t2, t2, t2);
+    fp_sub(r.y, t, t2);
+    fp_add(t, a.z, b.z);
+    fp_sqr(t, t);
+    fp_sub(t, t, z1z1);
+    fp_sub(t, t, z2z2);
+    fp_mul(r.z, t, h);
+    r.inf = false;
+}
+
+static void g2j_add(G2J& r, const G2J& ain, const G2J& bin) {
+    const G2J a = ain, b = bin;
+    if (a.inf) { r = b; return; }
+    if (b.inf) { r = a; return; }
+    Fp2 z1z1, z2z2, u1, u2, s1, s2, h, i, j, rr, v, t;
+    fp2_sqr(z1z1, a.z);
+    fp2_sqr(z2z2, b.z);
+    fp2_mul(u1, a.x, z2z2);
+    fp2_mul(u2, b.x, z1z1);
+    fp2_mul(s1, a.y, b.z);
+    fp2_mul(s1, s1, z2z2);
+    fp2_mul(s2, b.y, a.z);
+    fp2_mul(s2, s2, z1z1);
+    fp2_sub(h, u2, u1);
+    fp2_sub(rr, s2, s1);
+    if (fp2_is_zero(h)) {
+        if (fp2_is_zero(rr)) { g2j_dbl(r, a); return; }
+        r.inf = true;
+        return;
+    }
+    fp2_add(i, h, h);
+    fp2_sqr(i, i);
+    fp2_mul(j, h, i);
+    fp2_add(rr, rr, rr);
+    fp2_mul(v, u1, i);
+    fp2_sqr(t, rr);
+    fp2_sub(t, t, j);
+    fp2_sub(t, t, v);
+    fp2_sub(r.x, t, v);
+    fp2_sub(t, v, r.x);
+    fp2_mul(t, rr, t);
+    Fp2 t2;
+    fp2_mul(t2, s1, j);
+    fp2_add(t2, t2, t2);
+    fp2_sub(r.y, t, t2);
+    fp2_add(t, a.z, b.z);
+    fp2_sqr(t, t);
+    fp2_sub(t, t, z1z1);
+    fp2_sub(t, t, z2z2);
+    fp2_mul(r.z, t, h);
+    r.inf = false;
+}
+
 static void g2j_to_affine(G2A& r, const G2J& a) {
     if (a.inf) { r.inf = true; return; }
     Fp2 zi, zi2, zi3;
@@ -924,6 +1013,19 @@ static bool g_ready = false;
 
 static void ensure_init() {
     if (g_ready) return;
+    {   // (p+1)/4 for the decompress sqrt (p ≡ 3 mod 4)
+        u64 tmp[6];
+        u128 c = (u128)P_LIMBS[0] + 1;
+        for (int i = 0; i < 6; i++) {
+            if (i) c = (u128)P_LIMBS[i] + (c >> 64);
+            tmp[i] = (u64)c;
+        }
+        for (int i = 0; i < 6; i++) {
+            u64 lo = tmp[i] >> 2;
+            u64 hi = (i < 5) ? (tmp[i + 1] << 62) : 0;
+            SQRT_EXP[i] = lo | hi;
+        }
+    }
     memset(&FP_ZERO_C, 0, sizeof(FP_ZERO_C));
     memcpy(FP_ONE_C.l, ONE_M, 48);
     FP2_ZERO_C.c0 = FP_ZERO_C; FP2_ZERO_C.c1 = FP_ZERO_C;
@@ -965,6 +1067,63 @@ static bool load_g2(G2A& q, const uint8_t* c192, int inf) {
     return true;
 }
 
+// Pippenger bucket MSM (the role of fastMultExp, FastMultExp.cpp:27-59,
+// at bucket-method complexity): windows of c bits; per window each point
+// lands in its digit's bucket (one mixed add), then one running-sum
+// sweep over 2^c-1 buckets yields sum_b b*bucket[b]. Window size chosen
+// from n; ~2.5-3x over the shared-doubling square-and-add at n>=500.
+static inline int msm_window_bits(int n) {
+    if (n < 8) return 3;
+    if (n < 64) return 5;
+    if (n < 256) return 7;
+    return 8;
+}
+
+static inline int msm_digit(const uint8_t* k32, int w, int c) {
+    // bits [w*c, w*c+c) of a 32-byte big-endian scalar, LSB bit order
+    int d = 0;
+    for (int b = 0; b < c; b++) {
+        int bit = w * c + b;
+        if (bit > 255) break;
+        d |= ((k32[31 - bit / 8] >> (bit % 8)) & 1) << b;
+    }
+    return d;
+}
+
+template <typename Jac, typename Aff>
+static void msm_pippenger(Jac& acc, const Aff* aff, const uint8_t* ks,
+                          int n,
+                          void (*dbl)(Jac&, const Jac&),
+                          void (*add_aff)(Jac&, const Jac&, const Aff&),
+                          void (*add_jj)(Jac&, const Jac&, const Jac&)) {
+    const int c = msm_window_bits(n);
+    const int nbuckets = (1 << c) - 1;
+    const int windows = (255 / c) + 1;
+    Jac* buckets = new Jac[nbuckets];
+    acc.inf = true;
+    for (int w = windows - 1; w >= 0; w--) {
+        if (!acc.inf) {
+            for (int b = 0; b < c; b++) dbl(acc, acc);
+        }
+        for (int b = 0; b < nbuckets; b++) buckets[b].inf = true;
+        for (int i = 0; i < n; i++) {
+            if (aff[i].inf) continue;
+            int d = msm_digit(ks + (size_t)i * 32, w, c);
+            if (d) add_aff(buckets[d - 1], buckets[d - 1], aff[i]);
+        }
+        Jac running, sum;
+        running.inf = true;
+        sum.inf = true;
+        for (int b = nbuckets - 1; b >= 0; b--) {
+            add_jj(running, running, buckets[b]);
+            add_jj(sum, sum, running);
+        }
+        add_jj(acc, acc, sum);
+    }
+    delete[] buckets;
+}
+
+
 extern "C" {
 
 // prod_i e(P_i, Q_i) == 1 ?  (multi-pairing: miller loops multiplied,
@@ -998,26 +1157,93 @@ int bls381_pairing_check(const uint8_t* g1s, const uint8_t* g2s,
 // Interleaved (Straus) chain: ONE shared 256-doubling run, a mixed add
 // per set bit, and a single Jacobian->affine inversion at the end —
 // the fastMultExp role (reference FastMultExp.cpp:27).
+// Decompress a 48-byte ZCash-style compressed G1 point: canonical-
+// encoding + on-curve checks here, sqrt via one fp_pow (the Python-side
+// modexp at ~0.3 ms each was the per-share decompress bottleneck).
+// Returns 1 ok (affine out), 2 infinity, 0 invalid. No subgroup check —
+// the Python layer runs the GLV endomorphism membership test on top
+// (a probabilistic batch check would be unsound: the cofactor has small
+// prime factors).
+int bls381_g1_decompress(uint8_t* out96, const uint8_t* in48) {
+    ensure_init();
+    uint8_t flags = in48[0];
+    if (!(flags & 0x80)) return 0;
+    if (flags & 0x40) {                 // infinity: canonical form only
+        if (flags != 0xC0) return 0;
+        for (int i = 1; i < 48; i++) {
+            if (in48[i]) return 0;
+        }
+        return 2;
+    }
+    uint8_t xbe[48];
+    memcpy(xbe, in48, 48);
+    xbe[0] &= 0x1F;
+    // canonical: x < p (big-endian compare; P_LIMBS is plain form)
+    uint8_t pbe[48];
+    for (int i = 0; i < 6; i++) {
+        u64 w = P_LIMBS[5 - i];
+        for (int j = 0; j < 8; j++)
+            pbe[i * 8 + j] = (uint8_t)(w >> (56 - 8 * j));
+    }
+    int cmp = memcmp(xbe, pbe, 48);
+    if (cmp >= 0) return 0;
+    Fp x, x3, y2, y;
+    fp_from_be(x, xbe);
+    fp_sqr(x3, x);
+    fp_mul(x3, x3, x);
+    Fp b4;
+    {   // b = 4 in Montgomery form: 4 * ONE_M
+        Fp one;
+        memcpy(one.l, ONE_M, 48);
+        fp_add(b4, one, one);
+        fp_add(b4, b4, b4);
+    }
+    fp_add(y2, x3, b4);
+    // sqrt: y = y2^((p+1)/4)  (p ≡ 3 mod 4); SQRT_EXP set in ensure_init
+    fp_pow(y, y2, SQRT_EXP, 6);
+    Fp chk;
+    fp_sqr(chk, y);
+    if (!fp_eq(chk, y2)) return 0;      // not a QR: off curve
+    // sign selection: flag 0x20 = y lexicographically greater than p/2
+    uint8_t ybe[48];
+    fp_to_be(ybe, y);
+    // greater iff 2y > p  <=>  y > (p-1)/2: compare 2*y vs p in plain ints
+    bool greater;
+    {
+        // plain big-endian compare of y against (p-1)/2 = p >> 1 (p odd)
+        uint8_t half[48];
+        u64 tmp[6];
+        for (int i = 0; i < 6; i++) {
+            u64 lo = P_LIMBS[i] >> 1;
+            u64 hi = (i < 5) ? (P_LIMBS[i + 1] << 63) : 0;
+            tmp[i] = lo | hi;
+        }
+        for (int i = 0; i < 6; i++) {
+            u64 w = tmp[5 - i];
+            for (int j = 0; j < 8; j++)
+                half[i * 8 + j] = (uint8_t)(w >> (56 - 8 * j));
+        }
+        greater = memcmp(ybe, half, 48) > 0;
+    }
+    if (greater != !!(flags & 0x20)) {
+        fp_neg(y, y);
+        fp_to_be(ybe, y);
+    }
+    memcpy(out96, xbe, 48);
+    memcpy(out96 + 48, ybe, 48);
+    return 1;
+}
+
 int bls381_g1_msm(uint8_t* out96, uint8_t* out_inf, const uint8_t* pts,
                   const uint8_t* infs, const uint8_t* ks, int n) {
     ensure_init();
-    G1J acc;
-    acc.inf = true;
     G1A* aff = new G1A[n > 0 ? n : 1];
     for (int i = 0; i < n; i++) {
         load_g1(aff[i], pts + (size_t)i * 96, infs[i]);
     }
-    for (int bit = 255; bit >= 0; bit--) {
-        if (!acc.inf) g1j_dbl(acc, acc);
-        int byte = 31 - bit / 8;
-        int sh = bit % 8;
-        for (int i = 0; i < n; i++) {
-            if (aff[i].inf) continue;
-            if ((ks[(size_t)i * 32 + byte] >> sh) & 1) {
-                g1j_add_affine(acc, acc, aff[i]);
-            }
-        }
-    }
+    G1J acc;
+    msm_pippenger<G1J, G1A>(acc, aff, ks, n, g1j_dbl, g1j_add_affine,
+                            g1j_add);
     delete[] aff;
     G1A r;
     g1j_to_affine(r, acc);
@@ -1032,23 +1258,13 @@ int bls381_g1_msm(uint8_t* out96, uint8_t* out_inf, const uint8_t* pts,
 int bls381_g2_msm(uint8_t* out192, uint8_t* out_inf, const uint8_t* pts,
                   const uint8_t* infs, const uint8_t* ks, int n) {
     ensure_init();
-    G2J acc;
-    acc.inf = true;
     G2A* aff = new G2A[n > 0 ? n : 1];
     for (int i = 0; i < n; i++) {
         load_g2(aff[i], pts + (size_t)i * 192, infs[i]);
     }
-    for (int bit = 255; bit >= 0; bit--) {
-        if (!acc.inf) g2j_dbl(acc, acc);
-        int byte = 31 - bit / 8;
-        int sh = bit % 8;
-        for (int i = 0; i < n; i++) {
-            if (aff[i].inf) continue;
-            if ((ks[(size_t)i * 32 + byte] >> sh) & 1) {
-                g2j_add_affine(acc, acc, aff[i]);
-            }
-        }
-    }
+    G2J acc;
+    msm_pippenger<G2J, G2A>(acc, aff, ks, n, g2j_dbl, g2j_add_affine,
+                            g2j_add);
     delete[] aff;
     G2A r;
     g2j_to_affine(r, acc);
