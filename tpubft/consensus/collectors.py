@@ -128,8 +128,11 @@ class CertBatchVerifier:
                 try:
                     self._post(batch[i][3], bool(ok))
                 except Exception:  # noqa: BLE001 — one failed post (e.g.
-                    pass           # shutdown) must not make the batcher
-                                   # re-resolve the rest as failures
+                    # shutdown) must not make the batcher re-resolve the
+                    # rest as failures; but a consumer bug must be visible
+                    from tpubft.utils.logging import get_logger
+                    get_logger("collectors").exception(
+                        "cert verdict post failed")
 
     def stop(self) -> None:
         self._batcher.stop()

@@ -47,7 +47,11 @@ def fp_inv(a: int) -> int:
 
 
 def fp_sqrt(a: int) -> Optional[int]:
-    """p ≡ 3 (mod 4) → candidate a^((p+1)/4)."""
+    """p ≡ 3 (mod 4) → candidate a^((p+1)/4); native modexp when built
+    (the Python pow dominates hash-to-curve and decompress otherwise)."""
+    from tpubft.crypto import bls_native
+    if bls_native.available():
+        return bls_native.fp_sqrt(a % P)
     c = pow(a, (P + 1) // 4, P)
     return c if c * c % P == a % P else None
 
@@ -708,6 +712,11 @@ def lagrange_coeffs_at_zero(ids: Sequence[int]) -> List[int]:
     k = len(ids)
     if k == 0:
         return []
+    # fail loud on degenerate id sets: an id ≡ 0 mod R zeroes the
+    # batched products (silently-infinite combined signature), and
+    # duplicates make the interpolation meaningless
+    if len(set(i % R for i in ids)) != k or any(i % R == 0 for i in ids):
+        raise ValueError("signer ids must be distinct and nonzero mod R")
     num_total = 1
     for j in ids:
         num_total = num_total * (R - j) % R          # Π (0 - j)

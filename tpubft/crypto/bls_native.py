@@ -30,6 +30,7 @@ def available() -> bool:
         lib.bls381_g1_msm.restype = ctypes.c_int
         lib.bls381_g2_msm.restype = ctypes.c_int
         lib.bls381_g1_decompress.restype = ctypes.c_int
+        lib.bls381_fp_sqrt.restype = ctypes.c_int
         _lib = lib
     except Exception:  # noqa: BLE001 — no toolchain: pure-Python fallback
         _lib = None
@@ -93,6 +94,14 @@ def g1_msm(points: Sequence, scalars: Sequence[int]):
 
 def g1_mul(point, k: int):
     return g1_msm([point], [k])
+
+
+def fp_sqrt(x: int):
+    """sqrt mod p for 0 <= x < p, or None when x is not a QR."""
+    out = ctypes.create_string_buffer(48)
+    if _lib.bls381_fp_sqrt(out, x.to_bytes(48, "big")) != 1:
+        return None
+    return int.from_bytes(out.raw, "big")
 
 
 def g1_decompress(b: bytes):

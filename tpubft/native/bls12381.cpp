@@ -46,6 +46,7 @@ static const uint64_t G2C5_0[6] = {0x890dc9e4867545c3ULL, 0x2af322533285a5d5ULL,
 
 static const u64 X_ABS = 0xd201000000010000ULL;  // |x|, x negative
 static u64 SQRT_EXP[6];                          // (p+1)/4, set in ensure_init
+static uint8_t P_BE[48], P_HALF_BE[48];          // p and (p-1)/2, big-endian
 
 // ---------------- Fp (Montgomery form) ----------------
 
@@ -1025,6 +1026,21 @@ static void ensure_init() {
             u64 hi = (i < 5) ? (tmp[i + 1] << 62) : 0;
             SQRT_EXP[i] = lo | hi;
         }
+        for (int i = 0; i < 6; i++) {
+            u64 w = P_LIMBS[5 - i];
+            for (int j = 0; j < 8; j++)
+                P_BE[i * 8 + j] = (uint8_t)(w >> (56 - 8 * j));
+        }
+        for (int i = 0; i < 6; i++) {
+            u64 lo = P_LIMBS[i] >> 1;       // (p-1)/2 = p >> 1 (p odd)
+            u64 hi = (i < 5) ? (P_LIMBS[i + 1] << 63) : 0;
+            tmp[i] = lo | hi;
+        }
+        for (int i = 0; i < 6; i++) {
+            u64 w = tmp[5 - i];
+            for (int j = 0; j < 8; j++)
+                P_HALF_BE[i * 8 + j] = (uint8_t)(w >> (56 - 8 * j));
+        }
     }
     memset(&FP_ZERO_C, 0, sizeof(FP_ZERO_C));
     memcpy(FP_ONE_C.l, ONE_M, 48);
@@ -1090,12 +1106,48 @@ static inline int msm_digit(const uint8_t* k32, int w, int c) {
     return d;
 }
 
+// Dedicated single-scalar windowed mul (4-bit fixed window): the
+// Pippenger machinery pays a full bucket sweep per window, which is
+// pure overhead at n=1 — and n=1 is the subgroup-check / cofactor-clear
+// hot case.
+template <typename Jac, typename Aff>
+static void mul_single(Jac& acc, const Aff& p, const uint8_t* k32,
+                       void (*dbl)(Jac&, const Jac&),
+                       void (*add_aff)(Jac&, const Jac&, const Aff&),
+                       void (*add_jj)(Jac&, const Jac&, const Jac&)) {
+    Jac tbl[15];
+    tbl[0].inf = true;
+    add_aff(tbl[0], tbl[0], p);                 // [1]P
+    for (int i = 1; i < 15; i++)
+        add_aff(tbl[i], tbl[i - 1], p);         // [i+1]P
+    acc.inf = true;
+    // big-endian scalar: nibble position d (0 = least significant) lives
+    // in byte 31 - d/2; odd d is that byte's HIGH nibble
+    auto nibble = [&](int d) -> int {
+        int b = k32[31 - d / 2];
+        return (d & 1) ? (b >> 4) : (b & 0x0F);
+    };
+    int start = 63;
+    while (start >= 0 && nibble(start) == 0) start--;
+    for (int d = start; d >= 0; d--) {
+        if (!acc.inf) {
+            dbl(acc, acc); dbl(acc, acc); dbl(acc, acc); dbl(acc, acc);
+        }
+        int nib = nibble(d);
+        if (nib) add_jj(acc, acc, tbl[nib - 1]);
+    }
+}
+
 template <typename Jac, typename Aff>
 static void msm_pippenger(Jac& acc, const Aff* aff, const uint8_t* ks,
                           int n,
                           void (*dbl)(Jac&, const Jac&),
                           void (*add_aff)(Jac&, const Jac&, const Aff&),
                           void (*add_jj)(Jac&, const Jac&, const Jac&)) {
+    if (n == 1 && !aff[0].inf) {
+        mul_single<Jac, Aff>(acc, aff[0], ks, dbl, add_aff, add_jj);
+        return;
+    }
     const int c = msm_window_bits(n);
     const int nbuckets = (1 << c) - 1;
     const int windows = (255 / c) + 1;
@@ -1178,14 +1230,8 @@ int bls381_g1_decompress(uint8_t* out96, const uint8_t* in48) {
     uint8_t xbe[48];
     memcpy(xbe, in48, 48);
     xbe[0] &= 0x1F;
-    // canonical: x < p (big-endian compare; P_LIMBS is plain form)
-    uint8_t pbe[48];
-    for (int i = 0; i < 6; i++) {
-        u64 w = P_LIMBS[5 - i];
-        for (int j = 0; j < 8; j++)
-            pbe[i * 8 + j] = (uint8_t)(w >> (56 - 8 * j));
-    }
-    int cmp = memcmp(xbe, pbe, 48);
+    // canonical: x < p (big-endian compare; P_BE set in ensure_init)
+    int cmp = memcmp(xbe, P_BE, 48);
     if (cmp >= 0) return 0;
     Fp x, x3, y2, y;
     fp_from_be(x, xbe);
@@ -1208,29 +1254,26 @@ int bls381_g1_decompress(uint8_t* out96, const uint8_t* in48) {
     uint8_t ybe[48];
     fp_to_be(ybe, y);
     // greater iff 2y > p  <=>  y > (p-1)/2: compare 2*y vs p in plain ints
-    bool greater;
-    {
-        // plain big-endian compare of y against (p-1)/2 = p >> 1 (p odd)
-        uint8_t half[48];
-        u64 tmp[6];
-        for (int i = 0; i < 6; i++) {
-            u64 lo = P_LIMBS[i] >> 1;
-            u64 hi = (i < 5) ? (P_LIMBS[i + 1] << 63) : 0;
-            tmp[i] = lo | hi;
-        }
-        for (int i = 0; i < 6; i++) {
-            u64 w = tmp[5 - i];
-            for (int j = 0; j < 8; j++)
-                half[i * 8 + j] = (uint8_t)(w >> (56 - 8 * j));
-        }
-        greater = memcmp(ybe, half, 48) > 0;
-    }
+    bool greater = memcmp(ybe, P_HALF_BE, 48) > 0;
     if (greater != !!(flags & 0x20)) {
         fp_neg(y, y);
         fp_to_be(ybe, y);
     }
     memcpy(out96, xbe, 48);
     memcpy(out96 + 48, ybe, 48);
+    return 1;
+}
+
+// Square root in Fp via one fp_pow (p ≡ 3 mod 4): the Python-side modexp
+// at ~0.3 ms dominated hash-to-curve; returns 0 when not a QR.
+int bls381_fp_sqrt(uint8_t* out48, const uint8_t* in48) {
+    ensure_init();
+    Fp a, y, chk;
+    fp_from_be(a, in48);
+    fp_pow(y, a, SQRT_EXP, 6);
+    fp_sqr(chk, y);
+    if (!fp_eq(chk, a)) return 0;
+    fp_to_be(out48, y);
     return 1;
 }
 
