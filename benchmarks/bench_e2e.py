@@ -38,48 +38,50 @@ def _handler_factory(_r=None):
     return skvbc.SkvbcHandler(KeyValueBlockchain(MemoryDB()))
 
 
-def run_config(config: int, backend: str, secs: float,
-               clients: int) -> dict:
+def _drive(make_kv, config: int, backend: str, secs: float,
+           clients: int, mode: str = None,
+           warmup_timeout_ms: int = 20000) -> dict:
+    """Shared workload driver: `make_kv(idx)` returns a SkvbcClient
+    bound to client `idx`; one stats pipeline serves both harness
+    modes (so BASELINE numbers can never drift between them)."""
     cfg = CONFIGS[config]
-    overrides = {"threshold_scheme": cfg["threshold_scheme"],
-                 "crypto_backend": backend}
-    cluster = InProcessCluster(f=cfg["f"], num_clients=clients,
-                               handler_factory=_handler_factory,
-                               cfg_overrides=overrides)
     counts = [0] * clients
     lats: List[List[float]] = [[] for _ in range(clients)]
     stop_at = [0.0]
 
     def worker(idx: int) -> None:
-        kv = skvbc.SkvbcClient(cluster.client(idx))
+        kv = make_kv(idx)
         i = 0
         while time.monotonic() < stop_at[0]:
             t0 = time.monotonic()
-            reply = kv.write([(b"bench-%d-%d" % (idx, i % 64),
-                               b"v%d" % i)])
+            try:
+                r = kv.write([(b"bench-%d-%d" % (idx, i % 64),
+                               b"v%d" % i)], timeout_ms=8000)
+            except Exception:  # noqa: BLE001 — lossy transports time out
+                i += 1
+                continue
             dt = time.monotonic() - t0
-            if reply.success:
+            if r.success:
                 counts[idx] += 1
                 lats[idx].append(dt)
             i += 1
 
-    with cluster:
-        # warmup: first write pays kernel compiles on the tpu backend
-        kv0 = skvbc.SkvbcClient(cluster.client(0))
-        assert kv0.write([(b"warmup", b"w")]).success, \
-            "cluster failed to order the warmup write"
-        stop_at[0] = time.monotonic() + secs
-        threads = [threading.Thread(target=worker, args=(i,))
-                   for i in range(clients)]
-        t0 = time.monotonic()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.monotonic() - t0
+    # warmup: first write pays kernel compiles on the tpu backend
+    assert make_kv(0).write([(b"warmup", b"w")],
+                            timeout_ms=warmup_timeout_ms).success, \
+        "cluster failed to order the warmup write"
+    stop_at[0] = time.monotonic() + secs
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
     total = sum(counts)
     all_lats = sorted(x for ls in lats for x in ls)
-    return {
+    row = {
         "config": config, "n": 3 * cfg["f"] + 1, "f": cfg["f"],
         "threshold_scheme": cfg["threshold_scheme"], "backend": backend,
         "clients": clients, "secs": round(wall, 2), "ops": total,
@@ -89,6 +91,37 @@ def run_config(config: int, backend: str, secs: float,
         "p90_latency_ms": round(all_lats[int(len(all_lats) * 0.9)] * 1e3, 2)
         if all_lats else None,
     }
+    if mode:
+        row["mode"] = mode
+    return row
+
+
+def run_config(config: int, backend: str, secs: float,
+               clients: int) -> dict:
+    cfg = CONFIGS[config]
+    overrides = {"threshold_scheme": cfg["threshold_scheme"],
+                 "crypto_backend": backend}
+    with InProcessCluster(f=cfg["f"], num_clients=clients,
+                          handler_factory=_handler_factory,
+                          cfg_overrides=overrides) as cluster:
+        return _drive(lambda i: skvbc.SkvbcClient(cluster.client(i)),
+                      config, backend, secs, clients)
+
+
+def run_config_processes(config: int, backend: str, secs: float,
+                         clients: int) -> dict:
+    """REAL replica OS processes (BftTestNetwork) — no shared-GIL
+    inflation; this is the deployment-shaped number."""
+    import tempfile
+
+    from tpubft.testing.network import BftTestNetwork
+    cfg = CONFIGS[config]
+    with tempfile.TemporaryDirectory() as tmp, \
+            BftTestNetwork(f=cfg["f"], num_clients=max(4, clients),
+                           db_dir=tmp, crypto_backend=backend,
+                           threshold_scheme=cfg["threshold_scheme"]) as net:
+        return _drive(net.skvbc_client, config, backend, secs, clients,
+                      mode="processes")
 
 
 def main() -> None:
@@ -97,10 +130,14 @@ def main() -> None:
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--configs", default="1,2")
     ap.add_argument("--backends", default="cpu")
+    ap.add_argument("--processes", action="store_true",
+                    help="real replica OS processes instead of the "
+                         "in-process cluster")
     args = ap.parse_args()
     for config in [int(x) for x in args.configs.split(",")]:
         for backend in args.backends.split(","):
-            row = run_config(config, backend, args.secs, args.clients)
+            fn = run_config_processes if args.processes else run_config
+            row = fn(config, backend, args.secs, args.clients)
             print(json.dumps(row), flush=True)
 
 
