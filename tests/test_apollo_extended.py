@@ -219,3 +219,25 @@ def test_lossy_cluster_30pct_commits(tmp_path):
         assert done == 3, "cluster could not commit under 30%% loss"
         for r in range(net.n):
             net.heal(r)
+
+
+def test_tester_client_workload_binary(tmp_path):
+    """The standalone TesterClient process (reference
+    tests/simpleKVBC/TesterClient) runs its randomized checked workload
+    against a live process cluster and reports ok."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    with BftTestNetwork(f=1, db_dir=str(tmp_path),
+                        seed="tpubft-skvbc") as net:
+        env = dict(os.environ, PYTHONPATH=os.getcwd(), JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "tpubft.apps.tester_client",
+             "--f", "1", "--base-port", str(net.base_port),
+             "--ops", "40", "--concurrency", "2", "--client-idx", "1"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr[-1500:]
+        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        assert summary["ok"] and summary["ops_ok"] >= 20, summary
