@@ -232,7 +232,8 @@ def test_tester_client_workload_binary(tmp_path):
 
     with BftTestNetwork(f=1, db_dir=str(tmp_path),
                         seed="tpubft-skvbc") as net:
-        env = dict(os.environ, PYTHONPATH=os.getcwd(), JAX_PLATFORMS="cpu")
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, PYTHONPATH=repo_root, JAX_PLATFORMS="cpu")
         out = subprocess.run(
             [sys.executable, "-m", "tpubft.apps.tester_client",
              "--f", "1", "--base-port", str(net.base_port),
@@ -241,3 +242,31 @@ def test_tester_client_workload_binary(tmp_path):
         assert out.returncode == 0, out.stderr[-1500:]
         summary = json.loads(out.stdout.strip().splitlines()[-1])
         assert summary["ok"] and summary["ops_ok"] >= 20, summary
+
+
+def test_cre_client_observes_wedge(tmp_path):
+    """The standalone TesterCRE process observes the operator's wedge
+    through its poll loop (reference client-reconfiguration engine)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    with BftTestNetwork(f=1, db_dir=str(tmp_path),
+                        seed="tpubft-skvbc") as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"w", b"1")
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, PYTHONPATH=repo_root, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpubft.apps.cre_client",
+             "--f", "1", "--base-port", str(net.base_port),
+             "--client-idx", "1", "--polls", "30", "--period", "0.3"],
+            env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            assert net.operator_client().wedge(timeout_ms=15000).success
+            out, _ = proc.communicate(timeout=60)
+        finally:
+            proc.kill()
+        events = [json.loads(line) for line in out.strip().splitlines()]
+        assert any(e["wedge_point"] is not None for e in events), events

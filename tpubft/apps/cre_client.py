@@ -1,0 +1,58 @@
+"""TesterCRE — standalone client-reconfiguration-engine process.
+
+Rebuild of the reference's TesterCRE
+(/root/reference/tests/simpleKVBC/TesterClient sibling): a client process
+running the CRE poll loop against a live cluster, printing every
+cluster-control state change (wedge points, key rotations) as JSON lines
+until interrupted or --polls runs out.
+
+Run:  python -m tpubft.apps.cre_client --f 1 --base-port 3710 \
+          [--polls 10] [--period 1.0] [--client-idx 0]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tpubft.apps.tester_client import make_client
+from tpubft.client.cre import ClientReconfigurationEngine
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--f", type=int, default=1)
+    ap.add_argument("--c", type=int, default=0)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--client-idx", type=int, default=0)
+    ap.add_argument("--base-port", type=int, default=3710)
+    ap.add_argument("--seed", default="tpubft-skvbc")
+    ap.add_argument("--polls", type=int, default=0,
+                    help="exit after N polls (0 = run forever)")
+    ap.add_argument("--period", type=float, default=1.0)
+    args = ap.parse_args()
+
+    kv = make_client(args, 0)     # client id = n + args.client_idx
+    cl = kv._client
+    cre = ClientReconfigurationEngine(cl, poll_period_s=args.period)
+    cre.register_handler(
+        lambda st: print(json.dumps({
+            "event": "cluster_state", "wedge_point": st.wedge_point,
+            "restart_ready": st.restart_ready, "raw": st.raw}),
+            flush=True))
+    try:
+        n = 0
+        while args.polls == 0 or n < args.polls:
+            cre.poll_once()           # handlers fire on observed CHANGES
+            n += 1                    # --polls counts polls, as documented
+            time.sleep(args.period)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cl.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
