@@ -9,18 +9,22 @@ verification measured in the same process (the reference's crypto path is
 one-at-a-time CPU verify on the dispatcher/request threads —
 SigManager.cpp:197).
 
-Robustness: if TPU device init is unavailable (tunnel down), falls back to
-the CPU JAX backend and reports against the same baseline.
+Robustness: if TPU device init is unavailable (tunnel down), the bench
+retries for TPUBFT_BENCH_DEVICE_WAIT_S seconds (default 900) before
+falling back to the CPU JAX backend; the CPU fallback is marked with an
+explicit "degraded": true so a reader of the JSON artifact can tell
+"no hardware at capture time" from a perf regression.
 """
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
 
 
-def _device_available(timeout_s: float = 90.0) -> bool:
+def _device_probe_once(timeout_s: float = 90.0) -> bool:
     """Probe default-platform device init in a subprocess (init can hang
     forever when the TPU tunnel is down)."""
     try:
@@ -34,9 +38,24 @@ def _device_available(timeout_s: float = 90.0) -> bool:
         return False
 
 
+def _device_available() -> bool:
+    """Retry-wait for the device: a round's only driver-captured perf
+    artifact shouldn't be forfeited to a transient tunnel outage."""
+    deadline = time.monotonic() + float(
+        os.environ.get("TPUBFT_BENCH_DEVICE_WAIT_S", "900"))
+    while True:
+        if _device_probe_once():
+            return True
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        print("bench: device init unavailable; retrying (%.0fs left)"
+              % remaining, file=sys.stderr)
+        time.sleep(min(30.0, remaining))
+
+
 def main() -> None:
     use_default_platform = _device_available()
-    import os
 
     import jax
     if not use_default_platform:
@@ -99,13 +118,17 @@ def main() -> None:
     best = max(candidates, key=candidates.get)
     tpu_rate = candidates[best]
 
-    print(json.dumps({
+    platform = jax.devices()[0].platform
+    record = {
         "metric": "ed25519-verifies/sec (batch=%d, %s, %s)" % (
-            batch, jax.devices()[0].platform, best),
+            batch, platform, best),
         "value": round(tpu_rate, 1),
         "unit": "verifies/sec",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
-    }))
+    }
+    if platform == "cpu":
+        record["degraded"] = True  # no accelerator at capture time
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -203,3 +203,33 @@ def test_db_editor(tmp_path):
     assert run("delete", "famA", "aa").returncode == 0
     assert run("get", "famA", "aa").stdout.strip() == "(not found)"
     assert "entries: 2" in run("stats").stdout
+
+
+def test_flush_batcher_stop_resolves_pending_and_rejects_late_submits():
+    """stop() must resolve every queued item exactly once (via on_drop)
+    and a submit racing/after stop must resolve immediately rather than
+    sit in a queue no worker will ever drain."""
+    import threading
+    import time
+
+    from tpubft.utils.batcher import FlushBatcher
+
+    drained, dropped = [], []
+    gate = threading.Event()
+
+    def drain(batch):
+        gate.wait(timeout=5)            # wedge the worker mid-drain
+        drained.extend(batch)
+
+    b = FlushBatcher(drain, batch_size=4, flush_us=100_000,
+                     on_drop=dropped.append, name="test-batcher")
+    b.submit(1)
+    time.sleep(0.05)                    # worker picks up [1], blocks in drain
+    b.submit(2)                         # queued behind the wedged batch
+    gate.set()
+    b.stop()
+    b.submit(3)                         # after stop: must resolve via on_drop
+    time.sleep(0.05)
+    assert 3 in dropped
+    # every item resolved exactly once, through exactly one channel
+    assert sorted(drained + dropped) == [1, 2, 3]

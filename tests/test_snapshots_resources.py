@@ -64,6 +64,26 @@ def test_snapshot_detects_corruption(tmp_path):
         restore_snapshot(path, MemoryDB())
 
 
+def test_snapshot_manifest_mismatch_leaves_store_untouched(tmp_path):
+    """A digest-consistent file whose manifest disagrees with its body
+    must fail BEFORE any record reaches the DB (pass-1 validation)."""
+    import hashlib
+    import json
+
+    from tpubft.kvbc.snapshots import MAGIC, _rec
+    body = _rec(b"kv", b"k", b"v") + _rec(b"kv", b"k2", b"v2")
+    manifest = {"version": 1, "head_block": 1, "state_digest": "",
+                "entries": 99}                     # lies about the count
+    header = MAGIC + json.dumps(manifest).encode() + b"\n"
+    h = hashlib.sha256(header + body)
+    path = str(tmp_path / "bad.snap")
+    open(path, "wb").write(header + body + h.digest())
+    dst = MemoryDB()
+    with pytest.raises(SnapshotError, match="entry count"):
+        restore_snapshot(path, dst)
+    assert dst.get(b"k", b"kv") is None            # nothing was written
+
+
 def test_snapshot_native_db_scan(tmp_path):
     from tpubft.storage.native import NativeDB
     src = NativeDB(os.path.join(str(tmp_path), "src.kvlog"))

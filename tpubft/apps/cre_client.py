@@ -16,7 +16,7 @@ import json
 import sys
 import time
 
-from tpubft.apps.tester_client import make_client
+from tpubft.apps.tester_client import add_scheme_args, make_client
 from tpubft.client.cre import ClientReconfigurationEngine
 
 
@@ -31,6 +31,7 @@ def main() -> int:
     ap.add_argument("--polls", type=int, default=0,
                     help="exit after N polls (0 = run forever)")
     ap.add_argument("--period", type=float, default=1.0)
+    add_scheme_args(ap)
     args = ap.parse_args()
 
     kv = make_client(args, 0)     # client id = n + args.client_idx

@@ -39,6 +39,17 @@ def endpoint_table(base_port: int, n: int, num_clients: int,
     return eps
 
 
+def add_scheme_args(ap) -> None:
+    """Crypto-scheme flags shared by every cluster binary (replica,
+    TesterClient, TesterCRE): client and replica processes must generate
+    matching keys, so the flag names and defaults live in ONE place —
+    against a cluster running non-default schemes (config 3/5: ecdsa
+    clients, threshold BLS) a mismatched client is rejected on every
+    request."""
+    ap.add_argument("--threshold-scheme", default="multisig-ed25519")
+    ap.add_argument("--client-sig-scheme", default="ed25519")
+
+
 def run_replica(args) -> None:
     cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f,
                         num_of_client_proxies=args.clients)

@@ -16,7 +16,7 @@ import argparse
 import os
 import time
 
-from tpubft.apps.simple_test import endpoint_table
+from tpubft.apps.simple_test import add_scheme_args, endpoint_table
 from tpubft.comm import CommConfig, create_communication
 from tpubft.consensus.keys import ClusterKeys
 from tpubft.kvbc.replica import KvbcReplica
@@ -92,8 +92,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--work-window", type=int, default=300)
     p.add_argument("--kvbc-version", default="categorized",
                    choices=("categorized", "v4"))
-    p.add_argument("--threshold-scheme", default="multisig-ed25519")
-    p.add_argument("--client-sig-scheme", default="ed25519")
+    add_scheme_args(p)
     return p
 
 

@@ -19,7 +19,7 @@ import sys
 import threading
 import time
 
-from tpubft.apps.simple_test import endpoint_table
+from tpubft.apps.simple_test import add_scheme_args, endpoint_table
 from tpubft.apps.skvbc import SkvbcClient
 from tpubft.bftclient import BftClient, ClientConfig
 from tpubft.comm import CommConfig, PlainUdpCommunication
@@ -29,7 +29,9 @@ from tpubft.utils.config import ReplicaConfig
 
 def make_client(args, idx: int) -> SkvbcClient:
     cfg = ReplicaConfig(f_val=args.f, c_val=args.c,
-                        num_of_client_proxies=args.clients)
+                        num_of_client_proxies=args.clients,
+                        threshold_scheme=args.threshold_scheme,
+                        client_sig_scheme=args.client_sig_scheme)
     n = cfg.n_val
     client_id = n + args.client_idx + idx
     keys = ClusterKeys.generate(cfg, args.clients,
@@ -114,6 +116,7 @@ def main() -> int:
     ap.add_argument("--write-ratio", type=float, default=0.6)
     ap.add_argument("--timeout-ms", type=int, default=8000)
     ap.add_argument("--workload-seed", type=int, default=0xC11E47)
+    add_scheme_args(ap)
     args = ap.parse_args()
     summary = run_workload(args)
     print(json.dumps(summary))

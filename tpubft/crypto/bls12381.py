@@ -799,7 +799,11 @@ def batch_verify_shares(pks_g2: Sequence, h_g1, shares_g1: Sequence) -> bool:
         return True
     if any(s is None or not g1_is_on_curve(s) for s in shares_g1):
         return False
-    ctx = b"".join(g1_compress(s) for s in shares_g1)
+    # bind the full statement (message point + every pk + every share)
+    # into the coefficient transcript, per standard batch-verify practice
+    ctx = (g1_compress(h_g1)
+           + b"".join(g2_compress(p) for p in pks_g2)
+           + b"".join(g1_compress(s) for s in shares_g1))
     zs = _rlc_scalars(len(shares_g1), ctx)
     agg_sig = g1_msm(shares_g1, zs)
     agg_pk = g2_msm(pks_g2, zs)

@@ -261,7 +261,12 @@ class BlsThresholdVerifier(IThresholdVerifier):
                                     (hs[0], self._master_pk)])
             out[idxs[0]] = ok
             return out
-        ctx = b"certs" + b"".join(bls.g1_compress(p) for p in pts)
+        # the RLC transcript binds the FULL statement (master pk, each
+        # digest, each signature) so coefficients are fixed only after
+        # the adversary committed to every input, not just the sigs
+        ctx = (b"certs" + bls.g2_compress(self._master_pk)
+               + b"".join(items[i][0] + bls.g1_compress(p)
+                          for i, p in zip(idxs, pts)))
         zs = bls._rlc_scalars(len(pts), ctx)
         agg_sig = bls.g1_msm(pts, zs)
         agg_h = bls.g1_msm(hs, zs)

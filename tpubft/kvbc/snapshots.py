@@ -57,7 +57,7 @@ def create_snapshot(db: IDBClient, path: str,
     try:
         with os.fdopen(sfd, "wb") as sp:
             for fam, key, val in db.scan_all():
-                if any(fam.startswith(e) for e in exclude):
+                if fam in exclude:       # exact family match
                     continue
                 if filter_fn is not None and not filter_fn(fam):
                     continue
@@ -106,53 +106,58 @@ def restore_snapshot(path: str, db: IDBClient,
     the snapshot's families) — two sequential passes over the file, O(1)
     memory. Returns the manifest.
 
-    The digest is checked in a FIRST full pass before any write reaches
-    the DB, so a corrupt snapshot never leaves a half-restored store."""
+    The digest, record framing, AND manifest entry count are all checked
+    in a FIRST full pass before any write reaches the DB, so a corrupt
+    or self-inconsistent snapshot never leaves a half-restored store."""
     size = os.path.getsize(path)
     if size < len(MAGIC) + 32:
         raise SnapshotError("truncated snapshot")
     body_len = size - 32
-    # pass 1: integrity
+    # pass 1: integrity + framing + count — no DB writes yet
     h = hashlib.sha256()
     with open(path, "rb") as f:
         magic = f.read(len(MAGIC))
         if magic != MAGIC:
             raise SnapshotError("not a tpubft snapshot")
         h.update(magic)
-        remaining = body_len - len(MAGIC)
-        while remaining:
-            chunk = f.read(min(1 << 20, remaining))
-            if not chunk:
-                raise SnapshotError("truncated snapshot")
-            h.update(chunk)
-            remaining -= len(chunk)
+        header = f.readline()
+        h.update(header)
+        try:
+            manifest = json.loads(header.decode())
+            expected_entries = int(manifest["entries"])
+        except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
+            raise SnapshotError(f"corrupt snapshot header: {e}") from e
+        counted = 0
+        while f.tell() < body_len:
+            hdr = f.read(10)
+            if len(hdr) != 10 or f.tell() > body_len:
+                raise SnapshotError("corrupt record")
+            fl, kl, vl = struct.unpack("<HII", hdr)
+            if f.tell() + fl + kl + vl > body_len:
+                raise SnapshotError("corrupt record")
+            body = f.read(fl + kl + vl)
+            h.update(hdr)
+            h.update(body)
+            counted += 1
         if f.read(32) != h.digest():
             raise SnapshotError("snapshot integrity check failed")
-    # pass 2: restore
+        if counted != expected_entries:
+            raise SnapshotError(
+                f"entry count mismatch: {counted} != {expected_entries}")
+    # pass 2: restore (file already fully validated)
     with open(path, "rb") as f:
         f.read(len(MAGIC))
-        manifest = json.loads(f.readline().decode())
+        f.readline()
         wb = WriteBatch()
-        seen = 0
-
-        def need(n: int) -> bytes:
-            if f.tell() + n > body_len:
-                raise SnapshotError("corrupt record")
-            return f.read(n)
-
         while f.tell() < body_len:
-            fl, kl, vl = struct.unpack("<HII", need(10))
-            fam = need(fl)
-            key = need(kl)
-            val = need(vl)
+            fl, kl, vl = struct.unpack("<HII", f.read(10))
+            fam = f.read(fl)
+            key = f.read(kl)
+            val = f.read(vl)
             wb.put(key, val, fam)
-            seen += 1
             if len(wb) >= batch_entries:
                 db.write(wb)
                 wb = WriteBatch()
     if len(wb):
         db.write(wb)
-    if seen != manifest["entries"]:
-        raise SnapshotError(
-            f"entry count mismatch: {seen} != {manifest['entries']}")
     return manifest

@@ -38,10 +38,24 @@ class FlushBatcher(Generic[T]):
 
     def submit(self, item: T) -> None:
         with self._wake:
-            self._pending.append(item)
-            if len(self._pending) == 1 \
-                    or len(self._pending) >= self._batch_size:
-                self._wake.notify()
+            if self._running:
+                self._pending.append(item)
+                if len(self._pending) == 1 \
+                        or len(self._pending) >= self._batch_size:
+                    self._wake.notify()
+                return
+        # stopped batcher never drains: resolve the item now (outside
+        # the lock — on_drop may re-enter) so no waiter hangs on a
+        # PendingVerdict that never settles
+        self._drop_one(item)
+
+    def _drop_one(self, item: T) -> None:
+        if self._on_drop is None:
+            return
+        try:
+            self._on_drop(item)
+        except Exception:  # noqa: BLE001 — one bad callback must not
+            pass           # strand the remaining waiters
 
     def _run(self) -> None:
         while self._running:
@@ -61,19 +75,18 @@ class FlushBatcher(Generic[T]):
                 get_logger("batcher").exception("drain raised (%s)",
                                                 self._thread.name)
                 # waiters on the failed batch must still resolve
-                if self._on_drop is not None:
-                    for item in batch:
-                        try:
-                            self._on_drop(item)
-                        except Exception:  # noqa: BLE001
-                            pass
+                for item in batch:
+                    self._drop_one(item)
 
     def stop(self) -> None:
-        self._running = False
         with self._wake:
+            self._running = False
             self._wake.notify()
         self._thread.join(timeout=2)
-        if self._on_drop is not None:
-            for item in self._pending:
-                self._on_drop(item)
-        self._pending = []
+        # swap the residue under the lock: a wedged worker (join timed
+        # out) or a racing submit must not observe a half-drained list
+        # or double-resolve items the worker is still posting verdicts on
+        with self._wake:
+            residue, self._pending = self._pending, []
+        for item in residue:
+            self._drop_one(item)
