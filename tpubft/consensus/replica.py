@@ -234,6 +234,21 @@ class Replica(IReceiver):
             lambda cookie, ok: self.incoming.push_internal(
                 "cert_verified", (cookie[0], cookie[1], ok)),
             flush_us=cfg.verify_batch_flush_us)
+        # admission verification batcher: ClientRequest signature checks
+        # leave the dispatcher thread and verify in cross-request batches
+        # (ONE device dispatch per flush window with the TPU backend) —
+        # under a client flood the primary's dispatcher is no longer the
+        # serial per-sig bottleneck (reference: RequestThreadPool role in
+        # onMessage<ClientRequestMsg>, ReplicaImp.cpp:397)
+        self.req_batcher = None
+        self._req_verifying: set = set()
+        if cfg.async_verification:
+            from tpubft.consensus.sig_manager import BatchVerifier
+            self.req_batcher = BatchVerifier(
+                self.sig, batch_size=cfg.verify_batch_size,
+                flush_us=cfg.verify_batch_flush_us)
+            self.dispatcher.register_internal("req_verified",
+                                              self._on_req_verified)
 
         # retransmissions (reference RetransmissionsManager +
         # sendRetransmittableMsgToReplica, ReplicaImp.cpp:2531)
@@ -454,6 +469,8 @@ class Replica(IReceiver):
         self.dispatcher.stop()
         self.collector_pool.shutdown()
         self.cert_batcher.stop()
+        if self.req_batcher is not None:
+            self.req_batcher.stop()
         if self.preprocessor:
             self.preprocessor.shutdown()
         self.comm.stop()
@@ -619,8 +636,39 @@ class Replica(IReceiver):
         # every batch it lands in (backups reject the whole PrePrepare)
         if req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
             return
+        if self.req_batcher is not None:
+            # async plane: the signature check leaves the dispatcher and
+            # verifies in a cross-request batch; the verdict re-enters as
+            # the "req_verified" internal message and the post-admission
+            # logic (which re-reads mutable state) runs then
+            key = (client, req.req_seq_num, int(req.flags))
+            if key in self._req_verifying:
+                return            # retransmission of an in-flight verify
+            self._req_verifying.add(key)
+            self.req_batcher.submit_nowait(
+                client, req.signed_payload(), req.signature,
+                lambda ok, _req=req: self.incoming.push_internal(
+                    "req_verified", (_req, ok)))
+            return
         if not self.sig.verify(client, req.signed_payload(), req.signature):
             return
+        self._post_admission(req)
+
+    def _on_req_verified(self, payload) -> None:
+        """Admission-batch verdict (dispatcher thread)."""
+        req, ok = payload
+        self._req_verifying.discard(
+            (req.sender_id, req.req_seq_num, int(req.flags)))
+        if not ok:
+            return
+        self._post_admission(req)
+
+    def _post_admission(self, req: m.ClientRequestMsg) -> None:
+        """Everything after the client-signature check. With the async
+        plane the world may have moved since the request arrived (view
+        change, reply cached) — all state reads happen here, not before
+        the verify."""
+        client = req.sender_id
         if req.flags & m.RequestFlag.READ_ONLY:
             # replied directly — MUST NOT advance the client's
             # last-executed counter (that would make _execute_committed

@@ -321,18 +321,30 @@ class BatchVerifier:
         self._sm = sig_manager
         self._batcher = FlushBatcher(
             self._drain, batch_size=batch_size, flush_us=flush_us,
-            on_drop=lambda item: item[3].set(False),  # waiters must not hang
+            on_drop=lambda item: item[3](False),  # waiters must not hang
             name="batch-verifier")
 
     def submit(self, principal: int, data: bytes, sig: bytes) -> PendingVerdict:
         verdict = PendingVerdict()
-        self._batcher.submit((principal, data, sig, verdict))
+        self._batcher.submit((principal, data, sig, verdict.set))
         return verdict
+
+    def submit_nowait(self, principal: int, data: bytes, sig: bytes,
+                      resolve) -> None:
+        """Callback-style submission: `resolve(ok)` fires on the worker
+        thread once the batch containing this item drains (False if the
+        batch is dropped or the batcher is stopped). This is the
+        non-blocking entry the replica's admission path uses — the
+        dispatcher thread never waits on a verdict."""
+        self._batcher.submit((principal, data, sig, resolve))
 
     def _drain(self, batch) -> None:
         verdicts = self._sm.verify_batch([(p, d, s) for p, d, s, _ in batch])
-        for (_, _, _, v), ok in zip(batch, verdicts):
-            v.set(ok)
+        for (_, _, _, resolve), ok in zip(batch, verdicts):
+            try:
+                resolve(ok)
+            except Exception:  # noqa: BLE001 — one bad callback must not
+                pass           # fail the whole batch (double-resolving it)
 
     def stop(self) -> None:
         self._batcher.stop()
