@@ -1,0 +1,108 @@
+"""S3 wire-protocol object store (reference storage/src/s3/client.cpp)
+against the in-repo S3-compatible test server — real HTTP, real SigV4."""
+import pytest
+
+from tpubft.storage.s3 import S3Error, S3ObjectStore
+from tpubft.testing.s3server import S3TestServer
+
+
+@pytest.fixture()
+def server():
+    with S3TestServer(access_key="test-ak", secret_key="test-sk",
+                      max_keys=3) as srv:
+        yield srv
+
+
+def _store(srv, **kw):
+    return S3ObjectStore(srv.endpoint, "bkt", access_key="test-ak",
+                         secret_key="test-sk", **kw)
+
+
+def test_put_get_exists_delete_roundtrip(server):
+    st = _store(server)
+    assert st.get("a/b") is None
+    assert not st.exists("a/b")
+    st.put("a/b", b"block-payload")
+    assert st.exists("a/b")
+    assert st.get("a/b") == b"block-payload"
+    st.delete("a/b")
+    assert st.get("a/b") is None
+    st.delete("a/b")                      # idempotent
+
+
+def test_sigv4_rejected_on_wrong_secret(server):
+    bad = S3ObjectStore(server.endpoint, "bkt", access_key="test-ak",
+                        secret_key="WRONG")
+    with pytest.raises(S3Error, match="403"):
+        bad.put("k", b"v")
+    with pytest.raises(S3Error, match="403"):
+        bad.get("k")
+
+
+def test_unsigned_client_rejected_when_server_requires_auth(server):
+    anon = S3ObjectStore(server.endpoint, "bkt")
+    with pytest.raises(S3Error, match="403"):
+        anon.put("k", b"v")
+
+
+def test_integrity_seal_survives_the_wire(server):
+    st = _store(server)
+    st.put("blocks/1", b"payload-1")
+    server.corrupt("bkt/blocks/1")
+    assert st.get("blocks/1") is None     # corrupt read -> None, not junk
+
+
+def test_list_paginates_with_continuation_tokens(server):
+    st = _store(server)
+    for i in range(10):
+        st.put(f"blk/{i:04d}", b"x")
+    st.put("other/zzz", b"y")
+    # server pages at max_keys=3: full listing requires 4 continuations
+    assert list(st.list("blk/")) == [f"blk/{i:04d}" for i in range(10)]
+    assert list(st.list()) == [f"blk/{i:04d}" for i in range(10)] \
+        + ["other/zzz"]
+
+
+def test_keys_needing_url_encoding_sign_correctly(server):
+    """Keys with spaces/'+'/unicode must survive SigV4 canonicalization
+    (the signature is over the raw path, quoted exactly once)."""
+    st = _store(server)
+    for key in ("a key/with spaces", "plus+plus", "uni/éé"):
+        st.put(key, key.encode())
+        assert st.exists(key)
+        assert st.get(key) == key.encode()
+    assert "a key/with spaces" in list(st.list("a key/"))
+
+
+def test_key_prefix_namespacing(server):
+    a = _store(server, prefix="replica-4/")
+    b = _store(server, prefix="replica-5/")
+    a.put("blocks/1", b"from-a")
+    b.put("blocks/1", b"from-b")
+    assert a.get("blocks/1") == b"from-a"
+    assert b.get("blocks/1") == b"from-b"
+    assert list(a.list()) == ["blocks/1"]
+
+
+def test_server_error_surfaces_as_s3error(server):
+    st = _store(server)
+    server.fail_next = 1
+    with pytest.raises(S3Error, match="500"):
+        st.put("k", b"v")
+    st.put("k", b"v")                     # next request succeeds
+    assert st.get("k") == b"v"
+
+
+def test_ro_replica_archives_to_s3(server):
+    """The RO replica's archival duty rides the S3 backend unchanged
+    (same IObjectStore seam as the filesystem store)."""
+    from tpubft.kvbc.readonly import archive_key
+    from tpubft.storage.s3 import S3ObjectStore
+
+    st = S3ObjectStore(server.endpoint, "bkt", access_key="test-ak",
+                       secret_key="test-sk", prefix="ro-4/")
+    # mimic the archival writes ReadOnlyReplica performs per block
+    for blk in (1, 2, 3):
+        st.put(archive_key(blk), b"raw-block-%d" % blk)
+    assert [archive_key(b) for b in (1, 2, 3)] == list(st.list())
+    assert st.get(archive_key(2)) == b"raw-block-2"

@@ -14,7 +14,7 @@ from __future__ import annotations
 import argparse
 import time
 
-from tpubft.apps.simple_test import endpoint_table
+from tpubft.apps.simple_test import add_scheme_args, endpoint_table
 from tpubft.comm import CommConfig, create_communication
 from tpubft.consensus.keys import ClusterKeys
 from tpubft.kvbc.readonly import ReadOnlyReplica
@@ -37,18 +37,29 @@ def main() -> None:
     p.add_argument("--base-port", type=int, default=3710)
     p.add_argument("--metrics-port", type=int, default=0)
     p.add_argument("--archive-dir", default=None)
+    p.add_argument("--s3-endpoint", default=None,
+                   help="archive to an S3-compatible store (host:port) "
+                        "instead of --archive-dir")
+    p.add_argument("--s3-bucket", default="tpubft-archive")
+    p.add_argument("--s3-access-key", default="")
+    p.add_argument("--s3-secret-key-env", default="TPUBFT_S3_SECRET",
+                   help="env var holding the secret key (never a flag: "
+                        "argv is world-readable)")
     p.add_argument("--seed", default="tpubft-skvbc")
     p.add_argument("--checkpoint-window", type=int, default=150)
     p.add_argument("--transport", default="udp",
                    choices=("udp", "tcp", "tls"))
     p.add_argument("--certs-dir", default=None,
                    help="TLS material dir (node-<id>.key/.crt)")
+    add_scheme_args(p)
     args = p.parse_args()
 
     cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f, c_val=args.c,
                         num_ro_replicas=args.ro,
                         num_of_client_proxies=args.clients,
-                        checkpoint_window_size=args.checkpoint_window)
+                        checkpoint_window_size=args.checkpoint_window,
+                        threshold_scheme=args.threshold_scheme,
+                        client_sig_scheme=args.client_sig_scheme)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()
                                 ).for_node(args.replica)
@@ -65,7 +76,16 @@ def main() -> None:
     else:
         comm_cfg = CommConfig(self_id=args.replica, endpoints=eps)
     comm = create_communication(comm_cfg, args.transport)
-    store = FsObjectStore(args.archive_dir) if args.archive_dir else None
+    if args.s3_endpoint:
+        import os as _os
+
+        from tpubft.storage.s3 import S3ObjectStore
+        store = S3ObjectStore(args.s3_endpoint, args.s3_bucket,
+                              access_key=args.s3_access_key,
+                              secret_key=_os.environ.get(
+                                  args.s3_secret_key_env, ""))
+    else:
+        store = FsObjectStore(args.archive_dir) if args.archive_dir else None
     agg = Aggregator()
     ro = ReadOnlyReplica(cfg, keys, comm, object_store=store,
                          aggregator=agg, st_cfg=StConfig())

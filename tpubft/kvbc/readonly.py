@@ -50,6 +50,16 @@ log = get_logger("ro_replica")
 _K_ARCHIVED = b"ro.archived_to"
 
 
+def archive_key(block_id: int) -> str:
+    """Object-store key for an archived raw block (zero-padded so
+    lexicographic list order == block order)."""
+    return f"blocks/{block_id:020d}"
+
+
+def digest_key(block_id: int) -> str:
+    return f"digests/{block_id:020d}"
+
+
 class ReadOnlyReplica(IReceiver):
     def __init__(self, cfg: ReplicaConfig, keys: ClusterKeys,
                  comm: ICommunication,
@@ -247,8 +257,8 @@ class ReadOnlyReplica(IReceiver):
             raw = self.blockchain.get_raw_block(bid)
             if raw is None:
                 break
-            self.store.put(f"blocks/{bid:020d}", raw)
-            self.store.put(f"digests/{bid:020d}",
+            self.store.put(archive_key(bid), raw)
+            self.store.put(digest_key(bid),
                            self.blockchain.block_digest(bid))
             self.db.put(_K_ARCHIVED, bid.to_bytes(8, "big"))
         self.m_archived.set(self.archived_to)
@@ -269,7 +279,7 @@ class ReadOnlyReplica(IReceiver):
         for key in self.store.list("blocks/"):
             bid = int(key.split("/")[1])
             raw = self.store.get(key)
-            dig = self.store.get(f"digests/{bid:020d}")
+            dig = self.store.get(digest_key(bid))
             if raw is None or dig is None:
                 bad += 1
             elif hashlib.sha256(raw).digest() != dig:
