@@ -19,7 +19,12 @@ from tpubft.testing import InProcessCluster
 # the cluster tests must prove consensus stays live even when every
 # verification pays a full device dispatch, because the async verify plane
 # keeps those dispatches off the dispatcher thread
-TPU_CFG = {"crypto_backend": "tpu", "device_min_verify_batch": 1}
+TPU_CFG = {"crypto_backend": "tpu", "device_min_verify_batch": 1,
+           # on the CPU-JAX test backend every dispatch is ~0.3s and the
+           # whole suite shares one core: a 4s VC timer turns transient
+           # load into a view-change spiral. VC behavior has its own
+           # tests; these tests are about the device verification plane.
+           "view_change_timer_ms": 30000}
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -83,7 +88,10 @@ def test_tpu_multisig_threshold_verifier():
 
 
 @pytest.mark.slow
-def test_tpu_bls_combine_matches_cpu():
+def test_tpu_bls_combine_matches_cpu(monkeypatch):
+    # force the DEVICE combine even at k=3 (production crossover keeps
+    # small quorums on the host Pippenger path)
+    monkeypatch.setenv("TPUBFT_MSM_CROSSOVER_K", "1")
     from tpubft.crypto import bls12381 as bls
     from tpubft.crypto.interfaces import Cryptosystem
     from tpubft.crypto.tpu import make_threshold_verifier
@@ -148,7 +156,10 @@ def test_ordering_continues_while_batch_in_flight():
         first = [True]
 
         def gated(items, seq=None):
-            if first[0]:                       # seq 1's PrePrepare batch
+            # target seq 1's PrePrepare batch specifically: admission
+            # batches (seq=None) ride a different worker and must not
+            # spring the trap
+            if first[0] and seq == 1:
                 first[0] = False
                 blocked.set()
                 gate.wait(20)

@@ -117,8 +117,14 @@ class Replica(IReceiver):
         # crypto backend selection (the project's north star: the same
         # plugin boundaries the reference routes to CPU crypto —
         # SigManager.cpp:197, IThresholdVerifier.h:23 — route to the
-        # batched TPU kernels when crypto_backend == "tpu")
-        backend = cfg.crypto_backend
+        # batched TPU kernels when crypto_backend == "tpu"; "auto"
+        # probes for a real device safely and picks for you)
+        from tpubft.crypto.backend import resolve_backend
+        backend = self.crypto_backend = resolve_backend(cfg.crypto_backend)
+        # write the RESOLVED backend back: every later consumer of the
+        # config (device hashing in kvbc, the startup log, metrics) must
+        # see "cpu"/"tpu", never the unresolved "auto"
+        cfg.crypto_backend = backend
         batch_fn = None
         if backend == "tpu":
             from tpubft.crypto import tpu as tpu_backend
@@ -260,8 +266,7 @@ class Replica(IReceiver):
                 comm, min_timeout_ms=cfg.retransmission_timer_ms // 2 or 10,
                 max_timeout_ms=cfg.retransmission_timer_ms * 20)
             self.dispatcher.add_timer(
-                cfg.retransmission_timer_ms / 1000.0,
-                lambda: self.retrans.tick(time.monotonic()))
+                cfg.retransmission_timer_ms / 1000.0, self._retrans_tick)
             self.dispatcher.add_timer(
                 cfg.retransmission_timer_ms * 4 / 1000.0,
                 self._check_missing_data)
@@ -283,6 +288,8 @@ class Replica(IReceiver):
         self.m_view = self.metrics.register_gauge("view")
         self.m_last_executed = self.metrics.register_gauge("last_executed_seq")
         self.m_last_stable = self.metrics.register_gauge("last_stable_seq")
+        self.m_retransmitted = self.metrics.register_gauge(
+            "retransmitted_total")
         # a recovered replica must REPORT its recovered position — these
         # gauges otherwise read 0 until the next execution, making an
         # idle-after-restart replica look like it lost its state
@@ -636,6 +643,30 @@ class Replica(IReceiver):
         # every batch it lands in (backups reject the whole PrePrepare)
         if req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
             return
+        if not req.flags & m.RequestFlag.READ_ONLY:
+            if not self.is_primary or self.in_view_change:
+                # backup: forward FIRST, unverified — forwarding is cheap
+                # and not a commitment (the primary verifies); the verify
+                # below is paid ONCE per request, only to arm the
+                # dead-primary liveness clock honestly (complaints must
+                # never be armed by forged floods)
+                if (client, req.req_seq_num) in self._forwarded:
+                    return        # already forwarded + liveness armed
+                if not self.in_view_change:
+                    self.comm.send(self.primary, req.pack())
+            else:
+                # primary fast drop BEFORE paying for verification: a
+                # pending or already-executed request needs no new
+                # signature work (the retransmission path — reference
+                # ClientsManager duplicate handling). Resending a cached
+                # reply unverified is bounded, client-addressed traffic.
+                if not self.clients.can_become_pending(client,
+                                                       req.req_seq_num):
+                    cached = self.clients.cached_reply(client,
+                                                       req.req_seq_num)
+                    if cached is not None:
+                        self.comm.send(client, cached.pack())
+                    return
         if self.req_batcher is not None:
             # async plane: the signature check leaves the dispatcher and
             # verifies in a cross-request batch; the verdict re-enters as
@@ -653,6 +684,10 @@ class Replica(IReceiver):
         if not self.sig.verify(client, req.signed_payload(), req.signature):
             return
         self._post_admission(req)
+
+    def _retrans_tick(self) -> None:
+        self.retrans.tick(time.monotonic())
+        self.m_retransmitted.set(self.retrans.total_retransmitted)
 
     def _on_req_verified(self, payload) -> None:
         """Admission-batch verdict (dispatcher thread)."""
@@ -695,13 +730,11 @@ class Replica(IReceiver):
             self.comm.send(client, cached.pack())
             return
         if not self.is_primary or self.in_view_change:
-            # forward to the current primary (reference forwards or the
-            # client retransmits; forwarding is cheap and speeds recovery);
-            # remember it so a dead primary is detected (liveness → complaint)
-            if not self.in_view_change:
-                self.comm.send(self.primary, req.pack())
-            # first-sighting timestamp only: retransmissions must not reset
-            # the liveness clock or the complaint never fires
+            # the forward itself happened at arrival (pre-verify); here —
+            # with the signature now checked — arm the dead-primary
+            # liveness clock. First-sighting timestamp only:
+            # retransmissions must not reset it or the complaint never
+            # fires.
             self._forwarded.setdefault((client, req.req_seq_num),
                                        time.monotonic())
             return

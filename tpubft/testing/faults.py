@@ -17,27 +17,79 @@ Every command answers with the current fault state.
 """
 from __future__ import annotations
 
+import heapq
 import json
 import random
 import socket
 import threading
+import time
 from typing import Optional, Set
 
 from tpubft.comm.interfaces import ICommunication, IReceiver, NodeNum
 from tpubft.testing.byzantine import WrapCommunication
 
 
+class _DelayScheduler:
+    """Single-thread delayed-send executor (the tc/netem delay queue):
+    callbacks fire in due-time order, so a larger jitter draw can reorder
+    deliveries exactly like netem does."""
+
+    def __init__(self) -> None:
+        self._heap = []                # (due, seq, fn)
+        self._seq = 0
+        self._cv = threading.Condition()
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="delay-sched")
+        self._thread.start()
+
+    def schedule(self, delay_s: float, fn) -> None:
+        with self._cv:
+            heapq.heappush(self._heap,
+                           (time.monotonic() + delay_s, self._seq, fn))
+            self._seq += 1
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+                if not self._heap:
+                    self._cv.wait(timeout=0.5)
+                    continue
+                due, _, fn = self._heap[0]
+                now = time.monotonic()
+                if due > now:
+                    self._cv.wait(timeout=min(due - now, 0.5))
+                    continue
+                heapq.heappop(self._heap)
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — transport may be stopping
+                pass
+
+    def stop(self) -> None:
+        with self._cv:
+            self._running = False
+            self._cv.notify()
+
+
 class FaultyComm(WrapCommunication):
     """Transport wrapper with runtime-mutable drop sets: outbound drops by
-    destination, inbound drops by transport sender, and uniform
-    probabilistic loss (both directions)."""
+    destination, inbound drops by transport sender, uniform probabilistic
+    loss (both directions), and per-send delay with jitter (the
+    bft_network_traffic_control.py tc/netem role)."""
 
     def __init__(self, inner: ICommunication) -> None:
         super().__init__(inner, self._mutate_send)
         self.drop_to: Set[int] = set()
         self.drop_from: Set[int] = set()
         self.loss = 0.0
+        self.delay_ms = 0.0
+        self.jitter_ms = 0.0
         self._rng = random.Random(0xFA017)
+        self._sched: Optional[_DelayScheduler] = None
 
     def _mutate_send(self, dest: NodeNum, data: bytes) -> Optional[bytes]:
         if int(dest) in self.drop_to:
@@ -46,22 +98,48 @@ class FaultyComm(WrapCommunication):
             return None
         return data
 
+    def send(self, dest: NodeNum, data: bytes) -> None:
+        out = self._mutate_send(dest, data)
+        if out is None:
+            return
+        if self.delay_ms or self.jitter_ms:
+            delay = max(0.0, (self.delay_ms + self._rng.uniform(
+                -self.jitter_ms, self.jitter_ms)) / 1e3)
+            if self._sched is None:
+                self._sched = _DelayScheduler()
+            self._sched.schedule(delay,
+                                 lambda: self._inner.send(dest, out))
+            return
+        self._inner.send(dest, out)
+
     def start(self, receiver: IReceiver) -> None:
         self._inner.start(_FilteringReceiver(self, receiver))
 
+    def stop(self) -> None:
+        if self._sched is not None:
+            self._sched.stop()
+        super().stop()
+
     # control-server entry
     def configure(self, drop_to=None, drop_from=None,
-                  loss: Optional[float] = None) -> None:
+                  loss: Optional[float] = None,
+                  delay_ms: Optional[float] = None,
+                  jitter_ms: Optional[float] = None) -> None:
         if drop_to is not None:
             self.drop_to = {int(x) for x in drop_to}
         if drop_from is not None:
             self.drop_from = {int(x) for x in drop_from}
         if loss is not None:
             self.loss = float(loss)
+        if delay_ms is not None:
+            self.delay_ms = float(delay_ms)
+        if jitter_ms is not None:
+            self.jitter_ms = float(jitter_ms)
 
     def state(self) -> dict:
         return {"drop_to": sorted(self.drop_to),
-                "drop_from": sorted(self.drop_from), "loss": self.loss}
+                "drop_from": sorted(self.drop_from), "loss": self.loss,
+                "delay_ms": self.delay_ms, "jitter_ms": self.jitter_ms}
 
 
 class _FilteringReceiver(IReceiver):
@@ -114,11 +192,14 @@ class FaultControlServer:
             try:
                 cmd = json.loads(data.decode())
                 if cmd.get("cmd") == "clear":
-                    self._faults.configure(drop_to=(), drop_from=(), loss=0)
+                    self._faults.configure(drop_to=(), drop_from=(), loss=0,
+                                           delay_ms=0, jitter_ms=0)
                 elif cmd.get("cmd") == "set":
                     self._faults.configure(cmd.get("drop_to"),
                                            cmd.get("drop_from"),
-                                           cmd.get("loss"))
+                                           cmd.get("loss"),
+                                           cmd.get("delay_ms"),
+                                           cmd.get("jitter_ms"))
                 reply = json.dumps(self._faults.state()).encode()
             except (ValueError, KeyError) as e:
                 reply = json.dumps({"error": str(e)}).encode()

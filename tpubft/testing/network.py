@@ -64,6 +64,7 @@ class MetricsClient:
 
 class BftTestNetwork:
     def __init__(self, f: int = 1, c: int = 0, num_clients: int = 4,
+                 num_ro: int = 0,
                  base_port: Optional[int] = None,
                  db_dir: Optional[str] = None,
                  seed: str = "apollo-net",
@@ -77,11 +78,14 @@ class BftTestNetwork:
                  client_sig_scheme: str = "ed25519") -> None:
         self.f, self.c = f, c
         self.n = 3 * f + 2 * c + 1
+        self.num_ro = num_ro
         self.num_clients = num_clients
         self.seed = seed
         self.base_port = base_port or random.randint(20000, 50000)
         self.metrics_base = self.base_port + 1000
         self.fault_base = self.base_port + 2000
+        self.trs_base = self.base_port + 3000   # thin-replica servers
+        self.diag_base = self.base_port + 4000  # diagnostics admin servers
         self.db_dir = db_dir
         self.view_change_timeout_ms = view_change_timeout_ms
         self.crypto_backend = crypto_backend
@@ -113,10 +117,17 @@ class BftTestNetwork:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
-    def start_all(self) -> "BftTestNetwork":
-        for r in range(self.n):
-            self.start_replica(r)
-        self.wait_for_replicas_up(timeout=30)
+    def start_all(self, timeout: float = 30.0) -> "BftTestNetwork":
+        try:
+            for r in range(self.n):
+                self.start_replica(r)
+            self.wait_for_replicas_up(timeout=timeout)
+        except BaseException:
+            # a failed startup must not leak live replica processes (a
+            # 31-process orphan herd from one failed start poisons every
+            # later measurement on the host)
+            self.stop_all()
+            raise
         return self
 
     def start_replica(self, r: int) -> None:
@@ -130,6 +141,7 @@ class BftTestNetwork:
                    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="2")
         args = [sys.executable, "-m", "tpubft.apps.skvbc_replica",
                 "--replica", str(r), "--f", str(self.f), "--c", str(self.c),
+                "--ro", str(self.num_ro),
                 "--clients", str(self.num_clients),
                 "--base-port", str(self.base_port),
                 "--metrics-port", str(self.metrics_base + r),
@@ -137,6 +149,8 @@ class BftTestNetwork:
                 "--view-change-timeout-ms",
                 str(self.view_change_timeout_ms),
                 "--fault-port", str(self.fault_base + r),
+                "--trs-port", str(self.trs_base + r),
+                "--diag-port", str(self.diag_base + r),
                 "--crypto-backend", self.crypto_backend,
                 "--checkpoint-window", str(self.checkpoint_window),
                 "--work-window", str(self.work_window),
@@ -161,6 +175,39 @@ class BftTestNetwork:
                                          stderr=err)
         if out is not subprocess.DEVNULL:
             out.close()                   # child keeps its own fd
+
+    def start_ro_replica(self, idx: int = 0,
+                         extra_args: Optional[List[str]] = None,
+                         extra_env: Optional[Dict[str, str]] = None) -> int:
+        """Spawn a read-only replica process (id n+idx) — the archival
+        follower (reference RO TesterReplica variant). Returns its id."""
+        rid = self.n + idx
+        assert idx < self.num_ro, "construct the network with num_ro"
+        env = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu",
+                   **(extra_env or {}))
+        args = [sys.executable, "-m", "tpubft.apps.ro_replica",
+                "--replica", str(rid), "--f", str(self.f),
+                "--c", str(self.c), "--ro", str(self.num_ro),
+                "--clients", str(self.num_clients),
+                "--base-port", str(self.base_port),
+                "--metrics-port", str(self.metrics_base + rid),
+                "--seed", self.seed,
+                "--checkpoint-window", str(self.checkpoint_window),
+                "--threshold-scheme", self.threshold_scheme,
+                "--client-sig-scheme", self.client_sig_scheme,
+                "--transport", self.transport] + (extra_args or [])
+        if self.certs_dir:
+            args += ["--certs-dir", self.certs_dir]
+        if self.db_dir:
+            log = open(os.path.join(self.db_dir, f"ro-{rid}.log"), "ab")
+            out = err = log
+        else:
+            out = err = subprocess.DEVNULL
+        self.procs[rid] = subprocess.Popen(args, env=env, stdout=out,
+                                           stderr=err)
+        if out is not subprocess.DEVNULL:
+            out.close()
+        return rid
 
     def stop_all(self) -> None:
         for r, p in self.procs.items():
@@ -228,6 +275,16 @@ class BftTestNetwork:
         assert fault_command(self.fault_base + r, cmd="set",
                              loss=loss) is not None
 
+    def set_delay(self, r: int, delay_ms: float,
+                  jitter_ms: float = 0.0) -> None:
+        """Latency shaping at replica r: every outbound message is held
+        delay_ms ± jitter_ms before hitting the wire (the Apollo
+        bft_network_traffic_control.py tc/netem role)."""
+        from tpubft.testing.faults import fault_command
+        assert fault_command(self.fault_base + r, cmd="set",
+                             delay_ms=delay_ms,
+                             jitter_ms=jitter_ms) is not None
+
     def heal(self, r: Optional[int] = None) -> None:
         """Clear all injected faults (for one replica or all)."""
         from tpubft.testing.faults import fault_command
@@ -276,6 +333,7 @@ class BftTestNetwork:
     # ------------------------------------------------------------------
     def _node_cfg(self) -> ReplicaConfig:
         return ReplicaConfig(f_val=self.f, c_val=self.c,
+                             num_ro_replicas=self.num_ro,
                              num_of_client_proxies=self.num_clients,
                              threshold_scheme=self.threshold_scheme,
                              client_sig_scheme=self.client_sig_scheme)
@@ -291,14 +349,15 @@ class BftTestNetwork:
                                                 endpoints=eps))
 
     def client(self, idx: int = 0, **cfg_kw) -> BftClient:
-        client_id = self.n + idx
+        client_id = self.n + self.num_ro + idx
         cl = self._clients.get(client_id)
         if cl is None:
             cfg = self._node_cfg()
             keys = ClusterKeys.generate(
                 cfg, self.num_clients,
                 seed=self.seed.encode()).for_node(client_id)
-            eps = endpoint_table(self.base_port, self.n, self.num_clients)
+            eps = endpoint_table(self.base_port, self.n + self.num_ro,
+                                 self.num_clients)
             comm = self._make_comm(client_id, eps)
             cl = BftClient(ClientConfig(client_id=client_id, f_val=self.f,
                                         c_val=self.c, **cfg_kw), keys, comm)
@@ -322,8 +381,8 @@ class BftTestNetwork:
             keys = ClusterKeys.generate(
                 cfg, self.num_clients,
                 seed=self.seed.encode()).for_node(op_id)
-            eps = endpoint_table(self.base_port, self.n, self.num_clients,
-                                 operator_id=op_id)
+            eps = endpoint_table(self.base_port, self.n + self.num_ro,
+                                 self.num_clients, operator_id=op_id)
             comm = self._make_comm(op_id, eps)
             cl = BftClient(ClientConfig(client_id=op_id, f_val=self.f,
                                         c_val=self.c, **cfg_kw), keys, comm)
