@@ -1,0 +1,55 @@
+"""Crypto backend resolution: cpu | tpu | auto.
+
+The reference selects its crypto engine statically (RELIC/Crypto++ at
+build time); here the analogous choice is which side of the plugin
+boundary executes — host OpenSSL-style verifiers or the batched device
+kernels. "auto" resolves to "tpu" exactly when an accelerator device is
+actually reachable, probed in a SUBPROCESS because device init on this
+class of host can hang indefinitely when the accelerator transport is
+down (observed with the tunneled-TPU plugin) — a hung replica at boot is
+far worse than a slow probe.
+
+Resolution order for "auto":
+  1. TPUBFT_CRYPTO_BACKEND env var ("cpu"/"tpu") — operator override.
+  2. JAX_PLATFORMS forcing cpu — tests / CPU-mesh runs.
+  3. Cached probe result (per process).
+  4. Subprocess device probe with a hard timeout.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_probe_cache: Optional[str] = None
+
+
+def _probe_device(timeout_s: float = 60.0) -> str:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True)
+        for line in r.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                plat = line.split("=", 1)[1].strip()
+                return "tpu" if plat in ("tpu", "axon") else "cpu"
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    return "cpu"
+
+
+def resolve_backend(requested: str) -> str:
+    """Map a configured crypto_backend to a concrete one."""
+    global _probe_cache
+    if requested != "auto":
+        return requested
+    env = os.environ.get("TPUBFT_CRYPTO_BACKEND")
+    if env in ("cpu", "tpu"):
+        return env
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return "cpu"
+    if _probe_cache is None:
+        _probe_cache = _probe_device()
+    return _probe_cache

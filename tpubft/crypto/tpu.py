@@ -165,11 +165,21 @@ class TpuMultisigEd25519Verifier(MultisigEd25519Verifier):
 class TpuBlsThresholdAccumulator(BlsThresholdAccumulator):
     """BLS accumulator combining on device: Lagrange coefficients on host
     (tiny), the [λ_i]·share_i MSM on the TPU (ops/bls12_381.msm) — the
-    role of fastMultExp in BlsThresholdAccumulator.cpp:42-56."""
+    role of fastMultExp in BlsThresholdAccumulator.cpp:42-56.
+
+    Combine-path selection is by quorum size: below the measured
+    crossover (TPUBFT_MSM_CROSSOVER_K, benchmarks/bench_msm_crossover.py)
+    the host Pippenger MSM beats a device dispatch, so small quorums stay
+    on the CPU path even under the tpu backend."""
 
     def get_full_signed_data(self) -> bytes:
+        import os
+        k = self._verifier.threshold
+        crossover = int(os.environ.get("TPUBFT_MSM_CROSSOVER_K", "128"))
+        if len(self._shares) < crossover and k < crossover:
+            return super().get_full_signed_data()
         from tpubft.ops import bls12_381 as dev
-        ids = sorted(self._shares)[: self._verifier.threshold]
+        ids = sorted(self._shares)[:k]
         # shares are affine (x, y) int tuples — the device MSM's native input
         combined = dev.combine_shares(ids, [self._shares[i] for i in ids])
         return bls.g1_compress(combined)

@@ -44,6 +44,11 @@ class KvbcReplica:
                  thin_replica_port: Optional[int] = None) -> None:
         self.db = open_db(db_path)
         from tpubft.kvbc import create_blockchain
+        # resolve "auto" BEFORE the hashing decision below reads it (the
+        # consensus Replica performs the same write-back; both orderings
+        # must agree)
+        from tpubft.crypto.backend import resolve_backend
+        cfg.crypto_backend = resolve_backend(cfg.crypto_backend)
         if use_device_hashing is None:
             # device-backed crypto implies device-backed bulk hashing —
             # Merkle levels and block digests ride the batched SHA-256

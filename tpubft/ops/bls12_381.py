@@ -91,10 +91,13 @@ def msm(points: Sequence, scalars: Sequence[int]):
             infinity[i] = True
     px, py = cv.affine_to_device(pts)
     bits = _bits_msb_batch(ks)
-    x, y, z = msm_kernel(jnp.asarray(bits), jnp.asarray(px), jnp.asarray(py),
-                         jnp.asarray(infinity))
-    return _to_affine_host(np.asarray(x)[:, 0], np.asarray(y)[:, 0],
-                           np.asarray(z)[:, 0])
+    from tpubft.ops.dispatch import device_dispatch
+    with device_dispatch():
+        x, y, z = msm_kernel(jnp.asarray(bits), jnp.asarray(px),
+                             jnp.asarray(py), jnp.asarray(infinity))
+        x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
+    # host-side affine conversion stays OUTSIDE the gate (dispatch.py rule)
+    return _to_affine_host(x[:, 0], y[:, 0], z[:, 0])
 
 
 def _to_affine_host(x_limbs, y_limbs, z_limbs):
@@ -131,7 +134,9 @@ def batch_scalar_mul(points: Sequence, scalars: Sequence[int]) -> List:
         acc = cv.scalar_mul_bits(bits, p)
         return acc.x, acc.y, acc.z
 
-    x, y, z = kern(jnp.asarray(bits), jnp.asarray(px), jnp.asarray(py),
-                   jnp.asarray(infinity))
-    x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
+    from tpubft.ops.dispatch import device_dispatch
+    with device_dispatch():
+        x, y, z = kern(jnp.asarray(bits), jnp.asarray(px), jnp.asarray(py),
+                       jnp.asarray(infinity))
+        x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
     return [_to_affine_host(x[:, i], y[:, i], z[:, i]) for i in range(n)]

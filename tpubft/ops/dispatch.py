@@ -1,0 +1,26 @@
+"""Device dispatch gate — one execution stream to the accelerator.
+
+A TPU chip executes one XLA program at a time per core: concurrent
+host threads submitting programs don't overlap on the device, they
+queue. Modeling that queue explicitly with a process-wide lock keeps
+the host sane too — without it, every verification worker (admission
+batcher, PrePrepare background verify, collector combine jobs, cert
+batcher) materializes its own sharded program simultaneously, and on
+the CPU-mesh test backend (8 virtual devices × N worker threads) the
+oversubscription collapses throughput far below the serial rate.
+
+Hold the gate for submit→materialize of one batch; never while doing
+host-side crypto or holding protocol locks.
+"""
+from __future__ import annotations
+
+import threading
+
+# RLock: a gated section may call another gated helper (e.g. a combine
+# that internally runs a gated MSM)
+_gate = threading.RLock()
+
+
+def device_dispatch():
+    """Context manager serializing device program execution."""
+    return _gate

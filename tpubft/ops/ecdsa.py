@@ -124,6 +124,9 @@ def verify_batch(curve_name: str,
     if curve_name not in _KERNELS:
         _KERNELS[curve_name] = make_verify_kernel(curve_name)
     prep = prepare_batch(curve_name, items)
-    out = _KERNELS[curve_name](prep.u1_bits, prep.u2_bits, prep.qx, prep.qy,
-                               prep.r_raw, prep.r_plus_n_raw)
-    return np.asarray(out) & prep.host_valid
+    from tpubft.ops.dispatch import device_dispatch
+    with device_dispatch():
+        out = _KERNELS[curve_name](prep.u1_bits, prep.u2_bits,
+                                   prep.qx, prep.qy,
+                                   prep.r_raw, prep.r_plus_n_raw)
+        return np.asarray(out) & prep.host_valid

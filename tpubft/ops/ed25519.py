@@ -416,7 +416,9 @@ def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
         width[axis] = (0, m - n)
         return np.pad(a, width)
 
-    dev = kernel(pad(prep.s_win, 1), pad(prep.h_win, 1),
-                 pad(prep.a_y, 1), pad(prep.a_sign, 0),
-                 pad(prep.r_y, 1), pad(prep.r_sign, 0))
-    return np.asarray(dev)[:n] & prep.host_valid
+    from tpubft.ops.dispatch import device_dispatch
+    with device_dispatch():
+        dev = kernel(pad(prep.s_win, 1), pad(prep.h_win, 1),
+                     pad(prep.a_y, 1), pad(prep.a_sign, 0),
+                     pad(prep.r_y, 1), pad(prep.r_sign, 0))
+        return np.asarray(dev)[:n] & prep.host_valid

@@ -52,7 +52,9 @@ class ReplicaConfig:
     key_exchange_on_start: bool = False
 
     # crypto
-    crypto_backend: str = "cpu"         # "cpu" | "tpu"
+    # "auto" resolves to "tpu" when a real accelerator is reachable
+    # (safe subprocess probe — crypto/backend.py), else "cpu"
+    crypto_backend: str = "auto"        # "cpu" | "tpu" | "auto"
     kvbc_version: str = "categorized"   # ledger engine: "categorized" | "v4"
     replica_sig_scheme: str = "ed25519"  # per-message replica signatures
     client_sig_scheme: str = "ed25519"
