@@ -8,10 +8,17 @@ throughput harness kvbc/benchmark/kvbcbench/main.cpp).
 Configs (BASELINE.md):
   1. n=4 (f=1), multisig-ed25519 commit certs   — config 1
   2. n=7 (f=2), threshold-bls commit certs      — config 2
+  3. n=31 (f=10), secp256k1 client sigs + threshold-bls commit certs
+     (the Apollo 31-replica cluster shape)       — config 3
+  5. n=4 (f=1), ECDSA-P256 clients + threshold-bls over TLS, with a
+     view-change storm (primary paused every storm-period) — config 5
 Each runs with crypto_backend cpu and (if a device is reachable) tpu.
+(Config 4 — the n=1000 synthetic PrePrepare/share flood — is the
+separate benchmarks/bench_flood.py: it measures the crypto plane at a
+scale no single-host cluster can reach.)
 
 Usage: python -m benchmarks.bench_e2e [--secs 10] [--clients 4]
-       [--configs 1,2] [--backends cpu,tpu]
+       [--configs 1,2] [--backends cpu,tpu] [--processes]
 Prints one JSON line per (config, backend).
 """
 from __future__ import annotations
@@ -31,6 +38,14 @@ from tpubft.testing.cluster import InProcessCluster
 CONFIGS = {
     1: dict(f=1, threshold_scheme="multisig-ed25519"),
     2: dict(f=2, threshold_scheme="threshold-bls"),
+    3: dict(f=10, threshold_scheme="threshold-bls",
+            client_sig_scheme="ecdsa-secp256k1",
+            # a 31-replica co-located cluster pays ~n pairing checks per
+            # round on one host: keep the VC timer out of the measurement
+            view_change_timer_ms=30000),
+    5: dict(f=1, threshold_scheme="threshold-bls",
+            client_sig_scheme="ecdsa-p256", transport="tls",
+            storm_period_s=4.0),
 }
 
 
@@ -83,7 +98,10 @@ def _drive(make_kv, config: int, backend: str, secs: float,
     all_lats = sorted(x for ls in lats for x in ls)
     row = {
         "config": config, "n": 3 * cfg["f"] + 1, "f": cfg["f"],
-        "threshold_scheme": cfg["threshold_scheme"], "backend": backend,
+        "threshold_scheme": cfg["threshold_scheme"],
+        "client_sig_scheme": cfg.get("client_sig_scheme", "ed25519"),
+        "transport": cfg.get("transport", "udp/loopback"),
+        "backend": backend,
         "clients": clients, "secs": round(wall, 2), "ops": total,
         "ops_per_sec": round(total / wall, 1),
         "mean_latency_ms": round(statistics.mean(all_lats) * 1e3, 2)
@@ -99,8 +117,17 @@ def _drive(make_kv, config: int, backend: str, secs: float,
 def run_config(config: int, backend: str, secs: float,
                clients: int) -> dict:
     cfg = CONFIGS[config]
+    if cfg.get("transport") or cfg.get("storm_period_s"):
+        # TLS transport and the VC storm only exist on real processes; an
+        # in-process row must not claim a fidelity it didn't run with
+        raise SystemExit(
+            f"config {config} requires --processes (tls/storm fidelity)")
     overrides = {"threshold_scheme": cfg["threshold_scheme"],
+                 "client_sig_scheme": cfg.get("client_sig_scheme",
+                                              "ed25519"),
                  "crypto_backend": backend}
+    if cfg.get("view_change_timer_ms"):
+        overrides["view_change_timer_ms"] = cfg["view_change_timer_ms"]
     with InProcessCluster(f=cfg["f"], num_clients=clients,
                           handler_factory=_handler_factory,
                           cfg_overrides=overrides) as cluster:
@@ -108,20 +135,64 @@ def run_config(config: int, backend: str, secs: float,
                       config, backend, secs, clients)
 
 
+def _storm(net, stop_evt, period_s: float) -> None:
+    """View-change storm driver (config 5): pause the CURRENT primary for
+    a view-change-timeout's worth of silence, resume it, repeat — every
+    cycle forces a real view change while clients keep submitting. The
+    primary is read from live metrics (a spontaneous, load-induced view
+    change must not desynchronize the storm into pausing backups)."""
+    while not stop_evt.wait(period_s):
+        views = [net.current_view(r) for r in range(net.n)]
+        view = max((v for v in views if v is not None), default=0)
+        r = view % net.n                 # round-robin primary assignment
+        net.pause_replica(r)
+        # hold past the VC timeout so the complaint quorum forms
+        interrupted = stop_evt.wait(net.view_change_timeout_ms / 1000.0
+                                    + 1.0)
+        net.resume_replica(r)
+        if interrupted:
+            return
+
+
 def run_config_processes(config: int, backend: str, secs: float,
                          clients: int) -> dict:
     """REAL replica OS processes (BftTestNetwork) — no shared-GIL
     inflation; this is the deployment-shaped number."""
     import tempfile
+    import threading as _t
 
     from tpubft.testing.network import BftTestNetwork
     cfg = CONFIGS[config]
     with tempfile.TemporaryDirectory() as tmp, \
             BftTestNetwork(f=cfg["f"], num_clients=max(4, clients),
                            db_dir=tmp, crypto_backend=backend,
-                           threshold_scheme=cfg["threshold_scheme"]) as net:
-        return _drive(net.skvbc_client, config, backend, secs, clients,
-                      mode="processes")
+                           threshold_scheme=cfg["threshold_scheme"],
+                           client_sig_scheme=cfg.get("client_sig_scheme",
+                                                     "ed25519"),
+                           view_change_timeout_ms=cfg.get(
+                               "view_change_timer_ms", 3000),
+                           transport=cfg.get("transport", "udp")) as net:
+        storm_stop = None
+        storm_thread = None
+        if cfg.get("storm_period_s"):
+            storm_stop = _t.Event()
+            storm_thread = _t.Thread(target=_storm,
+                                     args=(net, storm_stop,
+                                           cfg["storm_period_s"]),
+                                     daemon=True)
+            storm_thread.start()
+        try:
+            row = _drive(net.skvbc_client, config, backend, secs, clients,
+                         mode="processes",
+                         warmup_timeout_ms=60000 if cfg["f"] > 2
+                         else 20000)
+        finally:
+            if storm_stop is not None:
+                storm_stop.set()
+                storm_thread.join(timeout=10)
+        if cfg.get("storm_period_s"):
+            row["storm_period_s"] = cfg["storm_period_s"]
+        return row
 
 
 def main() -> None:

@@ -1,0 +1,79 @@
+"""Measure the CPU↔device combine crossover (sets TPUBFT_MSM_CROSSOVER_K).
+
+For each quorum size k: build a threshold-BLS certificate through both
+accumulators — the CPU native path (Lagrange + Pippenger MSM,
+tpubft/native/bls12381.cpp) and the device path (host Lagrange + the
+batched curve MSM kernel, ops/bls12_381.combine_shares) — and report
+ms per combine. The crossover is the smallest k where the device wins;
+export it as TPUBFT_MSM_CROSSOVER_K (consumed by
+crypto/tpu.TpuBlsThresholdAccumulator). Reference counterpart:
+threshsign/bench/BenchThresholdBls.cpp:208 + FastMultExp.cpp:27.
+
+Usage: python -m benchmarks.bench_msm_crossover [--ks 8,32,128,512,667]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def bench_k(n: int, k: int, reps: int) -> dict:
+    from tpubft.crypto.interfaces import Cryptosystem
+    from tpubft.crypto.tpu import make_threshold_verifier
+    cs = Cryptosystem("threshold-bls", k, n, seed=b"xover-%d" % k)
+    digest = b"x" * 32
+    shares = [(i, cs.create_threshold_signer(i).sign_share(digest))
+              for i in range(1, k + 1)]
+    cpu_v = cs.create_threshold_verifier()
+    dev_v = make_threshold_verifier("threshold-bls", k, n, cs.public_key,
+                                    cs.share_public_keys)
+
+    def combine(v):
+        acc = v.new_accumulator(with_share_verification=False)
+        acc.set_expected_digest(digest)
+        for i, s in shares:
+            acc.add(i, s)
+        return acc.get_full_signed_data()
+
+    import os
+    os.environ["TPUBFT_MSM_CROSSOVER_K"] = "1"   # force device path
+    try:
+        assert combine(dev_v) == combine(cpu_v)
+        best_cpu = best_dev = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            combine(cpu_v)
+            best_cpu = min(best_cpu, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            combine(dev_v)
+            best_dev = min(best_dev, time.perf_counter() - t0)
+    finally:
+        del os.environ["TPUBFT_MSM_CROSSOVER_K"]
+    return {"k": k, "cpu_ms": round(best_cpu * 1e3, 1),
+            "device_ms": round(best_dev * 1e3, 1),
+            "device_wins": best_dev < best_cpu}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ks", default="8,32,128,512,667")
+    ap.add_argument("--n", type=int, default=1000)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+    import jax
+    rows = []
+    for k in [int(x) for x in args.ks.split(",")]:
+        row = bench_k(max(args.n, k), k, args.reps)
+        row["platform"] = jax.devices()[0].platform
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    crossover = next((r["k"] for r in rows if r["device_wins"]), None)
+    print(json.dumps({"crossover_k": crossover,
+                      "recommend": "TPUBFT_MSM_CROSSOVER_K=%s"
+                      % (crossover or "unset (CPU always wins here)")}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
