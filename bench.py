@@ -61,15 +61,10 @@ def main() -> None:
     if not use_default_platform:
         jax.config.update("jax_platforms", "cpu")
     # persistent cache: the verify kernel is a large program (~1 min
-    # compile); repeated driver runs hit the cache
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
+    # compile); repeated driver runs hit the cache (shared setup with
+    # every benchmarks/ harness)
+    from benchmarks.common import setup_cache
+    setup_cache()
 
     from tpubft.crypto import cpu as ccpu
     from tpubft.ops import ed25519 as ops

@@ -132,7 +132,8 @@ def run_config(config: int, backend: str, secs: float,
                           handler_factory=_handler_factory,
                           cfg_overrides=overrides) as cluster:
         return _drive(lambda i: skvbc.SkvbcClient(cluster.client(i)),
-                      config, backend, secs, clients)
+                      config, backend, secs, clients,
+                      warmup_timeout_ms=60000 if cfg["f"] > 2 else 20000)
 
 
 def _storm(net, stop_evt, period_s: float) -> None:
@@ -196,6 +197,8 @@ def run_config_processes(config: int, backend: str, secs: float,
 
 
 def main() -> None:
+    from benchmarks.common import setup_cache
+    setup_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--secs", type=float, default=10.0)
     ap.add_argument("--clients", type=int, default=4)

@@ -144,6 +144,8 @@ def phase_b_threshold(n: int, reps: int) -> None:
 
 
 def main() -> None:
+    from benchmarks.common import setup_cache
+    setup_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1000)
     ap.add_argument("--reps", type=int, default=3)

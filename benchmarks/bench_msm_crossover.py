@@ -56,6 +56,8 @@ def bench_k(n: int, k: int, reps: int) -> dict:
 
 
 def main() -> None:
+    from benchmarks.common import setup_cache
+    setup_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--ks", default="8,32,128,512,667")
     ap.add_argument("--n", type=int, default=1000)
@@ -68,7 +70,8 @@ def main() -> None:
         row["platform"] = jax.devices()[0].platform
         rows.append(row)
         print(json.dumps(row), flush=True)
-    crossover = next((r["k"] for r in rows if r["device_wins"]), None)
+    crossover = min((r["k"] for r in rows if r["device_wins"]),
+                    default=None)
     print(json.dumps({"crossover_k": crossover,
                       "recommend": "TPUBFT_MSM_CROSSOVER_K=%s"
                       % (crossover or "unset (CPU always wins here)")}),

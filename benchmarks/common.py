@@ -1,0 +1,31 @@
+"""Shared bench setup: persistent XLA compile cache.
+
+The crypto programs are large (sharded verify at the 1024 size class
+compiles for minutes on the CPU backend); every bench must hit the same
+persistent cache the tests and bench.py use, or a capture pass pays the
+full compile on each invocation.
+"""
+from __future__ import annotations
+
+import os
+
+
+def setup_cache() -> None:
+    import jax
+
+    # honor JAX_PLATFORMS=cpu RELIABLY: on this host the tunneled-TPU
+    # plugin overrides the env var and device init hangs when the tunnel
+    # is down — the config update before backend init is the only
+    # dependable way to force the CPU backend (same as tests/conftest.py)
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass                    # backend already initialized
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:  # noqa: BLE001 — cache is best-effort
+        pass

@@ -307,3 +307,25 @@ def test_diagnostics_ctl_over_processes(tmp_path):
         name = next(line for line in perf.splitlines() if "execute" in line)
         hist = query(net.diag_base + 0, f"perf show {name}")
         assert "count" in hist, hist
+
+
+def test_byzantine_share_corruptor_process(tmp_path):
+    """A replica binary running the corrupt-shares byzantine strategy
+    (reference TesterReplica strategy/ + WrapCommunication): its
+    signature shares are garbage on the wire, yet the cluster keeps
+    committing — bad shares are identified and excluded, never folded
+    into a certificate."""
+    net = BftTestNetwork(f=1, db_dir=str(tmp_path))
+    try:
+        for r in range(net.n - 1):
+            net.start_replica(r)
+        # replica 3 is byzantine: flips a byte in every outgoing share
+        net.start_replica(3, extra_args=["--strategy", "corrupt-shares"])
+        net.wait_for_replicas_up(timeout=30)
+        kv = net.skvbc_client(0)
+        for i in range(6):
+            assert _commit(kv, b"byz-%d" % i, b"v%d" % i), \
+                f"write {i} failed with a share corruptor present"
+        assert kv.read([b"byz-5"]) == {b"byz-5": b"v5"}
+    finally:
+        net.stop_all()

@@ -83,7 +83,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--strategy", default=None,
                    help="byzantine strategy name (testing)")
     p.add_argument("--crypto-backend", default="cpu",
-                   choices=("cpu", "tpu"))
+                   choices=("cpu", "tpu", "auto"))
     p.add_argument("--pre-execution", action="store_true")
     p.add_argument("--fault-port", type=int, default=None,
                    help="per-link fault-injection control port "

@@ -130,7 +130,8 @@ class BftTestNetwork:
             raise
         return self
 
-    def start_replica(self, r: int) -> None:
+    def start_replica(self, r: int,
+                      extra_args: Optional[List[str]] = None) -> None:
         assert r not in self.procs or self.procs[r].poll() is not None
         # persistent kernel cache: device-backend replicas (crypto tpu)
         # otherwise pay a cold XLA compile per process — the dominant
@@ -156,7 +157,7 @@ class BftTestNetwork:
                 "--work-window", str(self.work_window),
                 "--threshold-scheme", self.threshold_scheme,
                 "--client-sig-scheme", self.client_sig_scheme,
-                "--transport", self.transport]
+                "--transport", self.transport] + (extra_args or [])
         if self.certs_dir:
             args += ["--certs-dir", self.certs_dir]
         if self.pre_execution:
