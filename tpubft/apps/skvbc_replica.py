@@ -90,6 +90,10 @@ def make_parser() -> argparse.ArgumentParser:
                         "(Apollo iptables-partitioning analog)")
     p.add_argument("--checkpoint-window", type=int, default=150)
     p.add_argument("--work-window", type=int, default=300)
+    # v1 (direct-KV) is deliberately NOT offered here: it is a legacy
+    # migration-source engine (tools/migrate_v4 --from v1). As a consensus
+    # engine its raising history/proof reads would let one read request
+    # halt execution on every correct replica.
     p.add_argument("--kvbc-version", default="categorized",
                    choices=("categorized", "v4"))
     add_scheme_args(p)

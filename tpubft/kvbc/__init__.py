@@ -25,6 +25,9 @@ def create_blockchain(db, version: str = "categorized",
         return KeyValueBlockchain(db, use_device_hashing=use_device_hashing)
     if version == "v4":
         return V4KeyValueBlockchain(db)
+    if version in ("v1", "direct"):
+        from tpubft.kvbc.v1 import DirectKVBlockchain
+        return DirectKVBlockchain(db)
     raise ValueError(f"unknown kvbc version {version!r}")
 
 

@@ -67,14 +67,20 @@ def _silent_preprepare(dest: NodeNum, data: bytes) -> Optional[bytes]:
 
 
 def _corrupt_shares(dest: NodeNum, data: bytes) -> Optional[bytes]:
-    """Flip a byte in every outgoing signature-share message — exercises
-    share verification + bad-share isolation."""
+    """Flip a byte INSIDE the signature share of every outgoing share
+    message — exercises share verification + bad-share isolation. The
+    flipped byte must be within `sig`: PartialCommitProofMsg carries a
+    trailing path u8 AFTER the signature (messages.py SPEC), so flipping
+    the last wire byte would only make the message unparseable (a silent
+    replica, not a byzantine share)."""
     from tpubft.consensus.messages import MsgCode
-    if _msg_code(data) in (int(MsgCode.PreparePartial),
-                           int(MsgCode.CommitPartial),
-                           int(MsgCode.PartialCommitProof)):
+    code = _msg_code(data)
+    if code in (int(MsgCode.PreparePartial), int(MsgCode.CommitPartial),
+                int(MsgCode.PartialCommitProof)):
         b = bytearray(data)
-        b[-1] ^= 0xFF
+        # b[-1] is `path` on PartialCommitProof and the sig tail on the
+        # others; b[-3] is inside the >=48-byte signature on all three
+        b[-3] ^= 0xFF
         return bytes(b)
     return data
 

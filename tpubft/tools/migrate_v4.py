@@ -1,4 +1,4 @@
-"""Ledger engine migration: categorized ↔ v4.
+"""Ledger engine migration: v1 / categorized / v4, any direction.
 
 Rebuild of the reference's v4 migration CLI
 (/root/reference/kvbc/tools/migrations/v4migration_tool/): replays every
