@@ -107,8 +107,11 @@ def main() -> None:
         try:
             from tpubft.ops import ed25519_pallas as opsp
             candidates["pallas-fused"] = measure(opsp.verify_kernel)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            # surface the reason: hardware bring-up needs the Mosaic
+            # error, not a silent fall-through to the XLA kernel
+            print("bench: pallas-fused kernel unavailable: %r" % (e,),
+                  file=sys.stderr)
     candidates["xla"] = measure(ops.verify_kernel)
     best = max(candidates, key=candidates.get)
     tpu_rate = candidates[best]

@@ -233,3 +233,36 @@ def test_flush_batcher_stop_resolves_pending_and_rejects_late_submits():
     assert 3 in dropped
     # every item resolved exactly once, through exactly one channel
     assert sorted(drained + dropped) == [1, 2, 3]
+
+
+def test_prometheus_exposition_and_endpoint():
+    """Prometheus bridge (reference concord_prometheus_metrics.hpp):
+    counters/gauges/statuses render in the text exposition format and a
+    real HTTP scrape of /metrics serves them."""
+    import urllib.request
+
+    from tpubft.utils.metrics import (Aggregator, Component,
+                                      PrometheusEndpoint,
+                                      prometheus_exposition)
+
+    agg = Aggregator()
+    comp = Component("replica", agg)
+    comp.register_counter("executed_requests").inc(7)
+    comp.register_gauge("view", 3)
+    comp.register_status("state").set("collecting")
+    text = prometheus_exposition(agg)
+    assert "# TYPE tpubft_replica_executed_requests counter" in text
+    assert "tpubft_replica_executed_requests 7" in text
+    assert "tpubft_replica_view 3" in text
+    assert 'tpubft_replica_state_info{value="collecting"} 1' in text
+
+    ep = PrometheusEndpoint(agg)
+    ep.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ep.port}/metrics", timeout=5) as r:
+            body = r.read().decode()
+            assert r.headers["content-type"].startswith("text/plain")
+        assert "tpubft_replica_executed_requests 7" in body
+    finally:
+        ep.stop()

@@ -73,6 +73,8 @@ def make_parser() -> argparse.ArgumentParser:
                    help="thin-replica streaming port (0 = ephemeral)")
     p.add_argument("--diag-port", type=int, default=None,
                    help="diagnostics admin server port (0 = ephemeral)")
+    p.add_argument("--prom-port", type=int, default=None,
+                   help="Prometheus /metrics HTTP port (0 = ephemeral)")
     p.add_argument("--db-dir", default=None)
     p.add_argument("--seed", default="tpubft-skvbc")
     p.add_argument("--transport", default="udp",
@@ -126,6 +128,12 @@ def main() -> None:
     metrics = UdpMetricsServer(kr.replica.aggregator,
                                port=args.metrics_port)
     metrics.start()
+    prom = None
+    if args.prom_port is not None:
+        from tpubft.utils.metrics import PrometheusEndpoint
+        prom = PrometheusEndpoint(kr.replica.aggregator,
+                                  port=args.prom_port)
+        prom.start()
     diag = None
     if args.diag_port is not None:
         from tpubft.diagnostics import DiagnosticsServer
@@ -133,8 +141,9 @@ def main() -> None:
         diag.start()
     kr.start()
     diag_note = f", diag {diag.port}" if diag is not None else ""
+    prom_note = f", prom {prom.port}" if prom is not None else ""
     print(f"skvbc replica {args.replica} up (metrics {metrics.port}"
-          f"{diag_note})", flush=True)
+          f"{diag_note}{prom_note})", flush=True)
     try:
         while True:
             time.sleep(1)
@@ -143,6 +152,8 @@ def main() -> None:
     finally:
         kr.stop()
         metrics.stop()
+        if prom is not None:
+            prom.stop()
         if diag is not None:
             diag.stop()
         if fault_ctl is not None:

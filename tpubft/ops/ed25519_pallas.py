@@ -52,8 +52,17 @@ _MASK = [int(m) for m in F.MASK]
 
 # batch lanes per grid step, processed as an (8, TILE//8) sublane x lane
 # tile; Mosaic requires the lane-axis block (TILE//8) to be a multiple of
-# 128. VMEM per tile ~= table scratch (16*4*24*TILE*4B = 6.3 MB) + slack
-TILE = 1024
+# 128, so TILE must be a multiple of 1024 (the floor). VMEM per tile ~=
+# table scratch (16*4*24*TILE*4B = 6.3 MB at 1024) + slack; the env knob
+# exists so hardware bring-up can probe tile sizes without code edits.
+import os as _os
+
+TILE = int(_os.environ.get("TPUBFT_PALLAS_TILE", "1024"))
+if TILE <= 0 or TILE % 1024:
+    raise ValueError(
+        "TPUBFT_PALLAS_TILE must be a positive multiple of 1024 "
+        f"(got {TILE}): the Mosaic lane block TILE//8 must be a "
+        "multiple of 128")
 SUB = 8
 
 

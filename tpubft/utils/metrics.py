@@ -137,3 +137,75 @@ class UdpMetricsServer:
         if self._thread:
             self._thread.join(timeout=2)
         self._sock.close()
+
+
+def _prom_name(*parts: str) -> str:
+    out = "_".join(parts)
+    return "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in out)
+
+
+def prometheus_exposition(agg: Aggregator, prefix: str = "tpubft") -> str:
+    """Render an aggregator snapshot in the Prometheus text exposition
+    format (the role of the reference's Prometheus bridge,
+    util/include/concord_prometheus_metrics.hpp): counters and gauges
+    become `<prefix>_<component>_<name>`; statuses become an info-style
+    gauge with the value as a label."""
+    lines = []
+    for comp, snap in sorted(agg.snapshot().items()):
+        for name, v in sorted(snap.get("counters", {}).items()):
+            m = _prom_name(prefix, comp, name)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {v}")
+        for name, v in sorted(snap.get("gauges", {}).items()):
+            m = _prom_name(prefix, comp, name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {v}")
+        for name, v in sorted(snap.get("statuses", {}).items()):
+            m = _prom_name(prefix, comp, name, "info")
+            val = (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                   .replace("\n", "\\n"))
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f'{m}{{value="{val}"}} 1')
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusEndpoint:
+    """Minimal HTTP /metrics endpoint serving the exposition format —
+    scrapeable by a real Prometheus. One thread, stdlib only."""
+
+    def __init__(self, aggregator: Aggregator, port: int = 0,
+                 host: str = "127.0.0.1", prefix: str = "tpubft"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        agg = aggregator
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.split("?")[0] != "/metrics":
+                    body = b"see /metrics"
+                    self.send_response(404)
+                else:
+                    body = prometheus_exposition(agg, prefix).encode()
+                    self.send_response(200)
+                    self.send_header("content-type",
+                                     "text/plain; version=0.0.4")
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="prometheus")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
