@@ -75,6 +75,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="diagnostics admin server port (0 = ephemeral)")
     p.add_argument("--prom-port", type=int, default=None,
                    help="Prometheus /metrics HTTP port (0 = ephemeral)")
+    p.add_argument("--prom-host", default="127.0.0.1",
+                   help="bind address for /metrics (0.0.0.0 to let an "
+                        "external Prometheus scrape)")
     p.add_argument("--db-dir", default=None)
     p.add_argument("--seed", default="tpubft-skvbc")
     p.add_argument("--transport", default="udp",
@@ -132,7 +135,8 @@ def main() -> None:
     if args.prom_port is not None:
         from tpubft.utils.metrics import PrometheusEndpoint
         prom = PrometheusEndpoint(kr.replica.aggregator,
-                                  port=args.prom_port)
+                                  port=args.prom_port,
+                                  host=args.prom_host)
         prom.start()
     diag = None
     if args.diag_port is not None:

@@ -56,6 +56,7 @@ class BftClient(IReceiver):
         self._done: Dict[int, threading.Event] = {}
         self._result: Dict[int, m.ClientReplyMsg] = {}
         self._quorum_needed: Dict[int, int] = {}
+        self._primary_hint = 0      # learned from replies' current_primary
         self._started = False
 
     def start(self) -> None:
@@ -81,11 +82,21 @@ class BftClient(IReceiver):
                 return
             slot = self._replies.setdefault(msg.req_seq_num, {})
             slot[sender] = msg
-            matching = sum(1 for r in slot.values()
-                           if r.matching_digest() == msg.matching_digest())
-            if matching >= needed:
+            matching = [r for r in slot.values()
+                        if r.matching_digest() == msg.matching_digest()]
+            if len(matching) >= needed:
                 self._result[msg.req_seq_num] = msg
                 self._done[msg.req_seq_num].set()
+                # primary hint: majority vote over the QUORUM's replies —
+                # a single byzantine reply must not steer future sends at
+                # a dead node (one slow first-send per write, forever)
+                votes: Dict[int, int] = {}
+                for r in matching:
+                    if 0 <= r.current_primary < self.info.n:
+                        votes[r.current_primary] = \
+                            votes.get(r.current_primary, 0) + 1
+                if votes:
+                    self._primary_hint = max(votes, key=votes.get)
 
     # ---- API ----
     def quorum_size(self, q: Quorum) -> int:
@@ -136,9 +147,24 @@ class BftClient(IReceiver):
                                        or self.cfg.request_timeout_ms) / 1e3
         retry_s = self.cfg.retry_timeout_ms / 1e3
         try:
+            first = True
             while time.monotonic() < deadline:
-                for r in self.info.replica_ids:
-                    self.comm.send(r, raw)
+                # happy path: the primary alone orders the request
+                # (reference bftclient sends to the primary first and
+                # broadcasts only on retry) — backups pay nothing per
+                # write unless the primary is slow or has moved. Only
+                # worth it when the budget allows at least one broadcast
+                # retry after a wrong-hint miss. Read-only requests
+                # always broadcast: each replica answers from local
+                # state and the client needs f+1 matching replies from
+                # DISTINCT replicas.
+                if (first and not flags & int(m.RequestFlag.READ_ONLY)
+                        and deadline - time.monotonic() > 2 * retry_s):
+                    self.comm.send(self._primary_hint, raw)
+                else:
+                    for r in self.info.replica_ids:
+                        self.comm.send(r, raw)
+                first = False
                 if evt.wait(timeout=retry_s):
                     return self._result[req_seq].reply
             raise TimeoutError_(

@@ -57,11 +57,15 @@ _MASK = [int(m) for m in F.MASK]
 # exists so hardware bring-up can probe tile sizes without code edits.
 import os as _os
 
-TILE = int(_os.environ.get("TPUBFT_PALLAS_TILE", "1024"))
+_tile_raw = _os.environ.get("TPUBFT_PALLAS_TILE", "1024")
+try:
+    TILE = int(_tile_raw.strip())
+except ValueError:
+    TILE = -1
 if TILE <= 0 or TILE % 1024:
     raise ValueError(
-        "TPUBFT_PALLAS_TILE must be a positive multiple of 1024 "
-        f"(got {TILE}): the Mosaic lane block TILE//8 must be a "
+        f"TPUBFT_PALLAS_TILE must be a positive multiple of 1024 "
+        f"(got {_tile_raw!r}): the Mosaic lane block TILE//8 must be a "
         "multiple of 128")
 SUB = 8
 
