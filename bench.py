@@ -84,7 +84,13 @@ def main() -> None:
     cpu_rate = n_base / (time.perf_counter() - t0)
 
     # ---- batched kernels: fused Pallas (TPU) vs XLA formulation ----
-    batch = 16384
+    # TPUBFT_BENCH_BATCH lets hardware bring-up sweep amortization points
+    # without code edits (larger batches amortize dispatch further).
+    # Rounded up to a multiple of 1024 — the fused Pallas kernel requires
+    # the batch to be a multiple of its TILE (callers pad), and a
+    # non-conforming sweep value must not read as "kernel broken".
+    batch = max(1, int(os.environ.get("TPUBFT_BENCH_BATCH", "16384")))
+    batch = (batch + 1023) // 1024 * 1024
     items = [(msgs[i % 512], sigs[i % 512], pk) for i in range(batch)]
     prep = ops.prepare_batch(items)
     args = (prep.s_win, prep.h_win, prep.a_y, prep.a_sign,

@@ -28,7 +28,7 @@ def test_backup_restart_rejoins_and_cluster_progresses(tmp_path):
         # async verification to finish executing before crashing it, so
         # the restart genuinely recovers an executed prefix
         import time
-        deadline = time.time() + 5
+        deadline = time.time() + 20
         while time.time() < deadline \
                 and cluster.metric(2, "gauges", "last_executed_seq") < 1:
             time.sleep(0.02)
@@ -41,7 +41,7 @@ def test_backup_restart_rejoins_and_cluster_progresses(tmp_path):
         # restarted replica replays committed requests on recovery, then
         # applies new ones: its state must converge to the cluster's
         import time
-        deadline = time.time() + 5
+        deadline = time.time() + 20
         while time.time() < deadline:
             if cluster.handlers[2].value == 16:
                 break

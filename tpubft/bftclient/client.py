@@ -4,9 +4,11 @@ Rebuild of the reference's bftclient
 (/root/reference/client/bftclient/include/bftclient/bft_client.h:36
 Client::send; quorums.h:45-46 LinearizableQuorum = 2f+c+1,
 ByzantineSafeQuorum = f+1; src/matcher.cpp Matcher): the client signs a
-ClientRequestMsg, sends it to all replicas, retransmits on a timer, and
-returns once enough replies agree byte-for-byte (replica-specific info
-excluded from matching, as in the reference's RSI handling).
+ClientRequestMsg, sends writes PRIMARY-FIRST (broadcasting to all
+replicas on retry and for read-only requests), retransmits on a timer,
+and returns once enough replies agree byte-for-byte (replica-specific
+info excluded from matching, as in the reference's RSI handling). The
+primary hint is a majority vote over each write's reply quorum.
 """
 from __future__ import annotations
 

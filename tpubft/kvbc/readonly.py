@@ -214,7 +214,10 @@ class ReadOnlyReplica(IReceiver):
         self.m_reads.inc()
         self.comm.send(sender, m.ClientReplyMsg(
             sender_id=self.id, req_seq_num=req.req_seq_num,
-            current_primary=0, reply=payload,
+            # "unknown": an RO replica tracks no view. Out-of-range on
+            # purpose — clients must never take this as a primary hint
+            # (their 0 <= x < n filter rejects it)
+            current_primary=0xFFFFFFFF, reply=payload,
             replica_specific_info=b"ro").pack())
 
     # ---- state transfer completion -> archival ----
