@@ -86,11 +86,14 @@ def main() -> None:
     # ---- batched kernels: fused Pallas (TPU) vs XLA formulation ----
     # TPUBFT_BENCH_BATCH lets hardware bring-up sweep amortization points
     # without code edits (larger batches amortize dispatch further).
-    # Rounded up to a multiple of 1024 — the fused Pallas kernel requires
-    # the batch to be a multiple of its TILE (callers pad), and a
-    # non-conforming sweep value must not read as "kernel broken".
+    # Rounded up to a multiple of the fused kernel's TILE (which is
+    # itself TPUBFT_PALLAS_TILE-tunable) — the kernel requires the batch
+    # to be a tile multiple (callers pad), and a non-conforming sweep
+    # value must not read as "kernel broken" or silently skip lanes.
+    tile = max(1024, int(os.environ.get("TPUBFT_PALLAS_TILE", "1024")
+                         or 1024))
     batch = max(1, int(os.environ.get("TPUBFT_BENCH_BATCH", "16384")))
-    batch = (batch + 1023) // 1024 * 1024
+    batch = (batch + tile - 1) // tile * tile
     items = [(msgs[i % 512], sigs[i % 512], pk) for i in range(batch)]
     prep = ops.prepare_batch(items)
     args = (prep.s_win, prep.h_win, prep.a_y, prep.a_sign,
