@@ -94,41 +94,55 @@ def main() -> None:
                          or 1024))
     batch = max(1, int(os.environ.get("TPUBFT_BENCH_BATCH", "16384")))
     batch = (batch + tile - 1) // tile * tile
-    items = [(msgs[i % 512], sigs[i % 512], pk) for i in range(batch)]
-    prep = ops.prepare_batch(items)
-    args = (prep.s_win, prep.h_win, prep.a_y, prep.a_sign,
-            prep.r_y, prep.r_sign)
+    def prep_args(b: int):
+        items = [(msgs[i % 512], sigs[i % 512], pk) for i in range(b)]
+        prep = ops.prepare_batch(items)
+        return (prep.s_win, prep.h_win, prep.a_y, prep.a_sign,
+                prep.r_y, prep.r_sign)
 
-    def measure(kernel) -> float:
-        out = kernel(*args)
+    def measure(kernel, b: int, kargs) -> float:
+        out = kernel(*kargs)
         out.block_until_ready()                   # compile
         assert bool(out.all()), "kernel rejected valid signatures"
         reps = 3
         t0 = time.perf_counter()
         for _ in range(reps):
-            out = kernel(*args)
+            out = kernel(*kargs)
         out.block_until_ready()
-        return batch / ((time.perf_counter() - t0) / reps)
+        return b / ((time.perf_counter() - t0) / reps)
 
+    args = prep_args(batch)
     candidates = {}
-    if use_default_platform and jax.devices()[0].platform != "cpu":
+    on_accelerator = (use_default_platform
+                      and jax.devices()[0].platform != "cpu")
+    if on_accelerator:
         # the Mosaic kernel only compiles on real TPU hardware
         try:
             from tpubft.ops import ed25519_pallas as opsp
-            candidates["pallas-fused"] = measure(opsp.verify_kernel)
+            candidates["pallas-fused"] = (
+                measure(opsp.verify_kernel, batch, args), batch)
         except Exception as e:  # noqa: BLE001
             # surface the reason: hardware bring-up needs the Mosaic
             # error, not a silent fall-through to the XLA kernel
             print("bench: pallas-fused kernel unavailable: %r" % (e,),
                   file=sys.stderr)
-    candidates["xla"] = measure(ops.verify_kernel)
-    best = max(candidates, key=candidates.get)
-    tpu_rate = candidates[best]
+    candidates["xla"] = (measure(ops.verify_kernel, batch, args), batch)
+    if on_accelerator and "TPUBFT_BENCH_BATCH" not in os.environ:
+        # one larger amortization point for the XLA kernel: if the fused
+        # kernel is unavailable, the artifact should still carry the XLA
+        # formulation's best number (compile is cached across runs)
+        batch2 = batch * 2
+        candidates["xla"] = max(
+            candidates["xla"],
+            (measure(ops.verify_kernel, batch2, prep_args(batch2)),
+             batch2))
+    best = max(candidates, key=lambda k: candidates[k][0])
+    tpu_rate, best_batch = candidates[best]
 
     platform = jax.devices()[0].platform
     record = {
         "metric": "ed25519-verifies/sec (batch=%d, %s, %s)" % (
-            batch, platform, best),
+            best_batch, platform, best),
         "value": round(tpu_rate, 1),
         "unit": "verifies/sec",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
