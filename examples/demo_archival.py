@@ -81,10 +81,15 @@ def main() -> None:
                 os.path.dirname(os.path.abspath(__file__))))
 
             def cli(*a):
-                return json.loads(subprocess.run(
+                r = subprocess.run(
                     [sys.executable, "-m", "tpubft.tools.snapshot", *a],
-                    capture_output=True, text=True, env=env,
-                    check=True).stdout)
+                    capture_output=True, text=True, env=env)
+                if r.returncode != 0:
+                    # surface the tool's own diagnostic (e.g. a
+                    # digest_ok=false JSON), not an opaque exit status
+                    raise SystemExit(f"snapshot {a[0]} failed: "
+                                     f"{r.stdout.strip() or r.stderr}")
+                return json.loads(r.stdout)
             man = cli("create", db3, snap)
             print(f"== snapshot: {man['entries']} records, "
                   f"head block {man['head_block']}")

@@ -12,8 +12,13 @@ far worse than a slow probe.
 Resolution order for "auto":
   1. TPUBFT_CRYPTO_BACKEND env var ("cpu"/"tpu") — operator override.
   2. JAX_PLATFORMS forcing cpu — tests / CPU-mesh runs.
-  3. Cached probe result (per process).
-  4. Subprocess device probe with a hard timeout.
+  3. The in-process jax config forcing cpu (jax.config.update is the
+     only RELIABLE way to force CPU on hosts whose accelerator plugin
+     overrides the env var — tests/conftest.py does exactly that, and
+     the probe must respect it or every test session pays a full probe
+     timeout against a dead tunnel).
+  4. Cached probe result (per process).
+  5. Subprocess device probe with a hard timeout.
 """
 from __future__ import annotations
 
@@ -50,6 +55,13 @@ def resolve_backend(requested: str) -> str:
         return env
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         return "cpu"
+    try:
+        import jax
+        plats = jax.config.jax_platforms       # reading does not init
+        if plats and str(plats).strip().lower() == "cpu":
+            return "cpu"
+    except Exception:  # noqa: BLE001 — config introspection best-effort
+        pass
     if _probe_cache is None:
         _probe_cache = _probe_device()
     return _probe_cache
