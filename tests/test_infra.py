@@ -266,3 +266,40 @@ def test_prometheus_exposition_and_endpoint():
         assert "tpubft_replica_executed_requests 7" in body
     finally:
         ep.stop()
+
+
+def test_crypto_backend_resolution_precedence(monkeypatch):
+    """resolve_backend("auto") must NEVER reach the (potentially
+    60s-hanging) subprocess probe when any cheap signal forces cpu —
+    regression test for the probe firing under the tests' forced-CPU
+    jax config on hosts that preset JAX_PLATFORMS to the accelerator."""
+    from tpubft.crypto import backend
+
+    # explicit backends pass through untouched
+    assert backend.resolve_backend("cpu") == "cpu"
+    assert backend.resolve_backend("tpu") == "tpu"
+
+    def boom(*a, **k):
+        raise AssertionError("device probe must not run")
+
+    monkeypatch.setattr(backend, "_probe_device", boom)
+    # 1. operator env override wins
+    monkeypatch.setenv("TPUBFT_CRYPTO_BACKEND", "tpu")
+    assert backend.resolve_backend("auto") == "tpu"
+    monkeypatch.setenv("TPUBFT_CRYPTO_BACKEND", "cpu")
+    assert backend.resolve_backend("auto") == "cpu"
+    monkeypatch.delenv("TPUBFT_CRYPTO_BACKEND")
+    # 2. JAX_PLATFORMS env forcing cpu
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert backend.resolve_backend("auto") == "cpu"
+    # 3. the in-process jax config (conftest forces it): even with the
+    # env var pointing at an accelerator, no probe fires
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert backend.resolve_backend("auto") == "cpu"
+    # 4. with nothing forcing cpu, the (stubbed) probe result is cached
+    monkeypatch.setattr(backend, "_jax_config_forces_cpu", lambda: False)
+    monkeypatch.setattr(backend, "_probe_device", lambda *a: "tpu")
+    monkeypatch.setattr(backend, "_probe_cache", None)
+    assert backend.resolve_backend("auto") == "tpu"
+    monkeypatch.setattr(backend, "_probe_device", boom)
+    assert backend.resolve_backend("auto") == "tpu"   # cached, no re-probe

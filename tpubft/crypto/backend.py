@@ -55,13 +55,17 @@ def resolve_backend(requested: str) -> str:
         return env
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         return "cpu"
-    try:
-        import jax
-        plats = jax.config.jax_platforms       # reading does not init
-        if plats and str(plats).strip().lower() == "cpu":
-            return "cpu"
-    except Exception:  # noqa: BLE001 — config introspection best-effort
-        pass
+    if _jax_config_forces_cpu():
+        return "cpu"
     if _probe_cache is None:
         _probe_cache = _probe_device()
     return _probe_cache
+
+
+def _jax_config_forces_cpu() -> bool:
+    try:
+        import jax
+        plats = jax.config.jax_platforms       # reading does not init
+        return bool(plats) and str(plats).strip().lower() == "cpu"
+    except Exception:  # noqa: BLE001 — config introspection best-effort
+        return False
