@@ -36,6 +36,9 @@ def main() -> None:
     p.add_argument("--clients", type=int, default=4)
     p.add_argument("--base-port", type=int, default=3710)
     p.add_argument("--metrics-port", type=int, default=0)
+    p.add_argument("--prom-port", type=int, default=None,
+                   help="Prometheus /metrics HTTP port (0 = ephemeral)")
+    p.add_argument("--prom-host", default="127.0.0.1")
     p.add_argument("--archive-dir", default=None)
     p.add_argument("--s3-endpoint", default=None,
                    help="archive to an S3-compatible store (host:port) "
@@ -91,9 +94,16 @@ def main() -> None:
                          aggregator=agg, st_cfg=StConfig())
     metrics = UdpMetricsServer(agg, port=args.metrics_port)
     metrics.start()
+    prom = None
+    if args.prom_port is not None:
+        from tpubft.utils.metrics import PrometheusEndpoint
+        prom = PrometheusEndpoint(agg, port=args.prom_port,
+                                  host=args.prom_host)
+        prom.start()
     ro.start()
-    print(f"ro replica {args.replica} up (metrics {metrics.port})",
-          flush=True)
+    prom_note = f", prom {prom.port}" if prom is not None else ""
+    print(f"ro replica {args.replica} up (metrics {metrics.port}"
+          f"{prom_note})", flush=True)
     try:
         while True:
             time.sleep(1)
@@ -102,6 +112,8 @@ def main() -> None:
     finally:
         ro.stop()
         metrics.stop()
+        if prom is not None:
+            prom.stop()
 
 
 if __name__ == "__main__":
