@@ -33,6 +33,10 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
                         pre_execution_enabled=args.pre_execution,
                         checkpoint_window_size=args.checkpoint_window,
                         work_window_size=args.work_window,
+                        **({"device_min_verify_batch":
+                            args.device_min_verify_batch}
+                           if args.device_min_verify_batch is not None
+                           else {}),
                         kvbc_version=args.kvbc_version,
                         threshold_scheme=args.threshold_scheme,
                         client_sig_scheme=args.client_sig_scheme)
@@ -87,6 +91,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--view-change-timeout-ms", type=int, default=4000)
     p.add_argument("--strategy", default=None,
                    help="byzantine strategy name (testing)")
+    p.add_argument("--device-min-verify-batch", type=int, default=None,
+                   help="batches below this verify per-principal instead "
+                        "of via the cross-principal device dispatch "
+                        "(default: ReplicaConfig's crossover)")
     p.add_argument("--crypto-backend", default="cpu",
                    choices=("cpu", "tpu", "auto"))
     p.add_argument("--pre-execution", action="store_true")

@@ -75,7 +75,8 @@ class BftTestNetwork:
                  work_window: int = 300,
                  transport: str = "udp",
                  threshold_scheme: str = "multisig-ed25519",
-                 client_sig_scheme: str = "ed25519") -> None:
+                 client_sig_scheme: str = "ed25519",
+                 device_min_verify_batch: Optional[int] = None) -> None:
         self.f, self.c = f, c
         self.n = 3 * f + 2 * c + 1
         self.num_ro = num_ro
@@ -95,6 +96,7 @@ class BftTestNetwork:
         self.transport = transport
         self.threshold_scheme = threshold_scheme
         self.client_sig_scheme = client_sig_scheme
+        self.device_min_verify_batch = device_min_verify_batch
         self.certs_dir = None
         if transport == "tls":
             # pinned-cert material for every principal (replicas +
@@ -158,6 +160,9 @@ class BftTestNetwork:
                 "--threshold-scheme", self.threshold_scheme,
                 "--client-sig-scheme", self.client_sig_scheme,
                 "--transport", self.transport] + (extra_args or [])
+        if self.device_min_verify_batch is not None:
+            args += ["--device-min-verify-batch",
+                     str(self.device_min_verify_batch)]
         if self.certs_dir:
             args += ["--certs-dir", self.certs_dir]
         if self.pre_execution:
