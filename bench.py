@@ -149,6 +149,16 @@ def main() -> None:
     }
     if platform == "cpu":
         record["degraded"] = True  # no accelerator at capture time
+        # surface the most recent archived hardware capture (written by
+        # tools/tpu_capture.sh during a device window) so a transient
+        # tunnel outage at driver time doesn't erase the round's number
+        cap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "captures", "latest_tpu.json")
+        try:
+            with open(cap) as f:
+                record["last_hw_capture"] = json.load(f)
+        except (OSError, ValueError):
+            pass
     print(json.dumps(record))
 
 

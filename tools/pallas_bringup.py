@@ -1,0 +1,265 @@
+"""Mosaic bring-up ladder for the fused Ed25519 Pallas kernel.
+
+The full kernel (`tpubft/ops/ed25519_pallas.py`) has only ever run under
+the Pallas interpreter; this script compiles a ladder of sub-kernels of
+increasing complexity ON THE REAL DEVICE so that, if the full kernel
+fails Mosaic compilation, the failing construct is isolated in minutes
+instead of being a single opaque error at the end of an hours-long
+tunnel window. Run during a device window:
+
+    python -m tools.pallas_bringup            # whole ladder
+    python -m tools.pallas_bringup --rung 3   # one rung
+
+Rungs (each builds on the constructs of the previous):
+  0  vmem-roundtrip  3D (NL, 8, T8) block copy in/out
+  1  carry           vector shift-by-vector + concat row shift (_carry24)
+  2  mul             full field multiply (broadcast-MACs + _reduce48)
+  3  inv             the 254-sqr/mul inversion chain under fori_loop
+  4  table           scratch-ref table build + masked gather (the
+                     [h](-A) table pattern, incl. btab lane-slice reads)
+  5  full            the production verify_kernel on one tile, checked
+                     bit-exact against the XLA kernel's verdicts
+
+Every rung checks numerics against the pure-XLA formulation, so a rung
+that compiles but miscompiles (wrong layout, bad shift lowering) is also
+caught here, not in consensus.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+import traceback
+
+import os
+
+import jax
+
+# on this host the tunneled-TPU plugin makes device init hang under the
+# JAX_PLATFORMS=cpu env var alone; the config update is the reliable path
+# (same quirk handling as tests/conftest.py and benchmarks/common.py)
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpubft.ops import f25519 as F
+from tpubft.ops import ed25519 as ops
+from tpubft.ops import ed25519_pallas as kp
+
+NL = F.NL
+SUB = kp.SUB
+TILE = kp.TILE
+T8 = TILE // SUB
+
+
+def _rand_elems(rng: np.random.Generator, n: int) -> np.ndarray:
+    """(n,) random field elements as (NL, n) limb arrays."""
+    vals = [int.from_bytes(rng.bytes(32), "little") % F.P for _ in range(n)]
+    return np.stack([F.int_to_limbs(v) for v in vals], axis=1).astype(np.int32)
+
+
+def _shaped(x: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(x.reshape(x.shape[0], SUB, T8))
+
+
+def _consts() -> jnp.ndarray:
+    return jnp.asarray(kp._consts_table())
+
+
+_CONST_SPEC = pl.BlockSpec((2 * NL, 128), lambda: (0, 0),
+                           memory_space=pltpu.VMEM)
+_ELEM_SPEC = pl.BlockSpec((NL, SUB, T8), lambda: (0, 0, 0),
+                          memory_space=pltpu.VMEM)
+
+
+def _run_elemwise(kernel_body, n_elem_inputs: int, *arrays):
+    """pallas_call with n (NL,8,T8) element inputs + the consts table."""
+    out = pl.pallas_call(
+        kernel_body,
+        in_specs=[_ELEM_SPEC] * n_elem_inputs + [_CONST_SPEC],
+        out_specs=_ELEM_SPEC,
+        out_shape=jax.ShapeDtypeStruct((NL, SUB, T8), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024),
+    )(*[_shaped(a) for a in arrays], _consts())
+    return np.asarray(out).reshape(NL, TILE)
+
+
+# ---- rung bodies ----
+
+def _body_copy(a_ref, consts_ref, out_ref):
+    out_ref[:] = a_ref[:] + consts_ref[0, 0]   # touch both inputs
+
+
+def _body_carry(a_ref, consts_ref, out_ref):
+    e = kp._Engine(consts_ref)
+    out_ref[:] = e.normalize(a_ref[:])
+
+
+def _body_mul(a_ref, b_ref, consts_ref, out_ref):
+    e = kp._Engine(consts_ref)
+    out_ref[:] = e.mul(a_ref[:], b_ref[:])
+
+
+def _body_inv(a_ref, consts_ref, out_ref):
+    e = kp._Engine(consts_ref)
+    out_ref[:] = e.inv(a_ref[:])
+
+
+def _body_table(a_ref, btab_ref, consts_ref, out_ref, atab_ref):
+    """The table-build + masked-gather pattern from the production step
+    function: scratch writes at static indices, btab lane-slice reads,
+    mask-accumulate selects."""
+    e = kp._Engine(consts_ref)
+    a = a_ref[:]
+    atab_ref[0] = a
+    cur = a
+    for j in range(1, 4):
+        cur = e.mul(cur, a)
+        atab_ref[j] = cur
+    idx = (a[0] & 3)                      # (8, T8) pseudo-window digits
+    sel = None
+    for j in range(4):
+        term = jnp.where((idx == j)[None], atab_ref[j], 0)
+        sel = term if sel is None else sel + term
+    col = btab_ref[:, 0:1][:, :, None]    # lane-slice read, (NL, 1, 1)
+    out_ref[:] = e.mul(sel, jnp.broadcast_to(col, sel.shape))
+
+
+# ---- rungs ----
+
+def rung0(rng):
+    a = _rand_elems(rng, TILE)
+    got = _run_elemwise(_body_copy, 1, a)
+    want = a + int(kp._consts_table()[0, 0])
+    assert np.array_equal(got, want), "vmem roundtrip mismatch"
+
+
+def rung1(rng):
+    a = _rand_elems(rng, TILE) * 7        # force carries
+    got = _run_elemwise(_body_carry, 1, a)
+    # check against limb semantics directly: same value mod p
+    for i in range(0, TILE, 257):
+        g = F.limbs_to_int(got[:, i]) % F.P
+        w = F.limbs_to_int(a[:, i]) % F.P
+        assert g == w, f"carry changed value at lane {i}"
+
+
+def rung2(rng):
+    a = _rand_elems(rng, TILE)
+    b = _rand_elems(rng, TILE)
+    got = _run_elemwise(_body_mul, 2, a, b)
+    for i in range(0, TILE, 257):
+        g = F.limbs_to_int(got[:, i]) % F.P
+        w = (F.limbs_to_int(a[:, i]) * F.limbs_to_int(b[:, i])) % F.P
+        assert g == w, f"mul mismatch at lane {i}"
+
+
+def rung3(rng):
+    a = _rand_elems(rng, TILE)
+    got = _run_elemwise(_body_inv, 1, a)
+    for i in range(0, TILE, 509):
+        g = F.limbs_to_int(got[:, i]) % F.P
+        w = pow(F.limbs_to_int(a[:, i]), F.P - 2, F.P)
+        assert g == w, f"inv mismatch at lane {i}"
+
+
+def rung4(rng):
+    a = _rand_elems(rng, TILE)
+    btab = jnp.asarray(kp._btab_transposed())
+    out = pl.pallas_call(
+        _body_table,
+        in_specs=[_ELEM_SPEC,
+                  pl.BlockSpec(btab.shape, lambda: (0, 0),
+                               memory_space=pltpu.VMEM),
+                  _CONST_SPEC],
+        out_specs=_ELEM_SPEC,
+        out_shape=jax.ShapeDtypeStruct((NL, SUB, T8), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((4, NL, SUB, T8), jnp.int32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024),
+    )(_shaped(a), btab, _consts())
+    got = np.asarray(out).reshape(NL, TILE)
+    col0 = F.limbs_to_int(np.asarray(kp._btab_transposed())[:, 0])
+    for i in range(0, TILE, 257):
+        av = F.limbs_to_int(a[:, i])
+        k = int(a[0, i]) & 3
+        want = (pow(av, k + 1, F.P) * col0) % F.P
+        assert F.limbs_to_int(got[:, i]) % F.P == want, \
+            f"table gather mismatch at lane {i}"
+
+
+_INTERPRET = False
+
+
+def rung5(rng):
+    from tpubft.crypto import cpu as ccpu
+    msgs = [rng.bytes(32) for _ in range(TILE)]
+    signer = ccpu.Ed25519Signer.generate(seed=b"bringup")
+    pk = signer.public_bytes()
+    items = [(m, signer.sign(m), pk) for m in msgs]
+    bad = rng.integers(0, TILE, size=7)
+    for i in bad:
+        m, s, p = items[i]
+        items[i] = (m, s[:10] + bytes([s[10] ^ 1]) + s[11:], p)
+    prep = ops.prepare_batch(items)
+    args = (prep.s_win, prep.h_win, prep.a_y, prep.a_sign,
+            prep.r_y, prep.r_sign)
+    kernel = kp.verify_kernel.__wrapped__ if _INTERPRET else kp.verify_kernel
+    got = np.asarray(kernel(*args))
+    want = np.asarray(ops.verify_kernel(*args))
+    assert np.array_equal(got, want), "full kernel disagrees with XLA"
+    assert not got[list(bad)].any(), "corrupted sigs accepted"
+
+
+RUNGS = [("vmem-roundtrip", rung0), ("carry", rung1), ("mul", rung2),
+         ("inv", rung3), ("table+scratch", rung4), ("full-verify", rung5)]
+
+
+def main() -> int:
+    global _INTERPRET
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rung", type=int, default=None)
+    ap.add_argument("--interpret", action="store_true",
+                    help="run under the Pallas interpreter (CPU self-test "
+                         "of the ladder itself; no Mosaic)")
+    args = ap.parse_args()
+    if args.interpret:
+        # interpret mode must never touch the tunneled device — force the
+        # CPU platform BEFORE the first backend init below (env var alone
+        # is unreliable on this box; see module header)
+        jax.config.update("jax_platforms", "cpu")
+        _INTERPRET = True
+    print(f"platform={jax.devices()[0].platform} tile={TILE}")
+    if args.interpret:
+        real_call = pl.pallas_call
+
+        def interp_call(*a, **kw):
+            kw.pop("compiler_params", None)
+            kw["interpret"] = True
+            return real_call(*a, **kw)
+
+        pl.pallas_call = interp_call
+    todo = ([RUNGS[args.rung]] if args.rung is not None else RUNGS)
+    ok = True
+    for name, fn in todo:
+        rng = np.random.default_rng(7)
+        t0 = time.perf_counter()
+        try:
+            fn(rng)
+            print(f"rung {name}: OK ({time.perf_counter()-t0:.1f}s)")
+        except Exception:
+            ok = False
+            print(f"rung {name}: FAIL ({time.perf_counter()-t0:.1f}s)")
+            traceback.print_exc()
+            break   # later rungs share the failing construct
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

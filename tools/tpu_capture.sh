@@ -1,0 +1,78 @@
+#!/bin/bash
+# TPU capture daemon — polls for a compute-capable device window and runs
+# the docs/TPU_CAPTURE.md sequence the moment one opens. All output under
+# /tmp/capture/. Exits 0 after a successful capture, 1 if the deadline
+# passes with no window.
+#
+# Probe = real compute in a bounded subprocess (device init hangs forever
+# when the tunnel is down, and listing devices can succeed while compute
+# hangs — only a completed matmul counts).
+set -u
+OUT=/tmp/capture
+mkdir -p "$OUT"
+DEADLINE=$(( $(date +%s) + ${CAPTURE_WINDOW_S:-39600} ))   # default 11h
+PROBE_TIMEOUT=${PROBE_TIMEOUT_S:-150}
+cd /root/repo
+
+probe() {
+  timeout "$PROBE_TIMEOUT" python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform != "cpu"
+x = jnp.ones((8, 128))
+assert float((x @ x.T).sum()) == 8 * 128 * 8
+EOF
+}
+
+echo "$(date -u +%FT%TZ) capture daemon start (deadline in $((DEADLINE-$(date +%s)))s)" >> "$OUT/daemon.log"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) WINDOW OPEN — starting capture" >> "$OUT/daemon.log"
+    # 1. north-star bench (device confirmed: skip the retry-wait)
+    TPUBFT_BENCH_DEVICE_WAIT_S=0 timeout 1800 python bench.py \
+      > "$OUT/bench.json" 2> "$OUT/bench.err"
+    rc=$?
+    echo "$(date -u +%FT%TZ) bench rc=$rc $(tail -c 300 "$OUT/bench.json")" >> "$OUT/daemon.log"
+    if [ "$rc" != 0 ] || grep -q '"degraded"' "$OUT/bench.json"; then
+      # the window closed under us (bench fell back to CPU or died):
+      # this is NOT a capture — resume polling for a real window
+      echo "$(date -u +%FT%TZ) window lost mid-capture; resuming poll" >> "$OUT/daemon.log"
+      sleep "${PROBE_INTERVAL_S:-45}"
+      continue
+    fi
+    # archive the hardware record into the repo so a later tunnel-down
+    # driver run can still surface it (bench.py attaches it as
+    # "last_hw_capture" on degraded fallbacks)
+    mkdir -p /root/repo/benchmarks/captures
+    python - "$OUT/bench.json" <<'EOF'
+import json, subprocess, sys, time
+rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+commit = subprocess.run(["git", "-C", "/root/repo", "rev-parse", "--short", "HEAD"],
+                        capture_output=True, text=True).stdout.strip()
+out = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+       "commit": commit, "record": rec}
+open("/root/repo/benchmarks/captures/latest_tpu.json", "w").write(
+    json.dumps(out, indent=1) + "\n")
+EOF
+    # 2. e2e with the tpu backend
+    timeout 900 python -m benchmarks.bench_e2e --configs 1,2 --backends tpu --secs 10 \
+      > "$OUT/e2e_inproc.log" 2>&1
+    echo "$(date -u +%FT%TZ) e2e-inproc rc=$?" >> "$OUT/daemon.log"
+    timeout 1200 python -m benchmarks.bench_e2e --configs 1,2 --backends tpu --secs 10 --processes \
+      > "$OUT/e2e_proc.log" 2>&1
+    echo "$(date -u +%FT%TZ) e2e-proc rc=$?" >> "$OUT/daemon.log"
+    # 3. MSM combine crossover
+    timeout 1800 python -m benchmarks.bench_msm_crossover --ks 8,32,128,512,667 \
+      > "$OUT/msm_crossover.log" 2>&1
+    echo "$(date -u +%FT%TZ) crossover rc=$?" >> "$OUT/daemon.log"
+    # 4. config-4 flood
+    timeout 1800 python -m benchmarks.bench_flood --n 1000 --reps 3 \
+      > "$OUT/flood.log" 2>&1
+    echo "$(date -u +%FT%TZ) flood rc=$?" >> "$OUT/daemon.log"
+    echo "$(date -u +%FT%TZ) CAPTURE COMPLETE" >> "$OUT/daemon.log"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) no window" >> "$OUT/daemon.log"
+  sleep "${PROBE_INTERVAL_S:-45}"
+done
+echo "$(date -u +%FT%TZ) deadline passed, no window" >> "$OUT/daemon.log"
+exit 1
