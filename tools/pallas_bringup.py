@@ -224,7 +224,8 @@ RUNGS = [("vmem-roundtrip", rung0), ("carry", rung1), ("mul", rung2),
 def main() -> int:
     global _INTERPRET
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rung", type=int, default=None)
+    ap.add_argument("--rung", type=int, default=None,
+                    choices=range(len(RUNGS)))
     ap.add_argument("--interpret", action="store_true",
                     help="run under the Pallas interpreter (CPU self-test "
                          "of the ladder itself; no Mosaic)")

@@ -1,8 +1,9 @@
 #!/bin/bash
 # TPU capture daemon — polls for a compute-capable device window and runs
 # the docs/TPU_CAPTURE.md sequence the moment one opens. All output under
-# /tmp/capture/. Exits 0 after a successful capture, 1 if the deadline
-# passes with no window.
+# /tmp/capture/. Each step leaves a .done marker; steps that fail (the
+# window closing mid-capture) are retried in later windows. Exits 0 only
+# when EVERY step has succeeded, 1 if the deadline passes first.
 #
 # Probe = real compute in a bounded subprocess (device init hangs forever
 # when the tunnel is down, and listing devices can succeed while compute
@@ -14,6 +15,8 @@ DEADLINE=$(( $(date +%s) + ${CAPTURE_WINDOW_S:-39600} ))   # default 11h
 PROBE_TIMEOUT=${PROBE_TIMEOUT_S:-150}
 cd /root/repo
 
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/daemon.log"; }
+
 probe() {
   timeout "$PROBE_TIMEOUT" python - <<'EOF' >/dev/null 2>&1
 import jax, jax.numpy as jnp
@@ -23,27 +26,19 @@ assert float((x @ x.T).sum()) == 8 * 128 * 8
 EOF
 }
 
-echo "$(date -u +%FT%TZ) capture daemon start (deadline in $((DEADLINE-$(date +%s)))s)" >> "$OUT/daemon.log"
-while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if probe; then
-    echo "$(date -u +%FT%TZ) WINDOW OPEN — starting capture" >> "$OUT/daemon.log"
-    # 1. north-star bench (device confirmed: skip the retry-wait)
-    TPUBFT_BENCH_DEVICE_WAIT_S=0 timeout 1800 python bench.py \
-      > "$OUT/bench.json" 2> "$OUT/bench.err"
-    rc=$?
-    echo "$(date -u +%FT%TZ) bench rc=$rc $(tail -c 300 "$OUT/bench.json")" >> "$OUT/daemon.log"
-    if [ "$rc" != 0 ] || grep -q '"degraded"' "$OUT/bench.json"; then
-      # the window closed under us (bench fell back to CPU or died):
-      # this is NOT a capture — resume polling for a real window
-      echo "$(date -u +%FT%TZ) window lost mid-capture; resuming poll" >> "$OUT/daemon.log"
-      sleep "${PROBE_INTERVAL_S:-45}"
-      continue
-    fi
-    # archive the hardware record into the repo so a later tunnel-down
-    # driver run can still surface it (bench.py attaches it as
-    # "last_hw_capture" on degraded fallbacks)
-    mkdir -p /root/repo/benchmarks/captures
-    python - "$OUT/bench.json" <<'EOF'
+bench_step() {
+  TPUBFT_BENCH_DEVICE_WAIT_S=0 timeout 1800 python bench.py \
+    > "$OUT/bench.json" 2> "$OUT/bench.err"
+  local rc=$?
+  log "bench rc=$rc $(tail -c 300 "$OUT/bench.json")"
+  # a degraded (CPU-fallback) record means the window closed: not a capture
+  [ "$rc" = 0 ] || return 1
+  grep -q '"degraded"' "$OUT/bench.json" && return 1
+  # archive the hardware record into the repo so a later tunnel-down
+  # driver run can still surface it (bench.py attaches it as
+  # "last_hw_capture" on degraded fallbacks)
+  mkdir -p /root/repo/benchmarks/captures
+  python - "$OUT/bench.json" <<'EOF'
 import json, subprocess, sys, time
 rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
 commit = subprocess.run(["git", "-C", "/root/repo", "rev-parse", "--short", "HEAD"],
@@ -53,26 +48,80 @@ out = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
 open("/root/repo/benchmarks/captures/latest_tpu.json", "w").write(
     json.dumps(out, indent=1) + "\n")
 EOF
-    # 2. e2e with the tpu backend
-    timeout 900 python -m benchmarks.bench_e2e --configs 1,2 --backends tpu --secs 10 \
-      > "$OUT/e2e_inproc.log" 2>&1
-    echo "$(date -u +%FT%TZ) e2e-inproc rc=$?" >> "$OUT/daemon.log"
-    timeout 1200 python -m benchmarks.bench_e2e --configs 1,2 --backends tpu --secs 10 --processes \
-      > "$OUT/e2e_proc.log" 2>&1
-    echo "$(date -u +%FT%TZ) e2e-proc rc=$?" >> "$OUT/daemon.log"
-    # 3. MSM combine crossover
-    timeout 1800 python -m benchmarks.bench_msm_crossover --ks 8,32,128,512,667 \
-      > "$OUT/msm_crossover.log" 2>&1
-    echo "$(date -u +%FT%TZ) crossover rc=$?" >> "$OUT/daemon.log"
-    # 4. config-4 flood
-    timeout 1800 python -m benchmarks.bench_flood --n 1000 --reps 3 \
-      > "$OUT/flood.log" 2>&1
-    echo "$(date -u +%FT%TZ) flood rc=$?" >> "$OUT/daemon.log"
-    echo "$(date -u +%FT%TZ) CAPTURE COMPLETE" >> "$OUT/daemon.log"
-    exit 0
+}
+
+e2e_run() {  # $1 log name, $2 timeout, $3... extra flags
+  local logname=$1 tmo=$2; shift 2
+  timeout "$tmo" python -m benchmarks.bench_e2e --configs 1,2 --backends tpu \
+    --secs 10 "$@" > "$OUT/$logname.log" 2>&1 \
+    && grep -q '"ops_per_sec"' "$OUT/$logname.log"
+}
+
+e2e_inproc_step() { e2e_run e2e_inproc 900; }
+
+e2e_proc_step() { e2e_run e2e_proc 1200 --processes; }
+
+crossover_step() {
+  timeout 1800 python -m benchmarks.bench_msm_crossover --ks 8,32,128,512,667 \
+    > "$OUT/msm_crossover.log" 2>&1
+}
+
+flood_step() {
+  timeout 1800 python -m benchmarks.bench_flood --n 1000 --reps 3 \
+    > "$OUT/flood.log" 2>&1
+}
+
+STEPS="bench e2e_inproc e2e_proc crossover flood"
+
+run_step() {  # $1 = step name; skips if already .done, marks on success
+  local name=$1
+  [ -e "$OUT/$name.done" ] && return 0
+  "${name}_step"
+  local rc=$?
+  log "step $name rc=$rc"
+  if [ "$rc" = 0 ]; then
+    touch "$OUT/$name.done"
+    return 0
   fi
-  echo "$(date -u +%FT%TZ) no window" >> "$OUT/daemon.log"
+  return 1
+}
+
+all_done() {
+  for s in $STEPS; do [ -e "$OUT/$s.done" ] || return 1; done
+}
+
+done_count() {
+  local n=0
+  for s in $STEPS; do [ -e "$OUT/$s.done" ] && n=$((n + 1)); done
+  echo "$n"
+}
+
+set -- $STEPS
+TOTAL=$#
+
+# a fresh daemon is a fresh capture intent: stale markers from an earlier
+# run (possibly at an older commit) must not short-circuit this one.
+# CAPTURE_KEEP_MARKERS=1 resumes a partial capture instead.
+if [ "${CAPTURE_KEEP_MARKERS:-0}" != 1 ]; then
+  for s in $STEPS; do rm -f "$OUT/$s.done"; done
+fi
+
+log "capture daemon start (deadline in $((DEADLINE-$(date +%s)))s, $(done_count)/$TOTAL steps pre-marked)"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if probe; then
+    log "WINDOW OPEN — running pending capture steps"
+    for s in $STEPS; do
+      run_step "$s" || break   # window likely closed; re-probe first
+    done
+    if all_done; then
+      log "CAPTURE COMPLETE (all steps)"
+      exit 0
+    fi
+    log "capture incomplete ($(done_count)/$TOTAL steps); resuming poll"
+  else
+    log "no window"
+  fi
   sleep "${PROBE_INTERVAL_S:-45}"
 done
-echo "$(date -u +%FT%TZ) deadline passed, no window" >> "$OUT/daemon.log"
+log "deadline passed; steps done: $(done_count)/$TOTAL"
 exit 1

@@ -33,6 +33,7 @@ def decode_reply(reply: bytes) -> int:
 class CounterHandler(IRequestsHandler):
     def __init__(self) -> None:
         self._value = 0
+        self._applied: dict = {}        # client_id -> last applied req_seq
         self._lock = threading.Lock()
 
     def _persist(self) -> None:
@@ -47,7 +48,16 @@ class CounterHandler(IRequestsHandler):
         if request[:1] == b"A" and len(request) == 1 + _I64.size:
             delta = _I64.unpack(request[1:])[0]
             with self._lock:
+                # replay idempotence: recovery re-executes the committed
+                # suffix after the WAL's executed mark, which can trail
+                # app state persisted mid-crash (the same reason kvbc
+                # replays are keyed by block id — add_block of an
+                # existing id is a no-op)
+                if req_seq and self._applied.get(client_id, 0) >= req_seq:
+                    return _I64.pack(self._value)
                 self._value += delta
+                if req_seq:
+                    self._applied[client_id] = req_seq
                 self._persist()
                 return _I64.pack(self._value)
         if request[:1] == b"R":
@@ -71,15 +81,27 @@ class PersistentCounterHandler(CounterHandler):
         self._path = path
         try:
             with open(path, "rb") as fh:
-                self._value = _I64.unpack(fh.read(_I64.size))[0]
-        except (OSError, struct.error):
+                raw = fh.read()
+            if len(raw) == _I64.size:       # legacy bare-i64 state file
+                self._value = _I64.unpack(raw)[0]
+            else:                           # current JSON format
+                import json
+                st = json.loads(raw)
+                self._value = int(st["value"])
+                self._applied = {int(k): int(v)
+                                 for k, v in st.get("applied", {}).items()}
+        except (OSError, ValueError, KeyError, struct.error):
             self._value = 0
 
     def _persist(self) -> None:
+        """Value + per-client applied marks in ONE atomic replace: app
+        state and its replay-idempotence index must never diverge."""
+        import json
         import os
         tmp = self._path + ".tmp"
         with open(tmp, "wb") as fh:
-            fh.write(_I64.pack(self._value))
+            fh.write(json.dumps({"value": self._value,
+                                 "applied": self._applied}).encode())
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, self._path)

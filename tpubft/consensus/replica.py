@@ -353,8 +353,10 @@ class Replica(IReceiver):
         from tpubft.testing.slowdown import get_slowdown_manager
         self._slowdown = get_slowdown_manager()
 
-        self._restore_window(window_msgs)
+        # assigned BEFORE the restore replay: _restore_window can reach
+        # _execute_committed, whose pipeline retrigger reads _running
         self._running = False
+        self._restore_window(window_msgs)
 
     def _load_client_replies_from_pages(self) -> None:
         """Seed the at-most-once table + reply cache from reserved pages
@@ -769,6 +771,16 @@ class Replica(IReceiver):
         wedge_fill = (self.control.wedge_point is not None
                       and self.primary_next_seq <= self.control.wedge_point)
         if not self.pending_requests and not wedge_fill:
+            return
+        # pipeline gate (reference ReplicaImp::tryToSendPrePrepareMsg /
+        # concurrencyLevel): cap proposed-but-not-executed slots. Under
+        # load this is what creates real batches — requests arriving
+        # while the pipeline is full accumulate and ship together when a
+        # slot completes (execution re-triggers this), instead of every
+        # request paying a full consensus slot of per-replica crypto.
+        # At light load nothing is in flight and proposal is immediate.
+        in_flight = (self.primary_next_seq - 1) - self.last_executed
+        if in_flight >= max(1, self.cfg.concurrency_level):
             return
         seq = self.primary_next_seq
         if seq > self.last_stable + self.cfg.work_window_size:
@@ -1362,6 +1374,10 @@ class Replica(IReceiver):
                 st.last_executed_seq = nxt
             if nxt % self.cfg.checkpoint_window_size == 0:
                 self._send_checkpoint(nxt)
+            # a slot just left the pipeline: the primary proposes the
+            # batch that accumulated behind the concurrency gate NOW
+            # rather than waiting for the next flush-timer tick
+            self._try_send_pre_prepare()
 
     def _execute_internal_request(self, req: m.ClientRequestMsg,
                                   seq: int = 0) -> bytes:

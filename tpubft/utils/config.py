@@ -31,7 +31,11 @@ class ReplicaConfig:
     batch_flush_period_ms: int = 7
 
     # protocol windows/timers
-    concurrency_level: int = 1
+    # max consensus slots proposed-but-not-executed (the PrePrepare
+    # pipeline gate; under load this is also what forms request batches).
+    # Reference: ReplicaConfig.hpp concurrencyLevel, SKVBC tester
+    # replica default 3 (tests/simpleKVBC/TesterReplica/setup.cpp:72)
+    concurrency_level: int = 3
     view_change_timer_ms: int = 4000
     status_report_timer_ms: int = 1000
     checkpoint_window_size: int = 150   # seqnums between protocol checkpoints
