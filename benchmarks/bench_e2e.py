@@ -41,8 +41,14 @@ CONFIGS = {
     3: dict(f=10, threshold_scheme="threshold-bls",
             client_sig_scheme="ecdsa-secp256k1",
             # a 31-replica co-located cluster pays ~n pairing checks per
-            # round on one host: keep the VC timer out of the measurement
-            view_change_timer_ms=30000),
+            # round on one host: keep the VC timer out of the measurement,
+            # stop the 300ms fast-path timer from firing on >600ms
+            # co-location slots (spurious slow-path crypto), and don't
+            # pipeline slots (overlap amplifies the n=31 contention —
+            # depth 1 measured 1.8x depth 3 on a 1-core host)
+            view_change_timer_ms=30000,
+            fast_path_timeout_ms=5000,
+            concurrency_level=1),
     5: dict(f=1, threshold_scheme="threshold-bls",
             client_sig_scheme="ecdsa-p256", transport="tls",
             storm_period_s=4.0),
@@ -122,12 +128,13 @@ def run_config(config: int, backend: str, secs: float,
         # in-process row must not claim a fidelity it didn't run with
         raise SystemExit(
             f"config {config} requires --processes (tls/storm fidelity)")
-    overrides = {"threshold_scheme": cfg["threshold_scheme"],
-                 "client_sig_scheme": cfg.get("client_sig_scheme",
-                                              "ed25519"),
-                 "crypto_backend": backend}
-    if cfg.get("view_change_timer_ms"):
-        overrides["view_change_timer_ms"] = cfg["view_change_timer_ms"]
+    # every ReplicaConfig field in the CONFIGS entry flows through (f and
+    # the process-only keys are harness-level); cherry-picking fields
+    # here silently dropped new tunings
+    overrides = {k: v for k, v in cfg.items()
+                 if k not in ("f", "transport", "storm_period_s")}
+    overrides.setdefault("client_sig_scheme", "ed25519")
+    overrides["crypto_backend"] = backend
     with InProcessCluster(f=cfg["f"], num_clients=clients,
                           handler_factory=_handler_factory,
                           cfg_overrides=overrides) as cluster:
@@ -164,6 +171,12 @@ def run_config_processes(config: int, backend: str, secs: float,
 
     from tpubft.testing.network import BftTestNetwork
     cfg = CONFIGS[config]
+    # ReplicaConfig fields without a dedicated BftTestNetwork parameter
+    # ride the generic --config-override plumbing — process rows must run
+    # the same tunings as the in-process rows
+    flagged = ("f", "transport", "storm_period_s", "threshold_scheme",
+               "client_sig_scheme", "view_change_timer_ms")
+    overrides = {k: v for k, v in cfg.items() if k not in flagged}
     with tempfile.TemporaryDirectory() as tmp, \
             BftTestNetwork(f=cfg["f"], num_clients=max(4, clients),
                            db_dir=tmp, crypto_backend=backend,
@@ -172,7 +185,8 @@ def run_config_processes(config: int, backend: str, secs: float,
                                                      "ed25519"),
                            view_change_timeout_ms=cfg.get(
                                "view_change_timer_ms", 3000),
-                           transport=cfg.get("transport", "udp")) as net:
+                           transport=cfg.get("transport", "udp"),
+                           cfg_overrides=overrides) as net:
         storm_stop = None
         storm_thread = None
         if cfg.get("storm_period_s"):

@@ -24,22 +24,45 @@ from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.metrics import Aggregator, UdpMetricsServer
 
 
+def _parse_overrides(pairs) -> dict:
+    """--config-override key=value (repeatable): any ReplicaConfig field,
+    coerced to the field's declared type. The generic escape hatch so new
+    tunables never need a dedicated flag to reach process clusters."""
+    import dataclasses
+    types = {f.name: f.type for f in dataclasses.fields(ReplicaConfig)}
+    out = {}
+    for pair in pairs or []:
+        key, sep, val = pair.partition("=")
+        if not sep or key not in types:
+            raise SystemExit(f"--config-override: unknown or malformed "
+                             f"'{pair}' (want <ReplicaConfig field>=<value>)")
+        t = types[key]
+        if t in ("int", int):
+            out[key] = int(val)
+        elif t in ("bool", bool):
+            out[key] = val.lower() in ("1", "true", "yes", "on")
+        else:
+            out[key] = val
+    return out
+
+
 def build_replica(args, comm_wrapper=None) -> KvbcReplica:
-    cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f, c_val=args.c,
-                        num_ro_replicas=args.ro,
-                        num_of_client_proxies=args.clients,
-                        view_change_timer_ms=args.view_change_timeout_ms,
-                        crypto_backend=args.crypto_backend,
-                        pre_execution_enabled=args.pre_execution,
-                        checkpoint_window_size=args.checkpoint_window,
-                        work_window_size=args.work_window,
-                        **({"device_min_verify_batch":
-                            args.device_min_verify_batch}
-                           if args.device_min_verify_batch is not None
-                           else {}),
-                        kvbc_version=args.kvbc_version,
-                        threshold_scheme=args.threshold_scheme,
-                        client_sig_scheme=args.client_sig_scheme)
+    kw = dict(replica_id=args.replica, f_val=args.f, c_val=args.c,
+              num_ro_replicas=args.ro,
+              num_of_client_proxies=args.clients,
+              view_change_timer_ms=args.view_change_timeout_ms,
+              crypto_backend=args.crypto_backend,
+              pre_execution_enabled=args.pre_execution,
+              checkpoint_window_size=args.checkpoint_window,
+              work_window_size=args.work_window,
+              kvbc_version=args.kvbc_version,
+              threshold_scheme=args.threshold_scheme,
+              client_sig_scheme=args.client_sig_scheme)
+    if args.device_min_verify_batch is not None:
+        kw["device_min_verify_batch"] = args.device_min_verify_batch
+    # generic overrides win over flag-mapped fields (applied last)
+    kw.update(_parse_overrides(getattr(args, "config_override", None)))
+    cfg = ReplicaConfig(**kw)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()).for_node(args.replica)
     from tpubft.consensus.replicas_info import ReplicasInfo
@@ -95,6 +118,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="batches below this verify per-principal instead "
                         "of via the cross-principal device dispatch "
                         "(default: ReplicaConfig's crossover)")
+    p.add_argument("--config-override", action="append", default=[],
+                   metavar="FIELD=VALUE",
+                   help="set any ReplicaConfig field (repeatable); the "
+                        "generic escape hatch so new tunables reach "
+                        "process clusters without a dedicated flag")
     p.add_argument("--crypto-backend", default="cpu",
                    choices=("cpu", "tpu", "auto"))
     p.add_argument("--pre-execution", action="store_true")

@@ -76,7 +76,8 @@ class BftTestNetwork:
                  transport: str = "udp",
                  threshold_scheme: str = "multisig-ed25519",
                  client_sig_scheme: str = "ed25519",
-                 device_min_verify_batch: Optional[int] = None) -> None:
+                 device_min_verify_batch: Optional[int] = None,
+                 cfg_overrides: Optional[dict] = None) -> None:
         self.f, self.c = f, c
         self.n = 3 * f + 2 * c + 1
         self.num_ro = num_ro
@@ -97,6 +98,9 @@ class BftTestNetwork:
         self.threshold_scheme = threshold_scheme
         self.client_sig_scheme = client_sig_scheme
         self.device_min_verify_batch = device_min_verify_batch
+        # arbitrary ReplicaConfig fields, forwarded to every replica
+        # process as --config-override FIELD=VALUE
+        self.cfg_overrides = dict(cfg_overrides or {})
         self.certs_dir = None
         if transport == "tls":
             # pinned-cert material for every principal (replicas +
@@ -163,6 +167,8 @@ class BftTestNetwork:
         if self.device_min_verify_batch is not None:
             args += ["--device-min-verify-batch",
                      str(self.device_min_verify_batch)]
+        for k, v in self.cfg_overrides.items():
+            args += ["--config-override", f"{k}={v}"]
         if self.certs_dir:
             args += ["--certs-dir", self.certs_dir]
         if self.pre_execution:
