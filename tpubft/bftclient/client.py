@@ -16,7 +16,7 @@ import enum
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from tpubft.comm.interfaces import ICommunication, IReceiver
 from tpubft.consensus import messages as m
@@ -54,6 +54,7 @@ class BftClient(IReceiver):
         self._signer = keys.my_signer()
         self._req_seq = int(time.time() * 1e6)  # monotonic across restarts
         self._lock = threading.Lock()
+        self._batch_lock = threading.Lock()   # one outstanding batch
         self._replies: Dict[int, Dict[int, m.ClientReplyMsg]] = {}
         self._done: Dict[int, threading.Event] = {}
         self._result: Dict[int, m.ClientReplyMsg] = {}
@@ -123,59 +124,143 @@ class BftClient(IReceiver):
         return self._send(request, flags=int(m.RequestFlag.READ_ONLY),
                           quorum=quorum, timeout_ms=timeout_ms)
 
+    def send_write_batch(self, requests: List[bytes],
+                         quorum: Quorum = Quorum.LINEARIZABLE,
+                         timeout_ms: Optional[int] = None) -> List[bytes]:
+        """Several writes in ONE wire message (reference preprocessor
+        ClientBatchRequestMsg): each element is its own individually
+        signed ClientRequestMsg with its own req_seq/quorum tracking;
+        the batch is a transport + admission-verify optimization (the
+        replica verifies all elements in one cross-request device
+        batch). Returns the replies in order; raises TimeoutError if any
+        element misses quorum within the deadline."""
+        if not requests:
+            return []
+        if len(requests) > m.ClientBatchRequestMsg.MAX_BATCH:
+            raise ValueError(
+                f"batch of {len(requests)} > "
+                f"{m.ClientBatchRequestMsg.MAX_BATCH}")
+        if any(not p for p in requests):
+            # an empty element would fail ClientRequestMsg.validate on
+            # every replica and silently poison the WHOLE batch into a
+            # timeout — reject it here where the caller can see why
+            raise ValueError("empty request payload in batch")
+        self.start()
+        # one outstanding batch per client: replicas cache replies for
+        # retransmission recovery in a bounded per-client window
+        # (clients_manager.REPLY_CACHE_PER_CLIENT); concurrent batches
+        # from one principal could evict each other's replies and
+        # strand a retransmission
+        with self._batch_lock:
+            from tpubft.utils.tracing import get_tracer
+            span = get_tracer().start_span("client_send_batch")
+            span.set_tag("client", self.cfg.client_id) \
+                .set_tag("count", len(requests))
+            with self._lock:
+                reqs = [self._new_request_locked(payload, 0,
+                                                 span.context.serialize(),
+                                                 quorum)
+                        for payload in requests]
+            for req in reqs:
+                req.signature = self._signer.sign(req.signed_payload())
+            batch = m.ClientBatchRequestMsg(
+                sender_id=self.cfg.client_id, cid=span.context.serialize(),
+                requests=[r.pack() for r in reqs], signature=b"")
+            try:
+                missed = self._drive_quorum(
+                    batch.pack(), [r.req_seq_num for r in reqs],
+                    read_only=False, timeout_ms=timeout_ms)
+                if missed:
+                    raise TimeoutError_(
+                        f"client {self.cfg.client_id} batch: "
+                        f"{len(missed)}/{len(reqs)} elements missed quorum")
+                return [self._result[r.req_seq_num].reply for r in reqs]
+            finally:
+                span.finish()
+                self._forget([r.req_seq_num for r in reqs])
+
     def _send(self, request: bytes, flags: int, quorum: Quorum,
               timeout_ms: Optional[int]) -> bytes:
         self.start()
-        with self._lock:
-            self._req_seq += 1
-            req_seq = self._req_seq
-            evt = self._done[req_seq] = threading.Event()
-            self._quorum_needed[req_seq] = self.quorum_size(quorum)
         # the cid carries a serialized span context so the request's trace
         # joins across every replica (reference: spanContext inside
         # ClientRequestMsg; OpenTracing.hpp)
         from tpubft.utils.tracing import get_tracer
         span = get_tracer().start_span("client_send")
+        with self._lock:
+            req = self._new_request_locked(request, flags,
+                                           span.context.serialize(),
+                                           quorum)
+        req_seq = req.req_seq_num
         span.set_tag("client", self.cfg.client_id).set_tag("req_seq",
                                                            req_seq)
-        req = m.ClientRequestMsg(sender_id=self.cfg.client_id,
-                                 req_seq_num=req_seq, flags=flags,
-                                 request=request,
-                                 cid=span.context.serialize(),
-                                 signature=b"")
         req.signature = self._signer.sign(req.signed_payload())
-        raw = req.pack()
+        try:
+            missed = self._drive_quorum(
+                req.pack(), [req_seq],
+                read_only=bool(flags & int(m.RequestFlag.READ_ONLY)),
+                timeout_ms=timeout_ms)
+            if missed:
+                raise TimeoutError_(
+                    f"client {self.cfg.client_id} req {req_seq}: no "
+                    f"quorum within "
+                    f"{timeout_ms or self.cfg.request_timeout_ms}ms")
+            return self._result[req_seq].reply
+        finally:
+            span.finish()
+            self._forget([req_seq])
+
+    # ---- shared request machinery (single + batch paths) ----
+    def _new_request_locked(self, payload: bytes, flags: int, cid: str,
+                            quorum: Quorum) -> m.ClientRequestMsg:
+        """Allocate a req_seq and its quorum tracking (caller holds
+        _lock and signs afterwards)."""
+        self._req_seq += 1
+        rs = self._req_seq
+        self._done[rs] = threading.Event()
+        self._quorum_needed[rs] = self.quorum_size(quorum)
+        return m.ClientRequestMsg(sender_id=self.cfg.client_id,
+                                  req_seq_num=rs, flags=flags,
+                                  request=payload, cid=cid, signature=b"")
+
+    def _drive_quorum(self, raw: bytes, seqs: List[int], read_only: bool,
+                      timeout_ms: Optional[int]) -> set:
+        """Send `raw` and wait for quorum on every seq in `seqs`;
+        returns the seqs that missed quorum (empty = success).
+
+        Happy path: the primary alone orders writes (reference bftclient
+        sends to the primary first and broadcasts only on retry) —
+        backups pay nothing per write unless the primary is slow or has
+        moved; only worth it when the budget allows at least one
+        broadcast retry after a wrong-hint miss. Read-only requests
+        always broadcast: each replica answers from local state and the
+        client needs f+1 matching replies from DISTINCT replicas."""
         deadline = time.monotonic() + (timeout_ms
                                        or self.cfg.request_timeout_ms) / 1e3
         retry_s = self.cfg.retry_timeout_ms / 1e3
-        try:
-            first = True
-            while time.monotonic() < deadline:
-                # happy path: the primary alone orders the request
-                # (reference bftclient sends to the primary first and
-                # broadcasts only on retry) — backups pay nothing per
-                # write unless the primary is slow or has moved. Only
-                # worth it when the budget allows at least one broadcast
-                # retry after a wrong-hint miss. Read-only requests
-                # always broadcast: each replica answers from local
-                # state and the client needs f+1 matching replies from
-                # DISTINCT replicas.
-                if (first and not flags & int(m.RequestFlag.READ_ONLY)
-                        and deadline - time.monotonic() > 2 * retry_s):
-                    self.comm.send(self._primary_hint, raw)
-                else:
-                    for r in self.info.replica_ids:
-                        self.comm.send(r, raw)
-                first = False
-                if evt.wait(timeout=retry_s):
-                    return self._result[req_seq].reply
-            raise TimeoutError_(
-                f"client {self.cfg.client_id} req {req_seq}: no quorum "
-                f"within {timeout_ms or self.cfg.request_timeout_ms}ms")
-        finally:
-            span.finish()
-            with self._lock:
-                self._done.pop(req_seq, None)
-                self._replies.pop(req_seq, None)
-                self._result.pop(req_seq, None)
-                self._quorum_needed.pop(req_seq, None)
+        first = True
+        pending = set(seqs)
+        while time.monotonic() < deadline and pending:
+            if (first and not read_only
+                    and deadline - time.monotonic() > 2 * retry_s):
+                self.comm.send(self._primary_hint, raw)
+            else:
+                for r in self.info.replica_ids:
+                    self.comm.send(r, raw)
+            first = False
+            wait_until = min(deadline, time.monotonic() + retry_s)
+            for rs in sorted(pending):
+                if not self._done[rs].wait(
+                        timeout=max(0.0, wait_until - time.monotonic())):
+                    break
+            pending = {rs for rs in pending
+                       if not self._done[rs].is_set()}
+        return pending
+
+    def _forget(self, seqs: List[int]) -> None:
+        with self._lock:
+            for rs in seqs:
+                self._done.pop(rs, None)
+                self._replies.pop(rs, None)
+                self._result.pop(rs, None)
+                self._quorum_needed.pop(rs, None)

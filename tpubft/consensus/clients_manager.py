@@ -8,16 +8,28 @@ retransmitted request gets the cached answer instead of re-execution.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
-from tpubft.consensus.messages import ClientReplyMsg
+from tpubft.consensus.messages import ClientBatchRequestMsg, ClientReplyMsg
+
+# replies kept per client for retransmission recovery. Must cover a full
+# client batch PLUS interleaved single writes: every element of an
+# executed batch has to stay regenerable until the client stops
+# retransmitting it (reference keeps per-request reply slots in reserved
+# pages, bounded by the client batching limit). The client enforces one
+# outstanding batch per principal (bftclient._batch_lock), so 2× the
+# batch bound covers a retransmitting batch alongside a full batch's
+# worth of other traffic from the same principal.
+REPLY_CACHE_PER_CLIENT = 2 * ClientBatchRequestMsg.MAX_BATCH
 
 
 @dataclass
 class _ClientInfo:
     last_executed_req: int = -1
-    last_reply: Optional[ClientReplyMsg] = None
+    replies: "OrderedDict[int, ClientReplyMsg]" = field(
+        default_factory=OrderedDict)
     pending_req_seq: Optional[int] = None
     pending_cid: str = ""
 
@@ -57,7 +69,9 @@ class ClientsManager:
             return
         if req_seq > info.last_executed_req:
             info.last_executed_req = req_seq
-            info.last_reply = reply
+        info.replies[req_seq] = reply
+        while len(info.replies) > REPLY_CACHE_PER_CLIENT:
+            info.replies.popitem(last=False)     # evict oldest
         if info.pending_req_seq is not None and req_seq >= info.pending_req_seq:
             info.pending_req_seq = None
             info.pending_cid = ""
@@ -68,17 +82,15 @@ class ClientsManager:
         info = self._clients.get(client_id)
         if info is not None and req_seq > info.last_executed_req:
             info.last_executed_req = req_seq
-            info.last_reply = None
 
     def cached_reply(self, client_id: int,
                      req_seq: int) -> Optional[ClientReplyMsg]:
         """Reply for a retransmitted already-executed request (reference
-        stores replies in reserved pages; we cache the latest)."""
+        stores per-request reply slots in reserved pages; we keep a
+        bounded per-client map so every element of an executed batch
+        stays regenerable, not just the newest request)."""
         info = self._clients.get(client_id)
-        if info and info.last_reply is not None \
-                and info.last_executed_req == req_seq:
-            return info.last_reply
-        return None
+        return info.replies.get(req_seq) if info else None
 
     def last_executed(self, client_id: int) -> int:
         info = self._clients.get(client_id)

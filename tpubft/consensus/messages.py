@@ -51,6 +51,7 @@ class MsgCode(enum.IntEnum):
     PreProcessRequest = 21
     PreProcessReply = 22
     ReqViewPrePrepare = 23
+    ClientBatchRequest = 24
 
 
 class RequestFlag(enum.IntFlag):
@@ -152,6 +153,35 @@ class ClientRequestMsg(ConsensusMsg):
     def validate(self) -> None:
         if not self.request and not self.flags & RequestFlag.READ_ONLY:
             raise MsgError("empty write request")
+
+
+@register
+@dataclass
+class ClientBatchRequestMsg(ConsensusMsg):
+    """Reference preprocessor/messages/ClientBatchRequestMsg.hpp: several
+    individually-signed ClientRequestMsgs from ONE client ride a single
+    wire message. The replica unpacks and admits each element; their
+    signatures then verify as one cross-request device batch in the
+    admission plane, so client batching composes with the TPU seam."""
+    CODE = MsgCode.ClientBatchRequest
+    sender_id: int
+    cid: str
+    requests: list                # packed ClientRequestMsg frames
+    signature: bytes              # unused — authenticity is per element
+    SPEC = [("sender_id", "u32"), ("cid", "str"),
+            ("requests", ("list", "bytes")), ("signature", "bytes")]
+
+    # also sizes the per-client reply cache (clients_manager) — every
+    # element of an executed batch must stay regenerable for
+    # retransmission recovery, so the cache covers one full batch
+    MAX_BATCH: ClassVar[int] = 64
+
+    def validate(self) -> None:
+        if not self.requests:
+            raise MsgError("empty client batch")
+        if len(self.requests) > self.MAX_BATCH:
+            raise MsgError(
+                f"client batch of {len(self.requests)} > {self.MAX_BATCH}")
 
 
 @register

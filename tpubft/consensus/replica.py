@@ -523,6 +523,35 @@ class Replica(IReceiver):
                 return
             self._on_client_request(msg)
             return
+        if isinstance(msg, m.ClientBatchRequestMsg):
+            # one wire message, several individually-signed requests
+            # (reference ClientBatchRequestMsg::checkElements): every
+            # element must decode to a ClientRequestMsg from the SAME
+            # client; each then takes the normal admission path, where
+            # the async plane verifies them as one device batch
+            if msg.sender_id != sender and not self.info.is_replica(sender):
+                return
+            inners = []
+            for raw in msg.requests:
+                try:
+                    inner = m.unpack(raw)
+                except m.MsgError:
+                    return          # malformed element: drop whole batch
+                if not isinstance(inner, m.ClientRequestMsg) \
+                        or inner.sender_id != msg.sender_id:
+                    return          # element from a different principal
+                inners.append(inner)
+            # backup: relay the BATCH once (one wire message — exploding
+            # it into per-element forwards would defeat the transport
+            # amortization); elements below run with relay suppressed
+            # and still arm the liveness clock individually post-verify
+            if not self.is_primary and not self.in_view_change \
+                    and any((msg.sender_id, i.req_seq_num)
+                            not in self._forwarded for i in inners):
+                self.comm.send(self.primary, msg.pack())
+            for inner in inners:
+                self._on_client_request(inner, relay=False)
+            return
         # Anti-spoofing: sender_id must match the transport sender —
         # EXCEPT for messages carrying their own end-to-end signature
         # (replica sig or threshold combined sig, verified in their
@@ -612,7 +641,8 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     # client requests (ReplicaImp.cpp:397)
     # ------------------------------------------------------------------
-    def _on_client_request(self, req: m.ClientRequestMsg) -> None:
+    def _on_client_request(self, req: m.ClientRequestMsg,
+                           relay: bool = True) -> None:
         """Traced entry (reference: child span per message handler,
         ReplicaImp.cpp:409-413 — the span context rides the cid field,
         MessageBase::spanContext<T>())."""
@@ -622,9 +652,10 @@ class Replica(IReceiver):
                 parent=SpanContext.parse(req.cid or "")) as span:
             span.set_tag("r", self.id).set_tag("client", req.sender_id) \
                 .set_tag("req_seq", req.req_seq_num)
-            self._handle_client_request(req)
+            self._handle_client_request(req, relay=relay)
 
-    def _handle_client_request(self, req: m.ClientRequestMsg) -> None:
+    def _handle_client_request(self, req: m.ClientRequestMsg,
+                               relay: bool = True) -> None:
         client = req.sender_id
         if not self.clients.is_valid_client(client):
             return
@@ -654,7 +685,10 @@ class Replica(IReceiver):
                 # never be armed by forged floods)
                 if (client, req.req_seq_num) in self._forwarded:
                     return        # already forwarded + liveness armed
-                if not self.in_view_change:
+                if not self.in_view_change and relay:
+                    # relay=False when this element arrived inside a
+                    # ClientBatchRequestMsg the dispatcher already
+                    # relayed whole
                     self.comm.send(self.primary, req.pack())
             else:
                 # primary fast drop BEFORE paying for verification: a
