@@ -61,10 +61,13 @@ def _handler_factory(_r=None):
 
 def _drive(make_kv, config: int, backend: str, secs: float,
            clients: int, mode: str = None,
-           warmup_timeout_ms: int = 20000) -> dict:
+           warmup_timeout_ms: int = 20000,
+           client_batch: int = 1) -> dict:
     """Shared workload driver: `make_kv(idx)` returns a SkvbcClient
     bound to client `idx`; one stats pipeline serves both harness
-    modes (so BASELINE numbers can never drift between them)."""
+    modes (so BASELINE numbers can never drift between them).
+    client_batch>1 sends that many independent transactions per wire
+    message (ClientBatchRequestMsg); each counts as one op."""
     cfg = CONFIGS[config]
     counts = [0] * clients
     lats: List[List[float]] = [[] for _ in range(clients)]
@@ -76,10 +79,22 @@ def _drive(make_kv, config: int, backend: str, secs: float,
         while time.monotonic() < stop_at[0]:
             t0 = time.monotonic()
             try:
+                if client_batch > 1:
+                    ws = [[(b"bench-%d-%d" % (idx, (i + j) % 64),
+                            b"v%d" % (i + j))]
+                          for j in range(client_batch)]
+                    rs = kv.write_batch(ws, timeout_ms=8000)
+                    dt = time.monotonic() - t0
+                    ok = sum(1 for r in rs if r.success)
+                    if ok:
+                        counts[idx] += ok
+                        lats[idx].append(dt)
+                    i += client_batch
+                    continue
                 r = kv.write([(b"bench-%d-%d" % (idx, i % 64),
                                b"v%d" % i)], timeout_ms=8000)
             except Exception:  # noqa: BLE001 — lossy transports time out
-                i += 1
+                i += client_batch if client_batch > 1 else 1
                 continue
             dt = time.monotonic() - t0
             if r.success:
@@ -109,6 +124,7 @@ def _drive(make_kv, config: int, backend: str, secs: float,
         "transport": cfg.get("transport", "udp/loopback"),
         "backend": backend,
         "clients": clients, "secs": round(wall, 2), "ops": total,
+        **({"client_batch": client_batch} if client_batch > 1 else {}),
         "ops_per_sec": round(total / wall, 1),
         "mean_latency_ms": round(statistics.mean(all_lats) * 1e3, 2)
         if all_lats else None,
@@ -121,7 +137,7 @@ def _drive(make_kv, config: int, backend: str, secs: float,
 
 
 def run_config(config: int, backend: str, secs: float,
-               clients: int) -> dict:
+               clients: int, client_batch: int = 1) -> dict:
     cfg = CONFIGS[config]
     if cfg.get("transport") or cfg.get("storm_period_s"):
         # TLS transport and the VC storm only exist on real processes; an
@@ -140,7 +156,8 @@ def run_config(config: int, backend: str, secs: float,
                           cfg_overrides=overrides) as cluster:
         return _drive(lambda i: skvbc.SkvbcClient(cluster.client(i)),
                       config, backend, secs, clients,
-                      warmup_timeout_ms=60000 if cfg["f"] > 2 else 20000)
+                      warmup_timeout_ms=60000 if cfg["f"] > 2 else 20000,
+                      client_batch=client_batch)
 
 
 def _storm(net, stop_evt, period_s: float) -> None:
@@ -163,7 +180,7 @@ def _storm(net, stop_evt, period_s: float) -> None:
 
 
 def run_config_processes(config: int, backend: str, secs: float,
-                         clients: int) -> dict:
+                         clients: int, client_batch: int = 1) -> dict:
     """REAL replica OS processes (BftTestNetwork) — no shared-GIL
     inflation; this is the deployment-shaped number."""
     import tempfile
@@ -200,7 +217,7 @@ def run_config_processes(config: int, backend: str, secs: float,
             row = _drive(net.skvbc_client, config, backend, secs, clients,
                          mode="processes",
                          warmup_timeout_ms=60000 if cfg["f"] > 2
-                         else 20000)
+                         else 20000, client_batch=client_batch)
         finally:
             if storm_stop is not None:
                 storm_stop.set()
@@ -216,6 +233,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--secs", type=float, default=10.0)
     ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--client-batch", type=int, default=1,
+                    help=">1: transactions per wire message "
+                         "(ClientBatchRequestMsg)")
     ap.add_argument("--configs", default="1,2")
     ap.add_argument("--backends", default="cpu")
     ap.add_argument("--processes", action="store_true",
@@ -225,7 +245,8 @@ def main() -> None:
     for config in [int(x) for x in args.configs.split(",")]:
         for backend in args.backends.split(","):
             fn = run_config_processes if args.processes else run_config
-            row = fn(config, backend, args.secs, args.clients)
+            row = fn(config, backend, args.secs, args.clients,
+                     args.client_batch)
             print(json.dumps(row), flush=True)
 
 

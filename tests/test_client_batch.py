@@ -129,3 +129,38 @@ def test_backup_relays_whole_batch_to_primary():
         while time.time() < deadline and cl.handlers[0].value != 10:
             time.sleep(0.05)
         assert cl.handlers[0].value == 10
+
+
+def test_out_of_order_admission_multi_pending():
+    """A later-allocated single request may ARRIVE before a batch's
+    elements; membership (not seq ordering) is the in-flight dedup, so
+    the earlier seqs must still be admittable (reference ClientsManager
+    tracks a requestsInfo MAP, not one slot)."""
+    from tpubft.consensus.clients_manager import ClientsManager
+    cm = ClientsManager([10])
+    cm.add_pending(10, 65)               # the late single arrives first
+    for s in range(1, 65):               # then the batch's elements
+        assert cm.can_become_pending(10, s), s
+        cm.add_pending(10, s)
+    assert not cm.can_become_pending(10, 65)   # dup while in flight
+    assert not cm.can_become_pending(10, 64)
+
+
+def test_batch_replies_survive_replica_restart():
+    """Reply-ring persistence: after a restart, EVERY element of an
+    executed batch stays regenerable from reserved pages, not just the
+    newest reply."""
+    with InProcessCluster(f=1, num_clients=1,
+                          cfg_overrides={"crypto_backend": "cpu"}) as cl:
+        c = cl.client(0)
+        replies = c.send_write_batch(
+            [counter.encode_add(i) for i in (1, 2, 3)], timeout_ms=20000)
+        assert [counter.decode_reply(r) for r in replies] == [1, 3, 6]
+        last_seq = c._req_seq
+        seqs = [last_seq - 2, last_seq - 1, last_seq]
+        rep = cl.restart(2)
+        for s in seqs:
+            cached = rep.clients.cached_reply(c.cfg.client_id, s)
+            assert cached is not None, f"reply for seq {s} lost on restart"
+        assert counter.decode_reply(rep.clients.cached_reply(
+            c.cfg.client_id, seqs[-1]).reply) == 6

@@ -237,6 +237,16 @@ class SkvbcClient:
                                         pre_process=pre_process)
         return unpack(reply)
 
+    def write_batch(self, writes: List[List[Tuple[bytes, bytes]]],
+                    timeout_ms: Optional[int] = None) -> List[WriteReply]:
+        """Several independent write transactions in ONE wire message
+        (BftClient.send_write_batch / ClientBatchRequestMsg); each
+        element orders and replies separately."""
+        reqs = [pack(WriteRequest(read_version=0, readset=[], writeset=ws))
+                for ws in writes]
+        replies = self._client.send_write_batch(reqs, timeout_ms=timeout_ms)
+        return [unpack(r) for r in replies]
+
     def read(self, keys: List[bytes], read_version: int = READ_LATEST,
              timeout_ms: Optional[int] = None) -> Dict[bytes, bytes]:
         req = ReadRequest(read_version=read_version, keys=keys)

@@ -60,6 +60,26 @@ def run_workload(args) -> dict:
             k = rng.choice(keys)
             try:
                 if rng.random() < args.write_ratio:
+                    if args.batch > 1:
+                        # batched-workload mode: several independent
+                        # write transactions on one wire message
+                        # (ClientBatchRequestMsg)
+                        kvs_payload = []
+                        for j in range(args.batch):
+                            bk = rng.choice(keys)
+                            bv = b"%d-%d-%d-%d" % (w, i, j,
+                                                   rng.randrange(1 << 30))
+                            kvs_payload.append((bk, bv))
+                        t0 = time.monotonic()
+                        rs = kv.write_batch([[p] for p in kvs_payload],
+                                            timeout_ms=args.timeout_ms)
+                        lat.append(time.monotonic() - t0)
+                        with model_lock:
+                            for (bk, bv), r in zip(kvs_payload, rs):
+                                if r.success:
+                                    counts[w] += 1
+                                    model[bk] = bv
+                        continue
                     v = b"%d-%d-%d" % (w, i, rng.randrange(1 << 30))
                     t0 = time.monotonic()
                     r = kv.write([(k, v)], timeout_ms=args.timeout_ms)
@@ -113,6 +133,9 @@ def main() -> int:
     ap.add_argument("--ops", type=int, default=100)
     ap.add_argument("--concurrency", type=int, default=2)
     ap.add_argument("--keys", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=1,
+                    help=">1: each write op sends this many independent "
+                         "transactions as one ClientBatchRequestMsg")
     ap.add_argument("--write-ratio", type=float, default=0.6)
     ap.add_argument("--timeout-ms", type=int, default=8000)
     ap.add_argument("--workload-seed", type=int, default=0xC11E47)

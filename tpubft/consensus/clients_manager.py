@@ -25,13 +25,22 @@ from tpubft.consensus.messages import ClientBatchRequestMsg, ClientReplyMsg
 REPLY_CACHE_PER_CLIENT = 2 * ClientBatchRequestMsg.MAX_BATCH
 
 
+# in-flight (admitted, not yet executed) requests tracked per client.
+# Multiple pending seqs are first-class (reference ClientsManager
+# requestsInfo map, bounded by maxNumOfRequestsInBatch): a batch's 64
+# elements plus interleaved singles may all be in flight, and they can
+# ARRIVE out of seq order (a later-allocated single can beat a batch to
+# the primary), so membership — not ordering — is the dedup test.
+MAX_PENDING_PER_CLIENT = 2 * ClientBatchRequestMsg.MAX_BATCH
+
+
 @dataclass
 class _ClientInfo:
     last_executed_req: int = -1
     replies: "OrderedDict[int, ClientReplyMsg]" = field(
         default_factory=OrderedDict)
-    pending_req_seq: Optional[int] = None
-    pending_cid: str = ""
+    pending: "OrderedDict[int, str]" = field(
+        default_factory=OrderedDict)      # req_seq -> cid
 
 
 class ClientsManager:
@@ -49,17 +58,17 @@ class ClientsManager:
             return False
         if req_seq <= info.last_executed_req:
             return False                       # already executed (dup)
-        if info.pending_req_seq is not None and req_seq <= info.pending_req_seq:
+        if req_seq in info.pending:
             return False                       # already in flight
+        if len(info.pending) >= MAX_PENDING_PER_CLIENT:
+            return False                       # per-client flood bound
         return True
 
     def add_pending(self, client_id: int, req_seq: int, cid: str = "") -> None:
-        info = self._clients[client_id]
-        info.pending_req_seq = req_seq
-        info.pending_cid = cid
+        self._clients[client_id].pending[req_seq] = cid
 
     def has_pending(self, client_id: int) -> bool:
-        return self._clients[client_id].pending_req_seq is not None
+        return bool(self._clients[client_id].pending)
 
     # ---- execution results ----
     def on_request_executed(self, client_id: int, req_seq: int,
@@ -72,16 +81,17 @@ class ClientsManager:
         info.replies[req_seq] = reply
         while len(info.replies) > REPLY_CACHE_PER_CLIENT:
             info.replies.popitem(last=False)     # evict oldest
-        if info.pending_req_seq is not None and req_seq >= info.pending_req_seq:
-            info.pending_req_seq = None
-            info.pending_cid = ""
+        info.pending.pop(req_seq, None)
 
     def note_executed(self, client_id: int, req_seq: int) -> None:
         """Advance at-most-once state without a cached reply (oversize
         reply marker loaded from reserved pages)."""
         info = self._clients.get(client_id)
-        if info is not None and req_seq > info.last_executed_req:
+        if info is None:
+            return
+        if req_seq > info.last_executed_req:
             info.last_executed_req = req_seq
+        info.pending.pop(req_seq, None)
 
     def cached_reply(self, client_id: int,
                      req_seq: int) -> Optional[ClientReplyMsg]:
@@ -100,5 +110,4 @@ class ClientsManager:
         """View change: in-flight requests are abandoned; clients will
         retransmit and the new primary re-admits them."""
         for info in self._clients.values():
-            info.pending_req_seq = None
-            info.pending_cid = ""
+            info.pending.clear()

@@ -212,6 +212,7 @@ class Replica(IReceiver):
         self._vc_started_at = 0.0
         self._last_progress = time.monotonic()
         self._forwarded: Dict[tuple, float] = {}   # (client, req_seq) -> time
+        self._batch_relayed: Dict[tuple, float] = {}  # batch relay dedup
 
         # --- pipeline ---
         self.incoming = IncomingMsgsStorage()
@@ -361,9 +362,32 @@ class Replica(IReceiver):
     def _load_client_replies_from_pages(self) -> None:
         """Seed the at-most-once table + reply cache from reserved pages
         (reference: ClientsManager loadInfoFromReservedPages)."""
+        from tpubft.consensus.clients_manager import \
+            REPLY_CACHE_PER_CLIENT as _RING
         from tpubft.consensus.reserved_pages import ReservedPagesClient
         pages = ReservedPagesClient(self.res_pages, "clients")
+        ring = ReservedPagesClient(self.res_pages, "clientreplies")
+
+        def seed(client: int, raw: bytes) -> None:
+            try:
+                reply = m.unpack(raw[1:])
+            except m.MsgError:
+                return
+            if isinstance(reply, m.ClientReplyMsg):
+                # re-personalize the canonical page form
+                reply.sender_id = self.id
+                reply.current_primary = self.primary
+                self.clients.on_request_executed(client, reply.req_seq_num,
+                                                 reply)
+
         for c in self.info.all_client_ids():
+            # the reply ring first (recent batch elements) ...
+            for slot in range(_RING):
+                raw = ring.load(index=c * _RING + slot)
+                if raw and raw[:1] == b"\x00":
+                    seed(c, raw)
+            # ... then the newest-reply/at-most-once marker page, which
+            # also carries the authoritative last-executed watermark
             raw = pages.load(index=c)
             if not raw:
                 continue
@@ -372,15 +396,7 @@ class Replica(IReceiver):
                 self.clients.note_executed(c, int.from_bytes(raw[1:9],
                                                              "big"))
                 continue
-            try:
-                reply = m.unpack(raw[1:])
-            except m.MsgError:
-                continue
-            if isinstance(reply, m.ClientReplyMsg):
-                # re-personalize the canonical page form
-                reply.sender_id = self.id
-                reply.current_primary = self.primary
-                self.clients.on_request_executed(c, reply.req_seq_num, reply)
+            seed(c, raw)
 
     # ------------------------------------------------------------------
     # state transfer wiring (ReplicaForStateTransfer equivalent)
@@ -541,14 +557,28 @@ class Replica(IReceiver):
                         or inner.sender_id != msg.sender_id:
                     return          # element from a different principal
                 inners.append(inner)
-            # backup: relay the BATCH once (one wire message — exploding
-            # it into per-element forwards would defeat the transport
+            # backup: relay the BATCH as one wire message (exploding it
+            # into per-element forwards would defeat the transport
             # amortization); elements below run with relay suppressed
-            # and still arm the liveness clock individually post-verify
-            if not self.is_primary and not self.in_view_change \
-                    and any((msg.sender_id, i.req_seq_num)
-                            not in self._forwarded for i in inners):
-                self.comm.send(self.primary, msg.pack())
+            # and still arm the liveness clock individually post-verify.
+            # Retransmissions re-relay at most once per suppression
+            # window — _forwarded can't dedup here (entries appear only
+            # post-verify and are popped at execution, so a client
+            # retrying lost replies would otherwise trigger an
+            # (n-1)x-amplified re-relay of the largest message type on
+            # every retry).
+            if not self.is_primary and not self.in_view_change:
+                now = time.monotonic()
+                key = (msg.sender_id, inners[-1].req_seq_num)
+                last = self._batch_relayed.get(key)
+                if last is None or now - last > 1.0:
+                    self._batch_relayed[key] = now
+                    if len(self._batch_relayed) > 1024:
+                        cutoff = now - 5.0
+                        self._batch_relayed = {
+                            k: t for k, t in self._batch_relayed.items()
+                            if t > cutoff}
+                    self.comm.send(self.primary, msg.pack())
             for inner in inners:
                 self._on_client_request(inner, relay=False)
             return
@@ -1453,6 +1483,18 @@ class Replica(IReceiver):
             # crash/ST never re-executes, even though the cached reply is
             # lost (the client re-reads; reference paginates large replies)
             canonical = b"\x01" + req_seq.to_bytes(8, "big")
+        else:
+            # reply RING: a slot per req_seq mod window, so every element
+            # of a recently-executed batch stays regenerable across
+            # crash/ST — not just the newest reply (the in-memory cache's
+            # persistence mirror; reference keeps per-request reply slots
+            # in reserved pages). Slot math is deterministic, so pages
+            # stay digest-identical across replicas.
+            from tpubft.consensus.clients_manager import \
+                REPLY_CACHE_PER_CLIENT as _RING
+            self.res_pages.save("clientreplies",
+                                client * _RING + req_seq % _RING,
+                                canonical)
         self.res_pages.save("clients", client, canonical)
         if not self.info.is_internal_client(client):
             self.comm.send(client, reply.pack())

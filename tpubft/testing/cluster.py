@@ -39,6 +39,7 @@ class InProcessCluster:
         self.handlers: Dict[int, IRequestsHandler] = {}
         self.replicas: Dict[int, Replica] = {}
         self.storage_factory = storage_factory
+        self._pages_dbs: Dict[int, object] = {}
         self._cfg_overrides = cfg_overrides or {}
         self._num_clients = num_clients
         self.f, self.c = f, c
@@ -57,8 +58,17 @@ class InProcessCluster:
             handler = self.handler_factory()
         self.handlers[r] = handler
         storage = (self.storage_factory(r) if self.storage_factory else None)
+        # reserved pages survive an in-process restart (deployed replicas
+        # keep them in the ledger db): restart/crash tests must exercise
+        # the page reload paths, not silently start from empty pages
+        pages = self._pages_dbs.get(r)
+        if pages is None:
+            from tpubft.consensus.reserved_pages import ReservedPages
+            from tpubft.storage.memorydb import MemoryDB
+            pages = self._pages_dbs[r] = ReservedPages(MemoryDB())
         rep = Replica(cfg, self.keys.for_node(r), self.bus.create(r),
-                      handler, storage=storage, aggregator=agg)
+                      handler, storage=storage, aggregator=agg,
+                      reserved_pages=pages)
         # KVBC-backed handlers get a state-transfer manager, mirroring
         # KvbcReplica wiring (handlers expose .blockchain for this)
         bc = getattr(handler, "blockchain", None)
