@@ -164,3 +164,25 @@ def test_batch_replies_survive_replica_restart():
             assert cached is not None, f"reply for seq {s} lost on restart"
         assert counter.decode_reply(rep.clients.cached_reply(
             c.cfg.client_id, seqs[-1]).reply) == 6
+
+
+def test_batch_composes_with_pre_execution():
+    """PRE_PROCESS elements inside a ClientBatchRequestMsg each flow
+    through the pre-execution plane (reference groups these with
+    PreProcessBatchRequestMsg; here each element runs its own session)."""
+    from tpubft.apps import skvbc
+    from tpubft.kvbc import KeyValueBlockchain
+    from tpubft.storage import MemoryDB
+
+    def hf(_r=None):
+        return skvbc.SkvbcHandler(KeyValueBlockchain(MemoryDB()))
+
+    with InProcessCluster(f=1, num_clients=1, handler_factory=hf,
+                          cfg_overrides={"crypto_backend": "cpu",
+                                         "pre_execution_enabled": True}) as cl:
+        kv = skvbc.SkvbcClient(cl.client(0))
+        rs = kv.write_batch([[(b"pa", b"1")], [(b"pb", b"2")]],
+                            timeout_ms=20000, pre_process=True)
+        assert all(r.success for r in rs)
+        got = kv.read([b"pa", b"pb"], timeout_ms=20000)
+        assert got == {b"pa": b"1", b"pb": b"2"}

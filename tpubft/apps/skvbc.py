@@ -238,13 +238,16 @@ class SkvbcClient:
         return unpack(reply)
 
     def write_batch(self, writes: List[List[Tuple[bytes, bytes]]],
-                    timeout_ms: Optional[int] = None) -> List[WriteReply]:
+                    timeout_ms: Optional[int] = None,
+                    pre_process: bool = False) -> List[WriteReply]:
         """Several independent write transactions in ONE wire message
         (BftClient.send_write_batch / ClientBatchRequestMsg); each
-        element orders and replies separately."""
+        element orders and replies separately. pre_process routes every
+        element through the pre-execution plane."""
         reqs = [pack(WriteRequest(read_version=0, readset=[], writeset=ws))
                 for ws in writes]
-        replies = self._client.send_write_batch(reqs, timeout_ms=timeout_ms)
+        replies = self._client.send_write_batch(reqs, timeout_ms=timeout_ms,
+                                                pre_process=pre_process)
         return [unpack(r) for r in replies]
 
     def read(self, keys: List[bytes], read_version: int = READ_LATEST,

@@ -126,7 +126,8 @@ class BftClient(IReceiver):
 
     def send_write_batch(self, requests: List[bytes],
                          quorum: Quorum = Quorum.LINEARIZABLE,
-                         timeout_ms: Optional[int] = None) -> List[bytes]:
+                         timeout_ms: Optional[int] = None,
+                         pre_process: bool = False) -> List[bytes]:
         """Several writes in ONE wire message (reference preprocessor
         ClientBatchRequestMsg): each element is its own individually
         signed ClientRequestMsg with its own req_seq/quorum tracking;
@@ -156,8 +157,10 @@ class BftClient(IReceiver):
             span = get_tracer().start_span("client_send_batch")
             span.set_tag("client", self.cfg.client_id) \
                 .set_tag("count", len(requests))
+            flags = (int(m.RequestFlag.PRE_PROCESS)
+                     if pre_process else 0)
             with self._lock:
-                reqs = [self._new_request_locked(payload, 0,
+                reqs = [self._new_request_locked(payload, flags,
                                                  span.context.serialize(),
                                                  quorum)
                         for payload in requests]
