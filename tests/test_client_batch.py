@@ -158,6 +158,14 @@ def test_batch_replies_survive_replica_restart():
         assert [counter.decode_reply(r) for r in replies] == [1, 3, 6]
         last_seq = c._req_seq
         seqs = [last_seq - 2, last_seq - 1, last_seq]
+        # the client quorum (3 of 4) may exclude replica 2 — wait for it
+        # to execute the whole batch before restarting it, so the restart
+        # genuinely tests page reload (not an un-executed replica)
+        import time
+        deadline = time.time() + 20
+        while time.time() < deadline \
+                and (cl.metric(2, "counters", "executed_requests") or 0) < 3:
+            time.sleep(0.02)
         rep = cl.restart(2)
         for s in seqs:
             cached = rep.clients.cached_reply(c.cfg.client_id, s)

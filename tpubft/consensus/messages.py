@@ -52,6 +52,8 @@ class MsgCode(enum.IntEnum):
     PreProcessReply = 22
     ReqViewPrePrepare = 23
     ClientBatchRequest = 24
+    PreProcessBatchRequest = 25
+    PreProcessBatchReply = 26
 
 
 class RequestFlag(enum.IntFlag):
@@ -390,6 +392,48 @@ class PreProcessRequestMsg(ConsensusMsg):
     SPEC = [("sender_id", "u32"), ("client_id", "u32"),
             ("req_seq_num", "u64"), ("retry_id", "u64"),
             ("request", "bytes")]
+
+
+@register
+@dataclass
+class PreProcessBatchRequestMsg(ConsensusMsg):
+    """Primary → all replicas: a GROUP of PreProcessRequestMsgs for one
+    client, one wire message (reference PreProcessBatchRequestMsg.hpp —
+    the wire-level half of client batching: per-element sessions,
+    grouped transport)."""
+    CODE = MsgCode.PreProcessBatchRequest
+    sender_id: int              # the primary
+    client_id: int
+    batch_id: int               # primary-local group id for reply folding
+    requests: list              # packed PreProcessRequestMsg frames
+    SPEC = [("sender_id", "u32"), ("client_id", "u32"),
+            ("batch_id", "u64"), ("requests", ("list", "bytes"))]
+
+    def validate(self) -> None:
+        if not self.requests:
+            raise MsgError("empty preprocess batch")
+        if len(self.requests) > ClientBatchRequestMsg.MAX_BATCH:
+            raise MsgError("preprocess batch too large")
+
+
+@register
+@dataclass
+class PreProcessBatchReplyMsg(ConsensusMsg):
+    """Replica → primary: all of a batch's speculative-result replies
+    folded into one wire message (reference PreProcessBatchReplyMsg.hpp)."""
+    CODE = MsgCode.PreProcessBatchReply
+    sender_id: int
+    client_id: int
+    batch_id: int
+    replies: list               # packed PreProcessReplyMsg frames
+    SPEC = [("sender_id", "u32"), ("client_id", "u32"),
+            ("batch_id", "u64"), ("replies", ("list", "bytes"))]
+
+    def validate(self) -> None:
+        if not self.replies:
+            raise MsgError("empty preprocess batch reply")
+        if len(self.replies) > ClientBatchRequestMsg.MAX_BATCH:
+            raise MsgError("preprocess batch reply too large")
 
 
 @register

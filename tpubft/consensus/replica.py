@@ -646,6 +646,14 @@ class Replica(IReceiver):
             if self.preprocessor and self.info.is_replica(sender):
                 self.preprocessor.on_preprocess_reply(sender, msg)
             return
+        if isinstance(msg, m.PreProcessBatchRequestMsg):
+            if self.preprocessor and self.info.is_replica(sender):
+                self.preprocessor.on_preprocess_batch_request(sender, msg)
+            return
+        if isinstance(msg, m.PreProcessBatchReplyMsg):
+            if self.preprocessor and self.info.is_replica(sender):
+                self.preprocessor.on_preprocess_batch_reply(sender, msg)
+            return
         if isinstance(msg, m.PrePrepareMsg) and self._pending_entry \
                 and self._try_resolve_body(msg):
             return                  # old-view body answering our fetch

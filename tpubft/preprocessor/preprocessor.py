@@ -39,6 +39,15 @@ class PreProcessor:
         # PreProcessReplyMsg — rebroadcasts must not re-execute the app
         self._reply_cache: Dict[Tuple[int, int, int], bytes] = {}
         self._retry_counter = 0
+        # primary-side broadcast micro-batching: sessions created while
+        # one external message is being handled (e.g. the elements of a
+        # ClientBatchRequestMsg) group into ONE PreProcessBatchRequestMsg
+        # per client, flushed via the internal queue (which drains only
+        # after the current external message completes)
+        self._pending_broadcast: list = []
+        self._batch_counter = 0
+        # backup-side reply folding: (primary, batch_id) -> group state
+        self._reply_groups: Dict[Tuple[int, int], dict] = {}
         replica.dispatcher.register_internal("preexec", self._on_internal)
         replica.dispatcher.add_timer(1.0, self._expire_sessions)
 
@@ -71,19 +80,54 @@ class PreProcessor:
                         started=time.monotonic(),
                         last_broadcast=time.monotonic())
         self._sessions[key] = sess
-        self._broadcast_request(sess)
+        # defer the broadcast to the flush point: sessions created while
+        # this dispatcher turn runs (a client batch admits its elements
+        # in one loop) ship as ONE grouped wire message per client
+        if not self._pending_broadcast:
+            self.replica.incoming.push_internal(
+                "preexec", ("flush", None, 0, False, None, None))
+        self._pending_broadcast.append(sess)
         self._launch(req, sess.retry_id, primary=True)
 
-    def _broadcast_request(self, sess: _Session) -> None:
-        ppr = m.PreProcessRequestMsg(
+    def _packed_request(self, sess: _Session) -> bytes:
+        return m.PreProcessRequestMsg(
             sender_id=self.replica.id, client_id=sess.original.sender_id,
             req_seq_num=sess.original.req_seq_num, retry_id=sess.retry_id,
-            request=sess.original.pack())
+            request=sess.original.pack()).pack()
+
+    def _broadcast_request(self, sess: _Session) -> None:
+        raw = self._packed_request(sess)
         for r in self.replica.info.other_replicas(self.replica.id):
-            self.replica.comm.send(r, ppr.pack())
+            self.replica.comm.send(r, raw)
+
+    def _flush_broadcasts(self) -> None:
+        """Group pending sessions per client into PreProcessBatchRequestMsg
+        (singletons go out as plain PreProcessRequestMsg)."""
+        pending, self._pending_broadcast = self._pending_broadcast, []
+        by_client: Dict[int, list] = {}
+        for sess in pending:
+            if sess.done:
+                continue
+            by_client.setdefault(sess.original.sender_id, []).append(sess)
+        cap = m.ClientBatchRequestMsg.MAX_BATCH
+        for client, group in by_client.items():
+            if len(group) == 1:
+                self._broadcast_request(group[0])
+                continue
+            for i in range(0, len(group), cap):
+                chunk = group[i:i + cap]
+                self._batch_counter += 1
+                msg = m.PreProcessBatchRequestMsg(
+                    sender_id=self.replica.id, client_id=client,
+                    batch_id=self._batch_counter,
+                    requests=[self._packed_request(s) for s in chunk])
+                raw = msg.pack()
+                for r in self.replica.info.other_replicas(self.replica.id):
+                    self.replica.comm.send(r, raw)
 
     def _launch(self, req: m.ClientRequestMsg, retry_id: int,
-                primary: bool, reply_to: Optional[int] = None) -> None:
+                primary: bool, reply_to: Optional[int] = None,
+                group: Optional[Tuple[int, int]] = None) -> None:
         """Run handler.pre_execute on the pool; result re-enters the
         dispatcher as an internal msg (launchAsyncReqPreProcessingJob)."""
         handler = self.replica.handler
@@ -96,11 +140,15 @@ class PreProcessor:
                 result = None
             self.replica.incoming.push_internal(
                 "preexec", ("done", req, retry_id, primary, reply_to,
-                            result))
+                            result, group))
         self._pool.submit(job)
 
     def _on_internal(self, item) -> None:
-        kind, req, retry_id, primary, reply_to, result = item
+        kind, req, retry_id, primary, reply_to, result = item[:6]
+        group = item[6] if len(item) > 6 else None
+        if kind == "flush":
+            self._flush_broadcasts()
+            return
         key = (req.sender_id, req.req_seq_num)
         if primary:
             sess = self._sessions.get(key)
@@ -134,11 +182,53 @@ class PreProcessor:
             self._reply_cache[(key[0], key[1], retry_id)] = raw
             if len(self._reply_cache) > 512:
                 self._reply_cache.pop(next(iter(self._reply_cache)))
-            self.replica.comm.send(reply_to, raw)
+            if group is not None:
+                self._fold_group_reply(group, raw, reply_to)
+            else:
+                self.replica.comm.send(reply_to, raw)
+
+    def _send_group_reply(self, batch_id: int, st: dict) -> None:
+        msg = m.PreProcessBatchReplyMsg(
+            sender_id=self.replica.id, client_id=st["client"],
+            batch_id=batch_id, replies=st["got"])
+        self.replica.comm.send(st["reply_to"], msg.pack())
+
+    def _fold_group_reply(self, group: Tuple[int, int], raw_reply: bytes,
+                          reply_to: Optional[int]) -> None:
+        """Collect a batch element's reply; when the whole group is in,
+        send ONE PreProcessBatchReplyMsg to the primary."""
+        st = self._reply_groups.get(group)
+        if st is None:
+            # group expired (a slow sibling element) — the reply is still
+            # wanted: fall back to a direct single send so the primary's
+            # session can complete its quorum
+            if reply_to is not None:
+                self.replica.comm.send(reply_to, raw_reply)
+            return
+        st["got"].append(raw_reply)
+        if len(st["got"]) >= st["expect"]:
+            del self._reply_groups[group]
+            self._send_group_reply(group[1], st)
 
     # ------------------------------------------------------------------
     # backup side
     # ------------------------------------------------------------------
+    def _element_request(self, msg: m.PreProcessRequestMsg
+                         ) -> Optional[m.ClientRequestMsg]:
+        """Shared element validation for single + batched requests."""
+        try:
+            req = m.unpack(msg.request)
+        except m.MsgError:
+            return None
+        if not isinstance(req, m.ClientRequestMsg) \
+                or req.sender_id != msg.client_id \
+                or req.req_seq_num != msg.req_seq_num:
+            return None
+        if not self.replica.sig.verify(req.sender_id, req.signed_payload(),
+                                       req.signature):
+            return None
+        return req
+
     def on_preprocess_request(self, sender: int,
                               msg: m.PreProcessRequestMsg) -> None:
         if sender != self.replica.primary:
@@ -148,18 +238,70 @@ class PreProcessor:
         if cached is not None:
             self.replica.comm.send(sender, cached)
             return
-        try:
-            req = m.unpack(msg.request)
-        except m.MsgError:
-            return
-        if not isinstance(req, m.ClientRequestMsg) \
-                or req.sender_id != msg.client_id \
-                or req.req_seq_num != msg.req_seq_num:
-            return
-        if not self.replica.sig.verify(req.sender_id, req.signed_payload(),
-                                       req.signature):
+        req = self._element_request(msg)
+        if req is None:
             return
         self._launch(req, msg.retry_id, primary=False, reply_to=sender)
+
+    def on_preprocess_batch_request(self, sender: int,
+                                    msg: m.PreProcessBatchRequestMsg) -> None:
+        """A grouped preprocess request: launch every valid element, fold
+        all replies into one PreProcessBatchReplyMsg (reference
+        PreProcessBatchRequestMsg handling)."""
+        if sender != self.replica.primary:
+            return
+        elements = []
+        for raw in msg.requests:
+            try:
+                ppr = m.unpack(raw)
+            except m.MsgError:
+                return
+            if not isinstance(ppr, m.PreProcessRequestMsg) \
+                    or ppr.client_id != msg.client_id:
+                return                  # malformed group: drop whole
+            elements.append(ppr)
+        group = (sender, msg.batch_id)
+        if group in self._reply_groups:
+            return                      # duplicate batch delivery
+        cached_raws, todo = [], []
+        for ppr in elements:
+            cached = self._reply_cache.get((ppr.client_id, ppr.req_seq_num,
+                                            ppr.retry_id))
+            if cached is not None:
+                cached_raws.append(cached)
+                continue
+            req = self._element_request(ppr)
+            if req is not None:
+                todo.append((req, ppr.retry_id))
+            # invalid elements simply produce no reply: the primary's
+            # per-element session rebroadcast covers the gap
+        if not cached_raws and not todo:
+            return
+        st = {"expect": len(cached_raws) + len(todo),
+              "got": list(cached_raws), "reply_to": sender,
+              "client": msg.client_id, "started": time.monotonic()}
+        if not todo:
+            # everything cached: fold-and-send immediately
+            self._send_group_reply(msg.batch_id, st)
+            return
+        self._reply_groups[group] = st
+        for req, retry_id in todo:
+            self._launch(req, retry_id, primary=False, reply_to=sender,
+                         group=group)
+
+    def on_preprocess_batch_reply(self, sender: int,
+                                  msg: m.PreProcessBatchReplyMsg) -> None:
+        """Primary unfolds a grouped reply into per-element handling."""
+        for raw in msg.replies:
+            try:
+                rep = m.unpack(raw)
+            except m.MsgError:
+                return
+            if not isinstance(rep, m.PreProcessReplyMsg) \
+                    or rep.sender_id != sender \
+                    or rep.client_id != msg.client_id:
+                return
+            self.on_preprocess_reply(sender, rep)
 
     def on_preprocess_reply(self, sender: int,
                             msg: m.PreProcessReplyMsg) -> None:
@@ -208,6 +350,14 @@ class PreProcessor:
         for key in [k for k, s in self._sessions.items()
                     if now - s.started > self.SESSION_TIMEOUT_S]:
             del self._sessions[key]
+        # a reply group whose elements never all complete (handler wedge)
+        # must not leak — and its partial replies are still useful, so
+        # flush what arrived before dropping
+        for g in [g for g, st in self._reply_groups.items()
+                  if now - st["started"] > self.SESSION_TIMEOUT_S]:
+            st = self._reply_groups.pop(g)
+            if st["got"]:
+                self._send_group_reply(g[1], st)
 
 
 def validate_preprocessed_request(replica, req: m.ClientRequestMsg) -> bool:

@@ -123,7 +123,9 @@ class BftTestNetwork:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
-    def start_all(self, timeout: float = 30.0) -> "BftTestNetwork":
+    def start_all(self, timeout: float = 60.0) -> "BftTestNetwork":
+        # 60s: each replica process pays a contended jax import (~10-20s
+        # when the 1-core host is busy); 30s flaked under load
         try:
             for r in range(self.n):
                 self.start_replica(r)
