@@ -137,3 +137,41 @@ def test_ro_replica_archives_and_serves_reads():
                                      "served_reads") == 1
         finally:
             ro.stop()
+
+
+@pytest.mark.slow
+def test_late_joining_ro_replica_polls_for_checkpoint():
+    """An RO replica started AFTER the cluster's last checkpoint
+    broadcast must still anchor: it polls with AskForCheckpointMsg
+    (reference ReadOnlyReplica sendAskForCheckpointMsg timer) and the
+    replicas resend their latest self checkpoints."""
+    overrides = dict(checkpoint_window_size=5, work_window_size=10,
+                     num_ro_replicas=1, fast_path_timeout_ms=150)
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                          cfg_overrides=overrides) as cluster:
+        client = cluster.client(0)
+        client.start()
+        kv = skvbc.SkvbcClient(client)
+        for i in range(7):                  # crosses checkpoint 5
+            assert kv.write([(f"k{i}".encode(), f"v{i}".encode())],
+                            timeout_ms=8000).success
+        # cluster idle now — its checkpoint broadcasts are history.
+        # A LATE-JOINING RO replica can only anchor by asking.
+        ro_id = cluster.n
+        ro_cfg = ReplicaConfig(replica_id=ro_id, f_val=1,
+                               num_of_client_proxies=2, **overrides)
+        ro = ReadOnlyReplica(ro_cfg, cluster.keys.for_node(ro_id),
+                             cluster.bus.create(ro_id),
+                             st_cfg=StConfig(retry_timeout_s=0.3))
+        ro.ASK_CHECKPOINT_PERIOD_S = 0.5    # fast poll for the test
+        ro.start()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if ro.blockchain.last_block_id >= 5:
+                    break
+                time.sleep(0.1)
+            assert ro.last_anchor >= 5, "late RO never anchored via poll"
+            assert ro.blockchain.last_block_id >= 5, "late RO never fetched"
+        finally:
+            ro.stop()

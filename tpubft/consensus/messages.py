@@ -54,6 +54,7 @@ class MsgCode(enum.IntEnum):
     ClientBatchRequest = 24
     PreProcessBatchRequest = 25
     PreProcessBatchReply = 26
+    AskForCheckpoint = 27
 
 
 class RequestFlag(enum.IntFlag):
@@ -343,6 +344,19 @@ class FullCommitProofMsg(_SignedShareBase):
 
 
 # ---------------- checkpointing ----------------
+
+@register
+@dataclass
+class AskForCheckpointMsg(ConsensusMsg):
+    """Any node → a replica: please (re)send your latest self
+    CheckpointMsg (reference AskForCheckpointMsg.hpp — sent periodically
+    by read-only replicas so a late joiner doesn't wait a whole
+    checkpoint window for the next broadcast). Unsigned: the reply is
+    bounded, already-signed traffic."""
+    CODE = MsgCode.AskForCheckpoint
+    sender_id: int
+    SPEC = [("sender_id", "u32")]
+
 
 @register
 @dataclass

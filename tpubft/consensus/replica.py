@@ -213,6 +213,8 @@ class Replica(IReceiver):
         self._last_progress = time.monotonic()
         self._forwarded: Dict[tuple, float] = {}   # (client, req_seq) -> time
         self._batch_relayed: Dict[tuple, float] = {}  # batch relay dedup
+        self._ck_asked: Dict[int, float] = {}      # AskForCheckpoint rate
+        self._self_ck_latest: Optional[m.CheckpointMsg] = None
 
         # --- pipeline ---
         self.incoming = IncomingMsgsStorage()
@@ -653,6 +655,21 @@ class Replica(IReceiver):
         if isinstance(msg, m.PreProcessBatchReplyMsg):
             if self.preprocessor and self.info.is_replica(sender):
                 self.preprocessor.on_preprocess_batch_reply(sender, msg)
+            return
+        if isinstance(msg, m.AskForCheckpointMsg):
+            # reference ReplicaImp::onMessage<AskForCheckpointMsg>: resend
+            # our latest self checkpoint to the asker (RO replicas poll
+            # this so a late joiner doesn't wait a whole window).
+            # Rate-bounded per asker: unsigned request, bounded reply.
+            if not (self.info.is_replica(sender)
+                    or sender in self.info.ro_replica_ids):
+                return
+            now = time.monotonic()
+            if now - self._ck_asked.get(sender, 0.0) < 2.0:
+                return
+            self._ck_asked[sender] = now
+            if self._self_ck_latest is not None:
+                self.comm.send(sender, self._self_ck_latest.pack())
             return
         if isinstance(msg, m.PrePrepareMsg) and self._pending_entry \
                 and self._try_resolve_body(msg):
@@ -1747,6 +1764,11 @@ class Replica(IReceiver):
         self._ck_latest_seq[ck.sender_id] = ck.seq_num
         slot = self.checkpoints.setdefault(ck.seq_num, {})
         slot[ck.sender_id] = ck
+        if ck.sender_id == self.id:
+            # retained past stability GC: AskForCheckpoint answers with
+            # this (reference checkpointsLog keeps the last stable's
+            # selfCheckpointMsg)
+            self._self_ck_latest = ck
         matching = sum(1 for other in slot.values()
                        if other.state_digest == ck.state_digest
                        and other.res_pages_digest == ck.res_pages_digest)

@@ -107,6 +107,8 @@ class ReadOnlyReplica(IReceiver):
         self._ck_sender_latest: Dict[int, int] = {}
         self._certified: Dict[int, Tuple[bytes, bytes]] = {}
         self.last_anchor = 0
+        self._last_anchor_time = 0.0     # monotonic time of last anchor
+        self._last_ask = 0.0
 
         self.incoming = IncomingMsgsStorage()
         self.dispatcher = Dispatcher(self.incoming,
@@ -187,6 +189,7 @@ class ReadOnlyReplica(IReceiver):
         if len(voters) < self.info.st_anchor_quorum:
             return
         self.last_anchor = ck.seq_num
+        self._last_anchor_time = time.monotonic()
         self.m_anchor.set(ck.seq_num)
         self._certified[ck.seq_num] = pair
         if len(self._certified) > 32:
@@ -267,9 +270,23 @@ class ReadOnlyReplica(IReceiver):
         self.m_archived.set(self.archived_to)
 
     # ---- periodic ----
+    ASK_CHECKPOINT_PERIOD_S = 10.0
+
     def _tick(self) -> None:
-        if self._running:
-            self.state_transfer.tick()
+        if not self._running:
+            return
+        self.state_transfer.tick()
+        # poll for checkpoints when anchors aren't arriving on their own
+        # (reference ReadOnlyReplica sends AskForCheckpointMsg on a
+        # timer): a late joiner must not wait a whole checkpoint window
+        # for the cluster's next broadcast
+        now = time.monotonic()
+        if now - self._last_anchor_time > self.ASK_CHECKPOINT_PERIOD_S \
+                and now - self._last_ask > self.ASK_CHECKPOINT_PERIOD_S:
+            self._last_ask = now
+            ask = m.AskForCheckpointMsg(sender_id=self.id).pack()
+            for r in range(self.info.n):
+                self.comm.send(r, ask)
 
     # ---- audit helper (reference object_store integrity check tool) ----
     def verify_archive(self) -> Tuple[int, int]:
