@@ -46,7 +46,12 @@ class LoopbackBus:
         self._hooks.append(hook)
 
     def post(self, sender: NodeNum, dest: NodeNum, data: bytes) -> None:
-        self._ensure_thread()
+        # lock-free fast path: post() runs for EVERY message in the
+        # cluster, and the bus lock here was a measurable global hot spot
+        # under load; the lock is only taken when the pump looks dead
+        t = self._thread
+        if t is None or not t.is_alive():
+            self._ensure_thread()
         self._q.put((sender, dest, data))
 
     def _ensure_thread(self) -> None:
