@@ -403,3 +403,32 @@ def test_split_brain_partition_cannot_commit_then_heals(tmp_path):
             ok = _commit(kv, b"m1", b"v", timeout_ms=10000, tries=1)
         assert ok, "cluster never recovered after partition heal"
         assert kv.read([b"m0", b"m1"]) == {b"m0": b"v", b"m1": b"v"}
+
+
+def test_client_batch_under_loss_recovers_via_reply_ring(tmp_path):
+    """Client BATCHES under 25% uniform loss: lost replies force batch
+    retransmissions, and the per-request reply ring (multi-entry cache +
+    reserved-pages persistence) must regenerate EVERY element's reply —
+    the single-slot cache this round replaced could only serve the
+    newest one, stranding earlier elements forever."""
+    with BftTestNetwork(f=1, db_dir=str(tmp_path)) as net:
+        kv = net.skvbc_client(0)
+        assert kv.write([(b"warm", b"w")], timeout_ms=30000).success
+        for r in range(net.n):
+            net.set_loss(r, 0.25)
+        done = 0
+        deadline = time.monotonic() + 90
+        while done < 3 and time.monotonic() < deadline:
+            try:
+                rs = kv.write_batch(
+                    [[(b"lb-%d-%d" % (done, j), b"v")] for j in range(8)],
+                    timeout_ms=20000)
+            except Exception:   # noqa: BLE001 — lossy: retry the batch
+                continue
+            if all(r.success for r in rs):
+                done += 1
+        for r in range(net.n):
+            net.heal(r)
+        assert done == 3, "batches never fully recovered under loss"
+        got = kv.read([b"lb-2-%d" % j for j in range(8)], timeout_ms=20000)
+        assert len(got) == 8
