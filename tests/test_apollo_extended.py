@@ -242,6 +242,16 @@ def test_tester_client_workload_binary(tmp_path):
         assert out.returncode == 0, out.stderr[-1500:]
         summary = json.loads(out.stdout.strip().splitlines()[-1])
         assert summary["ok"] and summary["ops_ok"] >= 20, summary
+        # batched-workload mode: writes ride ClientBatchRequestMsg
+        out = subprocess.run(
+            [sys.executable, "-m", "tpubft.apps.tester_client",
+             "--f", "1", "--base-port", str(net.base_port),
+             "--ops", "16", "--concurrency", "2", "--client-idx", "1",
+             "--batch", "4"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr[-1500:]
+        summary = json.loads(out.stdout.strip().splitlines()[-1])
+        assert summary["ok"] and summary["ops_ok"] >= 16, summary
 
 
 def test_cre_client_observes_wedge(tmp_path):
