@@ -42,6 +42,11 @@ def test_client_pool_concurrent_writes():
         # identities returned to the pool: next write succeeds
         assert counter.decode_reply(
             pool.write(counter.encode_add(1))) == 4
+        # batched submission: one identity, one wire message, N replies
+        rs = pool.submit_write_batch(
+            [counter.encode_add(2), counter.encode_add(3)]).result(
+                timeout=10)
+        assert [counter.decode_reply(r) for r in rs] == [6, 9]
 
 
 @pytest.mark.slow

@@ -57,6 +57,27 @@ class ClientPool:
         return self.submit_write(request, timeout_ms=timeout_ms,
                                  pre_process=pre_process).result()
 
+    def submit_write_batch(self, requests: List[bytes],
+                           timeout_ms: Optional[int] = None,
+                           pre_process: bool = False) -> Future:
+        """Async BATCH through the next free identity — one wire message
+        carrying every element (ClientBatchRequestMsg); the gateway-side
+        analog of the reference pool's client batching flag
+        (concord_client_pool batching configuration)."""
+        try:
+            client = self._clients.get_nowait()
+        except queue.Empty:
+            raise ClientPoolBusy("all pool clients in flight") from None
+
+        def run():
+            try:
+                return client.send_write_batch(requests,
+                                               timeout_ms=timeout_ms,
+                                               pre_process=pre_process)
+            finally:
+                self._clients.put(client)
+        return self._pool.submit(run)
+
     def read(self, request: bytes,
              timeout_ms: Optional[int] = None) -> bytes:
         """Read through a checked-out identity (same discipline as
