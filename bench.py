@@ -115,7 +115,13 @@ def main() -> None:
     candidates = {}
     on_accelerator = (use_default_platform
                       and jax.devices()[0].platform != "cpu")
-    if on_accelerator:
+    if on_accelerator and os.environ.get("TPUBFT_SKIP_PALLAS"):
+        # the capture daemon sets this when the bounded bring-up ladder
+        # failed or HUNG — a wedged Mosaic compile must not eat the
+        # device window that the XLA kernel could use
+        print("bench: pallas-fused kernel skipped (TPUBFT_SKIP_PALLAS)",
+              file=sys.stderr)
+    elif on_accelerator:
         # the Mosaic kernel only compiles on real TPU hardware
         try:
             from tpubft.ops import ed25519_pallas as opsp

@@ -27,7 +27,17 @@ EOF
 }
 
 bench_step() {
-  TPUBFT_BENCH_DEVICE_WAIT_S=0 timeout 1800 python bench.py \
+  # bounded Mosaic bring-up first: a WEDGED compile of the fused kernel
+  # must cost one 900s probe, not the whole window — on failure/hang the
+  # bench still captures the XLA kernel number
+  local skip_pallas=""
+  if ! timeout 900 python -m tools.pallas_bringup --rung 5 \
+      > "$OUT/bringup.log" 2>&1; then
+    log "bringup rung5 failed/hung (rc=$?): bench will skip pallas"
+    skip_pallas=1
+  fi
+  TPUBFT_SKIP_PALLAS=$skip_pallas TPUBFT_BENCH_DEVICE_WAIT_S=0 \
+    timeout 1800 python bench.py \
     > "$OUT/bench.json" 2> "$OUT/bench.err"
   local rc=$?
   log "bench rc=$rc $(tail -c 300 "$OUT/bench.json")"
