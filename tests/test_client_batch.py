@@ -194,3 +194,40 @@ def test_batch_composes_with_pre_execution():
         assert all(r.success for r in rs)
         got = kv.read([b"pa", b"pb"], timeout_ms=20000)
         assert got == {b"pa": b"1", b"pb": b"2"}
+
+
+def test_ask_for_checkpoint_reply_and_rate_limit():
+    """A replica answers AskForCheckpoint with its retained self
+    checkpoint, at most once per rate window per asker."""
+    import time as _t
+    with InProcessCluster(f=1, num_clients=1,
+                          cfg_overrides={"crypto_backend": "cpu",
+                                         "checkpoint_window_size": 5,
+                                         "num_ro_replicas": 1}) as cl:
+        c = cl.client(0)
+        for i in range(6):                       # cross checkpoint 5
+            counter.decode_reply(c.send_write(counter.encode_add(1)))
+        rep = cl.replicas[1]
+        deadline = _t.time() + 10
+        while _t.time() < deadline and rep._self_ck_latest is None:
+            _t.sleep(0.05)
+        assert rep._self_ck_latest is not None
+        sent = []
+        orig = rep.comm.send
+        rep.comm.send = lambda d, raw: (sent.append((d, raw)), orig(d, raw))
+        ro_id = cl.n                             # the RO principal asks
+        ask = m.AskForCheckpointMsg(sender_id=ro_id)
+        rep.incoming.push_external(ro_id, ask.pack())
+        rep.incoming.push_external(ro_id, ask.pack())   # within window
+        deadline = _t.time() + 5
+        while _t.time() < deadline and not sent:
+            _t.sleep(0.05)
+        _t.sleep(0.3)                            # drain the duplicate
+        cks = [1 for d, raw in sent
+               if d == ro_id and isinstance(m.unpack(raw), m.CheckpointMsg)]
+        assert len(cks) == 1, f"rate limit broken: {len(cks)} replies"
+        # an unknown principal gets nothing
+        sent.clear()
+        rep.incoming.push_external(9999, ask.pack())
+        _t.sleep(0.3)
+        assert not [1 for d, _ in sent if d == 9999]
