@@ -211,3 +211,17 @@ def test_tpu_backend_rejects_forged_client_request():
         cluster.replicas[0].on_new_message(cl.cfg.client_id, forged.pack())
         time.sleep(0.5)
         assert cluster.handlers[0].value == 3
+
+
+def test_client_batch_rides_device_verification():
+    """A ClientBatchRequestMsg's elements verify as one cross-request
+    device batch on the tpu backend — the composition client batching
+    was built for (admission-plane coalescing × device dispatch)."""
+    with InProcessCluster(f=1, cfg_overrides=TPU_CFG) as cluster:
+        cl = cluster.client()
+        replies = cl.send_write_batch(
+            [counter.encode_add(d) for d in (5, 6, 7)], timeout_ms=60000)
+        assert [counter.decode_reply(r) for r in replies] == [5, 11, 18]
+        # the PRIMARY's admission batcher dispatched to the device
+        assert cluster.metric(0, "counters", "sigs_device_dispatched",
+                              component="signature_manager") > 0
