@@ -54,15 +54,22 @@ def main() -> None:
                    choices=("udp", "tcp", "tls"))
     p.add_argument("--certs-dir", default=None,
                    help="TLS material dir (node-<id>.key/.crt)")
+    p.add_argument("--config-override", action="append", default=[],
+                   metavar="FIELD=VALUE",
+                   help="set any ReplicaConfig field (repeatable) — same "
+                        "escape hatch as the skvbc replica binary")
     add_scheme_args(p)
     args = p.parse_args()
 
-    cfg = ReplicaConfig(replica_id=args.replica, f_val=args.f, c_val=args.c,
-                        num_ro_replicas=args.ro,
-                        num_of_client_proxies=args.clients,
-                        checkpoint_window_size=args.checkpoint_window,
-                        threshold_scheme=args.threshold_scheme,
-                        client_sig_scheme=args.client_sig_scheme)
+    from tpubft.apps.skvbc_replica import _parse_overrides
+    kw = dict(replica_id=args.replica, f_val=args.f, c_val=args.c,
+              num_ro_replicas=args.ro,
+              num_of_client_proxies=args.clients,
+              checkpoint_window_size=args.checkpoint_window,
+              threshold_scheme=args.threshold_scheme,
+              client_sig_scheme=args.client_sig_scheme)
+    kw.update(_parse_overrides(args.config_override))
+    cfg = ReplicaConfig(**kw)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()
                                 ).for_node(args.replica)
