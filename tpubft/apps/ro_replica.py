@@ -61,14 +61,14 @@ def main() -> None:
     add_scheme_args(p)
     args = p.parse_args()
 
-    from tpubft.apps.skvbc_replica import _parse_overrides
     kw = dict(replica_id=args.replica, f_val=args.f, c_val=args.c,
               num_ro_replicas=args.ro,
               num_of_client_proxies=args.clients,
               checkpoint_window_size=args.checkpoint_window,
               threshold_scheme=args.threshold_scheme,
               client_sig_scheme=args.client_sig_scheme)
-    kw.update(_parse_overrides(args.config_override))
+    from tpubft.utils.config import parse_config_overrides
+    kw.update(parse_config_overrides(args.config_override))
     cfg = ReplicaConfig(**kw)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()

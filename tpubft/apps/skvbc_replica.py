@@ -24,28 +24,6 @@ from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.metrics import Aggregator, UdpMetricsServer
 
 
-def _parse_overrides(pairs) -> dict:
-    """--config-override key=value (repeatable): any ReplicaConfig field,
-    coerced to the field's declared type. The generic escape hatch so new
-    tunables never need a dedicated flag to reach process clusters."""
-    import dataclasses
-    types = {f.name: f.type for f in dataclasses.fields(ReplicaConfig)}
-    out = {}
-    for pair in pairs or []:
-        key, sep, val = pair.partition("=")
-        if not sep or key not in types:
-            raise SystemExit(f"--config-override: unknown or malformed "
-                             f"'{pair}' (want <ReplicaConfig field>=<value>)")
-        t = types[key]
-        if t in ("int", int):
-            out[key] = int(val)
-        elif t in ("bool", bool):
-            out[key] = val.lower() in ("1", "true", "yes", "on")
-        else:
-            out[key] = val
-    return out
-
-
 def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     kw = dict(replica_id=args.replica, f_val=args.f, c_val=args.c,
               num_ro_replicas=args.ro,
@@ -61,7 +39,9 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     if args.device_min_verify_batch is not None:
         kw["device_min_verify_batch"] = args.device_min_verify_batch
     # generic overrides win over flag-mapped fields (applied last)
-    kw.update(_parse_overrides(getattr(args, "config_override", None)))
+    from tpubft.utils.config import parse_config_overrides
+    kw.update(parse_config_overrides(getattr(args, "config_override",
+                                             None)))
     cfg = ReplicaConfig(**kw)
     keys = ClusterKeys.generate(cfg, args.clients,
                                 seed=args.seed.encode()).for_node(args.replica)

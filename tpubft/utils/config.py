@@ -13,6 +13,40 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 
+# identity/topology fields have dedicated CLI flags on every binary and
+# feed key generation + endpoint tables from argv — overriding them
+# through the generic escape hatch would silently desync those
+_TOPOLOGY_FIELDS = frozenset({
+    "replica_id", "f_val", "c_val", "num_ro_replicas",
+    "num_of_client_proxies", "is_read_only"})
+
+
+def parse_config_overrides(pairs) -> Dict[str, Any]:
+    """--config-override key=value (repeatable): any non-topology
+    ReplicaConfig field, coerced to the field's declared type. The
+    generic escape hatch so new tunables never need a dedicated flag to
+    reach replica processes."""
+    types = {f.name: f.type for f in dataclasses.fields(ReplicaConfig)}
+    out: Dict[str, Any] = {}
+    for pair in pairs or []:
+        key, sep, val = pair.partition("=")
+        if not sep or key not in types:
+            raise SystemExit(f"--config-override: unknown or malformed "
+                             f"'{pair}' (want <ReplicaConfig field>=<value>)")
+        if key in _TOPOLOGY_FIELDS:
+            raise SystemExit(f"--config-override: '{key}' is a topology "
+                             f"field — use its dedicated flag (keys and "
+                             f"endpoint tables are derived from argv)")
+        t = types[key]
+        if t in ("int", int):
+            out[key] = int(val)
+        elif t in ("bool", bool):
+            out[key] = val.lower() in ("1", "true", "yes", "on")
+        else:
+            out[key] = val
+    return out
+
+
 @dataclass
 class ReplicaConfig:
     """All tunables for one replica. Field docs mirror the reference params."""
