@@ -123,9 +123,11 @@ class BftTestNetwork:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
-    def start_all(self, timeout: float = 60.0) -> "BftTestNetwork":
-        # 60s: each replica process pays a contended jax import (~10-20s
-        # when the 1-core host is busy); 30s flaked under load
+    def start_all(self, timeout: float = 120.0) -> "BftTestNetwork":
+        # 120s: n replica processes pay CONCURRENT contended jax imports
+        # (~10-20s each when the 1-core host is busy) — 30s and 60s both
+        # flaked under background load; boot time is not what any of
+        # these scenarios measure
         try:
             for r in range(self.n):
                 self.start_replica(r)
