@@ -141,6 +141,69 @@ def test_clients_manager_dedup_and_cache():
     assert not cm.can_become_pending(99, 1)     # unknown client
 
 
+def test_clients_manager_out_of_order_execution():
+    """Membership, not a watermark: a lower-seq request whose pre-exec
+    session finishes AFTER a higher-seq sibling executed must still be
+    admittable and executable (advisor round-4 high finding)."""
+    cm = ClientsManager([10])
+    cm.add_pending(10, 5)
+    cm.add_pending(10, 3)
+    reply5 = ClientReplyMsg(sender_id=0, req_seq_num=5, current_primary=0,
+                            reply=b"r5", replica_specific_info=b"")
+    cm.on_request_executed(10, 5, reply5)
+    # seq 3 is still in flight — not a dup just because 5 executed
+    assert not cm.was_executed(10, 3)
+    assert not cm.can_become_pending(10, 3)     # in flight, not executed
+    reply3 = ClientReplyMsg(sender_id=0, req_seq_num=3, current_primary=0,
+                            reply=b"r3", replica_specific_info=b"")
+    cm.on_request_executed(10, 3, reply3)
+    assert cm.was_executed(10, 3)
+    assert cm.cached_reply(10, 3) == reply3
+    # a NEVER-seen lower seq arriving late is admissible
+    assert cm.can_become_pending(10, 2)
+    # oversize-reply marker still records at-most-once state
+    cm.note_executed(10, 7)
+    assert cm.was_executed(10, 7)
+    assert not cm.can_become_pending(10, 7)
+    assert cm.cached_reply(10, 7) is None
+
+
+def test_clients_manager_eviction_floor():
+    """Seqs evicted from the bounded reply cache must stay refused (they
+    may have executed), while fresh higher seqs are unaffected."""
+    from tpubft.consensus.clients_manager import REPLY_CACHE_PER_CLIENT
+    cm = ClientsManager([10])
+    n = REPLY_CACHE_PER_CLIENT + 4
+    for seq in range(1, n + 1):
+        cm.on_request_executed(10, seq, ClientReplyMsg(
+            sender_id=0, req_seq_num=seq, current_primary=0,
+            reply=b"", replica_specific_info=b""))
+    # oldest entries were evicted: still treated as executed
+    assert cm.was_executed(10, 1)
+    assert not cm.can_become_pending(10, 1)
+    assert cm.was_executed(10, n)
+    assert cm.can_become_pending(10, n + 1)
+
+
+def test_clients_manager_seal_restore():
+    """Post-restart/ST floor: the persisted reply ring is bounded, so a
+    seq below the watermark that wasn't reloaded must be refused (it may
+    have executed-and-evicted), while in-flight admission before the seal
+    is unaffected."""
+    cm = ClientsManager([10])
+    # simulate a restore that reloaded only seqs 90 and 100 from the ring
+    cm.on_request_executed(10, 90, ClientReplyMsg(
+        sender_id=0, req_seq_num=90, current_primary=0, reply=b"",
+        replica_specific_info=b""))
+    cm.note_executed(10, 100)
+    assert cm.can_become_pending(10, 50)    # pre-seal: unknown = fresh
+    cm.seal_restore(10)
+    assert not cm.can_become_pending(10, 50)    # may have executed
+    assert cm.was_executed(10, 50)
+    assert cm.cached_reply(10, 90) is not None  # ring entries still serve
+    assert cm.can_become_pending(10, 101)       # above watermark: fresh
+
+
 def test_active_window_slide():
     w = ActiveWindow(300, SeqNumInfo)
     assert w.in_window(1) and w.in_window(300)

@@ -11,11 +11,17 @@ from __future__ import annotations
 
 import struct
 import threading
+from collections import OrderedDict
 
 from tpubft.consensus.replica import IRequestsHandler
 from tpubft.crypto.digest import digest as sha256
 
 _I64 = struct.Struct("<q")
+
+# replay-idempotence records kept per client. Covers the committed suffix
+# a WAL recovery can re-execute (bounded by the per-client in-flight cap,
+# consensus.clients_manager.MAX_PENDING_PER_CLIENT = 128, plus slack).
+_APPLIED_PER_CLIENT = 512
 
 
 def encode_add(delta: int) -> bytes:
@@ -33,8 +39,24 @@ def decode_reply(reply: bytes) -> int:
 class CounterHandler(IRequestsHandler):
     def __init__(self) -> None:
         self._value = 0
-        self._applied: dict = {}        # client_id -> last applied req_seq
+        # client_id -> bounded set of applied req_seqs (membership, not a
+        # watermark: requests execute out of seq order, so a lower seq is
+        # not evidence of a replay)
+        self._applied: dict = {}        # client_id -> OrderedDict[seq, None]
+        self._applied_floor: dict = {}  # client_id -> highest evicted seq
         self._lock = threading.Lock()
+
+    def _was_applied(self, client_id: int, req_seq: int) -> bool:
+        return (req_seq in self._applied.get(client_id, ())
+                or req_seq <= self._applied_floor.get(client_id, 0))
+
+    def _mark_applied(self, client_id: int, req_seq: int) -> None:
+        seqs = self._applied.setdefault(client_id, OrderedDict())
+        seqs[req_seq] = None
+        while len(seqs) > _APPLIED_PER_CLIENT:
+            evicted, _ = seqs.popitem(last=False)
+            if evicted > self._applied_floor.get(client_id, 0):
+                self._applied_floor[client_id] = evicted
 
     def _persist(self) -> None:
         pass
@@ -53,11 +75,11 @@ class CounterHandler(IRequestsHandler):
                 # app state persisted mid-crash (the same reason kvbc
                 # replays are keyed by block id — add_block of an
                 # existing id is a no-op)
-                if req_seq and self._applied.get(client_id, 0) >= req_seq:
+                if req_seq and self._was_applied(client_id, req_seq):
                     return _I64.pack(self._value)
                 self._value += delta
                 if req_seq:
-                    self._applied[client_id] = req_seq
+                    self._mark_applied(client_id, req_seq)
                 self._persist()
                 return _I64.pack(self._value)
         if request[:1] == b"R":
@@ -88,8 +110,15 @@ class PersistentCounterHandler(CounterHandler):
                 import json
                 st = json.loads(raw)
                 self._value = int(st["value"])
-                self._applied = {int(k): int(v)
-                                 for k, v in st.get("applied", {}).items()}
+                for k, v in st.get("applied", {}).items():
+                    if isinstance(v, list):
+                        self._applied[int(k)] = OrderedDict(
+                            (int(s), None) for s in v)
+                    else:   # legacy watermark format: treat as floor
+                        self._applied_floor[int(k)] = int(v)
+                self._applied_floor.update(
+                    {int(k): int(v)
+                     for k, v in st.get("floor", {}).items()})
         except (OSError, ValueError, KeyError, struct.error):
             self._value = 0
 
@@ -99,9 +128,11 @@ class PersistentCounterHandler(CounterHandler):
         import json
         import os
         tmp = self._path + ".tmp"
+        applied = {c: list(seqs) for c, seqs in self._applied.items()}
         with open(tmp, "wb") as fh:
             fh.write(json.dumps({"value": self._value,
-                                 "applied": self._applied}).encode())
+                                 "applied": applied,
+                                 "floor": self._applied_floor}).encode())
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, self._path)

@@ -111,8 +111,10 @@ def run_orchestrator(args) -> int:
                  "--base-port", str(args.base_port),
                  "--clients", str(args.clients), "--seed", args.seed,
                  "--metrics-port", str(metrics_base + r)]))
+        # 120s: n concurrent cold jax imports contend on this 1-core host
+        # (same flake class as the process-cluster boot timeout)
         if not _wait_for_metrics([metrics_base + r for r in range(n)],
-                                 timeout_s=60):
+                                 timeout_s=120):
             print("replicas failed to become ready")
             return 1
         keys = ClusterKeys.generate(cfg, args.clients, seed=args.seed.encode())

@@ -33,6 +33,7 @@ class LoopbackBus:
         self._q: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
+        self._closed = False
 
     def create(self, node: NodeNum) -> "LoopbackCommunication":
         comm = LoopbackCommunication(self, node)
@@ -48,7 +49,13 @@ class LoopbackBus:
     def post(self, sender: NodeNum, dest: NodeNum, data: bytes) -> None:
         # lock-free fast path: post() runs for EVERY message in the
         # cluster, and the bus lock here was a measurable global hot spot
-        # under load; the lock is only taken when the pump looks dead
+        # under load; the lock is only taken when the pump looks dead.
+        # _closed guards the shutdown race: a post() that observed a live
+        # thread while the None sentinel was already queued would be
+        # silently dropped, and a post() after shutdown would resurrect
+        # the pump — both drop the message instead.
+        if self._closed:
+            return
         t = self._thread
         if t is None or not t.is_alive():
             self._ensure_thread()
@@ -56,6 +63,8 @@ class LoopbackBus:
 
     def _ensure_thread(self) -> None:
         with self._lock:
+            if self._closed:
+                return
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._pump, name="loopback-bus", daemon=True)
@@ -82,6 +91,7 @@ class LoopbackBus:
 
     def shutdown(self) -> None:
         with self._lock:
+            self._closed = True
             t = self._thread
             self._thread = None
         if t is not None and t.is_alive():

@@ -36,9 +36,25 @@ MAX_PENDING_PER_CLIENT = 2 * ClientBatchRequestMsg.MAX_BATCH
 
 @dataclass
 class _ClientInfo:
+    # newest executed seq. NOT a dedup watermark (see replies below) — it
+    # exists only to seal the post-restore floor: reserved pages persist a
+    # bounded reply ring, so after a restart/state transfer anything at or
+    # below this that is absent from the ring may have executed and been
+    # forgotten, and must be refused (seal_restore).
     last_executed_req: int = -1
-    replies: "OrderedDict[int, ClientReplyMsg]" = field(
+    # req_seq -> reply (None = executed with oversize/absent reply). This
+    # map IS the at-most-once record: requests execute out of seq order
+    # (multi-pending + pre-exec sessions complete independently), so dedup
+    # is membership here, never a seqnum watermark (reference
+    # ClientsManager.cpp:455 canBecomePending checks requestsInfo/
+    # repliesInfo membership for the same reason).
+    replies: "OrderedDict[int, Optional[ClientReplyMsg]]" = field(
         default_factory=OrderedDict)
+    # highest req_seq ever evicted from the bounded replies map: a seq at
+    # or below this may have executed and been forgotten, so it must be
+    # refused (can't prove it isn't a replay). Only eviction — never
+    # execution — advances this.
+    evicted_high: int = -1
     pending: "OrderedDict[int, str]" = field(
         default_factory=OrderedDict)      # req_seq -> cid
 
@@ -56,13 +72,25 @@ class ClientsManager:
         info = self._clients.get(client_id)
         if info is None:
             return False
-        if req_seq <= info.last_executed_req:
+        if self._executed(info, req_seq):
             return False                       # already executed (dup)
         if req_seq in info.pending:
             return False                       # already in flight
         if len(info.pending) >= MAX_PENDING_PER_CLIENT:
             return False                       # per-client flood bound
         return True
+
+    @staticmethod
+    def _executed(info: _ClientInfo, req_seq: int) -> bool:
+        return req_seq in info.replies or req_seq <= info.evicted_high
+
+    def was_executed(self, client_id: int, req_seq: int) -> bool:
+        """At-most-once membership test: True if this request executed (or
+        its record aged out of the bounded cache, which must be treated as
+        executed). A lower seq than the newest execution is NOT evidence
+        of a dup — requests complete out of order."""
+        info = self._clients.get(client_id)
+        return self._executed(info, req_seq) if info else False
 
     def add_pending(self, client_id: int, req_seq: int, cid: str = "") -> None:
         self._clients[client_id].pending[req_seq] = cid
@@ -72,7 +100,7 @@ class ClientsManager:
 
     # ---- execution results ----
     def on_request_executed(self, client_id: int, req_seq: int,
-                            reply: ClientReplyMsg) -> None:
+                            reply: Optional[ClientReplyMsg]) -> None:
         info = self._clients.get(client_id)
         if info is None:
             return
@@ -80,31 +108,37 @@ class ClientsManager:
             info.last_executed_req = req_seq
         info.replies[req_seq] = reply
         while len(info.replies) > REPLY_CACHE_PER_CLIENT:
-            info.replies.popitem(last=False)     # evict oldest
+            seq, _ = info.replies.popitem(last=False)   # evict oldest
+            if seq > info.evicted_high:
+                info.evicted_high = seq
         info.pending.pop(req_seq, None)
 
     def note_executed(self, client_id: int, req_seq: int) -> None:
-        """Advance at-most-once state without a cached reply (oversize
-        reply marker loaded from reserved pages)."""
-        info = self._clients.get(client_id)
-        if info is None:
-            return
-        if req_seq > info.last_executed_req:
-            info.last_executed_req = req_seq
-        info.pending.pop(req_seq, None)
+        """Record execution without a cached reply (oversize reply marker
+        loaded from reserved pages). Keeps a None entry in the replies map
+        so the at-most-once membership test still covers the request."""
+        self.on_request_executed(client_id, req_seq, None)
 
     def cached_reply(self, client_id: int,
                      req_seq: int) -> Optional[ClientReplyMsg]:
         """Reply for a retransmitted already-executed request (reference
         stores per-request reply slots in reserved pages; we keep a
         bounded per-client map so every element of an executed batch
-        stays regenerable, not just the newest request)."""
+        stays regenerable, not just the newest request). None for both
+        never-executed and oversize-reply entries."""
         info = self._clients.get(client_id)
         return info.replies.get(req_seq) if info else None
 
-    def last_executed(self, client_id: int) -> int:
+    def seal_restore(self, client_id: int) -> None:
+        """Call after seeding this client from reserved pages (restart or
+        completed state transfer): the persisted reply ring is bounded, so
+        any seq at or below the persisted newest-executed watermark that
+        did not make it back into the ring may have executed and been
+        evicted — refuse it. Without this seal, a restart would reopen the
+        at-most-once window for old validly-signed requests."""
         info = self._clients.get(client_id)
-        return info.last_executed_req if info else -1
+        if info is not None and info.last_executed_req > info.evicted_high:
+            info.evicted_high = info.last_executed_req
 
     def clear_pending(self) -> None:
         """View change: in-flight requests are abandoned; clients will

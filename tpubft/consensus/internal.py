@@ -104,10 +104,11 @@ class InternalBFTClient:
         ordered+executed (a one-shot key exchange lost at startup would
         otherwise never happen)."""
         now = time.monotonic()
-        executed = self._replica.clients.last_executed(self.client_id)
+        clients = self._replica.clients
         for seq in sorted(self._pending):
             raw, sent, tries = self._pending[seq]
-            if seq <= executed or tries >= self.MAX_RETRANSMITS:
+            if (clients.was_executed(self.client_id, seq)
+                    or tries >= self.MAX_RETRANSMITS):
                 del self._pending[seq]
                 continue
             if now - sent >= self.RETRANSMIT_PERIOD_S:

@@ -212,7 +212,7 @@ class Replica(IReceiver):
         self._vc_started_at = 0.0
         self._last_progress = time.monotonic()
         self._forwarded: Dict[tuple, float] = {}   # (client, req_seq) -> time
-        self._batch_relayed: Dict[tuple, float] = {}  # batch relay dedup
+        self._batch_relayed: Dict[int, float] = {}  # client -> last relay t
         self._ck_asked: Dict[int, float] = {}      # AskForCheckpoint rate
         self._self_ck_latest: Optional[m.CheckpointMsg] = None
 
@@ -397,8 +397,12 @@ class Replica(IReceiver):
                 # oversize-reply marker: at-most-once state only
                 self.clients.note_executed(c, int.from_bytes(raw[1:9],
                                                              "big"))
-                continue
-            seed(c, raw)
+            else:
+                seed(c, raw)
+        for c in self.info.all_client_ids():
+            # the persisted ring is bounded: seqs below the watermark that
+            # didn't come back may have executed-and-evicted — refuse them
+            self.clients.seal_restore(c)
 
     # ------------------------------------------------------------------
     # state transfer wiring (ReplicaForStateTransfer equivalent)
@@ -549,6 +553,11 @@ class Replica(IReceiver):
             # the async plane verifies them as one device batch
             if msg.sender_id != sender and not self.info.is_replica(sender):
                 return
+            # unknown principals drop here, BEFORE the relay/suppression
+            # path: a byzantine replica streaming fabricated sender_ids
+            # must not grow _batch_relayed or mint amplified relays
+            if not self.clients.is_valid_client(msg.sender_id):
+                return
             inners = []
             for raw in msg.requests:
                 try:
@@ -569,17 +578,16 @@ class Replica(IReceiver):
             # retrying lost replies would otherwise trigger an
             # (n-1)x-amplified re-relay of the largest message type on
             # every retry).
+            # Suppression is keyed on the principal ALONE: the client
+            # enforces one outstanding batch per principal, and keying on
+            # any element-derived value would let a spoofer mint fresh
+            # keys (and unbounded relays) by varying that element. The
+            # map is therefore bounded by the client count — no pruning.
             if not self.is_primary and not self.in_view_change:
                 now = time.monotonic()
-                key = (msg.sender_id, inners[-1].req_seq_num)
-                last = self._batch_relayed.get(key)
+                last = self._batch_relayed.get(msg.sender_id)
                 if last is None or now - last > 1.0:
-                    self._batch_relayed[key] = now
-                    if len(self._batch_relayed) > 1024:
-                        cutoff = now - 5.0
-                        self._batch_relayed = {
-                            k: t for k, t in self._batch_relayed.items()
-                            if t > cutoff}
+                    self._batch_relayed[msg.sender_id] = now
                     self.comm.send(self.primary, msg.pack())
             for inner in inners:
                 self._on_client_request(inner, relay=False)
@@ -1414,9 +1422,11 @@ class Replica(IReceiver):
             if info is None or not info.committed or info.executed:
                 return
             for req in info.pre_prepare.client_requests():
-                # at-most-once: a request seqnum already executed for this
-                # client must not re-execute (replay inside a later batch)
-                if req.req_seq_num <= self.clients.last_executed(req.sender_id):
+                # at-most-once: a request already executed for this client
+                # must not re-execute (replay inside a later batch). This
+                # is a membership test — requests execute out of seq order,
+                # so a lower seqnum is not evidence of a replay.
+                if self.clients.was_executed(req.sender_id, req.req_seq_num):
                     cached = self.clients.cached_reply(req.sender_id,
                                                        req.req_seq_num)
                     if cached is not None:
@@ -1900,7 +1910,7 @@ class Replica(IReceiver):
         # forwarded-but-unexecuted client requests are work the primary owes
         # us; executed or abandoned entries are GC'd
         for key in [k for k, t in self._forwarded.items()
-                    if k[1] <= self.clients.last_executed(k[0])
+                    if self.clients.was_executed(k[0], k[1])
                     or now - t > 4 * timeout]:
             del self._forwarded[key]
         if in_flight or self.pending_requests or self._forwarded:

@@ -40,10 +40,17 @@ def parse_config_overrides(pairs) -> Dict[str, Any]:
         t = types[key]
         if t in ("int", int):
             out[key] = int(val)
+        elif t in ("float", float):
+            out[key] = float(val)
         elif t in ("bool", bool):
             out[key] = val.lower() in ("1", "true", "yes", "on")
-        else:
+        elif t in ("str", str):
             out[key] = val
+        else:
+            # an unrecognized declared type must fail at parse time, not
+            # surface as a str/type mismatch deep inside the replica
+            raise SystemExit(f"--config-override: field '{key}' has "
+                             f"unsupported type {t!r}")
     return out
 
 
