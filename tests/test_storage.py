@@ -132,3 +132,87 @@ def test_db_persistent_storage_roundtrip(tmp_path):
     assert st2.last_executed_seq == 17
     assert st2.seq_states[17].pre_prepare == b"\x01\x02"
     db.close()
+
+
+def test_db_persistent_storage_incremental(tmp_path):
+    """Dirty/deleted seq tracking: window slide prunes rows from the DB,
+    VC blobs and descriptors round-trip, and mutations via seq() on a
+    pre-existing entry persist."""
+    path = str(tmp_path / "ps.kvlog")
+    db = NativeDB(path)
+    ps = DBPersistentStorage(db)
+    st = ps.begin_write_tran()
+    for s in range(1, 6):
+        st.seq(s).pre_prepare = b"pp%d" % s
+    st.restrictions = [b"r1", b"r2"]
+    st.carried_certs = [b"c1"]
+    st.carried_bodies = [b"b1"]
+    ps.end_write_tran()
+    # second tran: mutate one entry, slide the window past seq 3
+    st = ps.begin_write_tran()
+    st.seq(4).commit_full = b"cf4"
+    st.last_stable_seq = 3
+    for s in [s for s in st.seq_states if s <= 3]:
+        del st.seq_states[s]
+    ps.end_write_tran()
+    db.close()
+
+    db = NativeDB(path)
+    st2 = DBPersistentStorage(db).load()
+    assert sorted(st2.seq_states) == [4, 5]
+    assert st2.seq_states[4].pre_prepare == b"pp4"
+    assert st2.seq_states[4].commit_full == b"cf4"
+    assert st2.last_stable_seq == 3
+    assert st2.restrictions == [b"r1", b"r2"]
+    assert st2.carried_certs == [b"c1"]
+    assert st2.carried_bodies == [b"b1"]
+    # pruned rows are gone from the seq family on disk
+    assert db.get((1).to_bytes(8, "big"), b"metaseq") is None
+    db.close()
+
+
+def test_db_persistent_storage_fresh_db_seq_only_commit(tmp_path):
+    """A fresh DB whose first commits touch only seq rows (descriptor
+    scalars still at defaults — the normal prepare-before-execute order)
+    must still recover those rows: the desc row is the layout marker and
+    has to ride any first write."""
+    path = str(tmp_path / "ps.kvlog")
+    db = NativeDB(path)
+    ps = DBPersistentStorage(db)
+    st = ps.begin_write_tran()
+    st.seq(5).pre_prepare = b"\x05"
+    ps.end_write_tran()
+    db.close()
+    db = NativeDB(path)
+    st2 = DBPersistentStorage(db).load()
+    assert st2.seq_states[5].pre_prepare == b"\x05"
+    db.close()
+
+
+def test_db_persistent_storage_legacy_json_migration(tmp_path):
+    """A DB written by the old whole-state-JSON layout loads correctly."""
+    import json as _json
+
+    from tpubft.consensus.persistent import (FilePersistentStorage,
+                                             PersistedState)
+    path = str(tmp_path / "ps.kvlog")
+    db = NativeDB(path)
+    legacy = PersistedState(last_view=2, last_executed_seq=9,
+                            last_stable_seq=0)
+    legacy.seq(9).pre_prepare = b"\xaa"
+    raw = _json.dumps(FilePersistentStorage._encode(legacy),
+                      separators=(",", ":")).encode()
+    db.put((1).to_bytes(4, "big"), raw, b"metadata")
+    ps = DBPersistentStorage(db)
+    st = ps.load()
+    assert st.last_view == 2 and st.last_executed_seq == 9
+    assert st.seq_states[9].pre_prepare == b"\xaa"
+    # and the next commit writes the new layout
+    st = ps.begin_write_tran()
+    st.seq(9).commit_full = b"\xbb"
+    ps.end_write_tran()
+    db.close()
+    db = NativeDB(path)
+    st2 = DBPersistentStorage(db).load()
+    assert st2.seq_states[9].commit_full == b"\xbb"
+    db.close()

@@ -124,6 +124,15 @@ def make_parser() -> argparse.ArgumentParser:
 def main() -> None:
     from tpubft.utils.logging import configure
     configure()                       # level from TPUBFT_LOG (default warn)
+    if os.environ.get("TPUBFT_PROFILE_DIR"):
+        # profiling runs need a GRACEFUL stop on SIGTERM so the
+        # dispatcher's pstats dump (incoming.Dispatcher._loop) happens;
+        # normal runs keep the default hard exit (harness timing)
+        import signal
+
+        def _term(_sig, _frm):
+            raise SystemExit(0)
+        signal.signal(signal.SIGTERM, _term)
     args = make_parser().parse_args()
     comm_wrapper = None
     if args.strategy:

@@ -89,6 +89,12 @@ class Dispatcher:
         # sticky MDC pinned on the dispatcher thread (e.g. replica id) so
         # every log line from protocol handlers is attributable
         self._thread_mdc = thread_mdc or {}
+        # runs at the end of every loop iteration (message + due timers):
+        # the transport's batched-send flush point
+        self._post_hook: Optional[Callable[[], None]] = None
+
+    def set_post_hook(self, fn: Callable[[], None]) -> None:
+        self._post_hook = fn
 
     def set_external_handler(self, fn: Callable[[int, bytes], None]) -> None:
         self._external_handler = fn
@@ -116,6 +122,24 @@ class Dispatcher:
         get_watchdog().unregister(self._name)
 
     def _loop(self) -> None:
+        import os
+        prof_dir = os.environ.get("TPUBFT_PROFILE_DIR")
+        if prof_dir:
+            # saturation profiling of THE consensus thread (where all
+            # protocol state mutates): dump pstats when the loop exits —
+            # pair with the SIGTERM handler in apps that enables a clean
+            # stop (see skvbc_replica.main)
+            import cProfile
+            prof = cProfile.Profile()
+            try:
+                prof.runcall(self._loop_body)
+            finally:
+                prof.dump_stats(os.path.join(
+                    prof_dir, f"{self._name}-{os.getpid()}.pstats"))
+        else:
+            self._loop_body()
+
+    def _loop_body(self) -> None:
         set_mdc(**self._thread_mdc)
         # liveness heartbeat: a wedged dispatcher (deadlock, hung handler)
         # gets a full-process stack dump from the watchdog (§5.2 role)
@@ -146,3 +170,8 @@ class Dispatcher:
                         t[1]()
                     except Exception:  # noqa: BLE001
                         log.exception("timer callback raised")
+            if self._post_hook is not None:
+                try:
+                    self._post_hook()
+                except Exception:  # noqa: BLE001
+                    log.exception("post hook raised")

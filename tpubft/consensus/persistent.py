@@ -31,6 +31,48 @@ class PersistedSeqState:
     slow_started: bool = False
 
 
+class _TrackingSeqStates(dict):
+    """seq_states dict that records per-seq dirt/deletions so incremental
+    backends (DBPersistentStorage) persist only what changed in a
+    transaction instead of re-encoding the full window every commit."""
+
+    __slots__ = ("owner",)
+
+    def __init__(self, owner: "PersistedState"):
+        super().__init__()
+        self.owner = owner
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        self.owner.dirty_seqs.add(k)
+        self.owner.deleted_seqs.discard(k)
+
+    def __delitem__(self, k):
+        super().__delitem__(k)
+        self.owner.dirty_seqs.discard(k)
+        self.owner.deleted_seqs.add(k)
+
+    def pop(self, k, *default):
+        if k in self:
+            self.owner.dirty_seqs.discard(k)
+            self.owner.deleted_seqs.add(k)
+        return super().pop(k, *default)
+
+    def clear(self):
+        self.owner.deleted_seqs.update(self.keys())
+        self.owner.dirty_seqs.clear()
+        super().clear()
+
+    def update(self, *args, **kwargs):
+        for k, v in dict(*args, **kwargs).items():
+            self[k] = v                 # route through tracking
+
+    def setdefault(self, k, default=None):
+        if k not in self:
+            self[k] = default           # route through tracking
+        return super().__getitem__(k)
+
+
 @dataclass
 class PersistedState:
     """Everything needed to rejoin safely after a crash."""
@@ -38,7 +80,7 @@ class PersistedState:
     last_executed_seq: int = 0
     last_stable_seq: int = 0
     in_view_change: bool = False
-    seq_states: Dict[int, PersistedSeqState] = field(default_factory=dict)
+    seq_states: Dict[int, PersistedSeqState] = None  # set in __post_init__
     # view-change safety state (reference PersistentStorageDescriptors):
     # packed view_change.Restriction / messages.PreparedCertificate blobs
     restrictions: List[bytes] = field(default_factory=list)
@@ -47,11 +89,28 @@ class PersistedState:
     # travel digest-only, so the bodies that must survive a crash live here
     carried_bodies: List[bytes] = field(default_factory=list)
 
+    def __post_init__(self):
+        # change-tracking for incremental backends; a seq appears in at
+        # most one of the two sets. Backends drain both at commit.
+        self.dirty_seqs: set = set()
+        self.deleted_seqs: set = set()
+        states = _TrackingSeqStates(self)
+        if self.seq_states:                 # dataclasses.replace paths
+            states.update(self.seq_states)
+        self.seq_states = states
+
     def seq(self, seq_num: int) -> PersistedSeqState:
         st = self.seq_states.get(seq_num)
         if st is None:
             st = self.seq_states[seq_num] = PersistedSeqState()
+        else:
+            # the caller got a mutable entry: assume it changes
+            self.dirty_seqs.add(seq_num)
         return st
+
+    def clear_tracking(self) -> None:
+        self.dirty_seqs.clear()
+        self.deleted_seqs.clear()
 
 
 class PersistentStorage:
@@ -80,6 +139,8 @@ class InMemoryPersistentStorage(PersistentStorage):
     def end_write_tran(self) -> None:
         assert self._depth > 0
         self._depth -= 1
+        if self._depth == 0:
+            self._state.clear_tracking()    # whole state is live anyway
 
     def load(self) -> PersistedState:
         return self._state
@@ -110,6 +171,7 @@ class FilePersistentStorage(PersistentStorage):
         assert self._depth > 0
         self._depth -= 1
         if self._depth == 0:
+            self._state.clear_tracking()    # full-state WAL line follows
             line = json.dumps(self._encode(self._state),
                               separators=(",", ":")) + "\n"
             self._fh.write(line.encode())

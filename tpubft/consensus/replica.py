@@ -220,6 +220,11 @@ class Replica(IReceiver):
         self.incoming = IncomingMsgsStorage()
         self.dispatcher = Dispatcher(self.incoming, name=f"replica-{self.id}",
                                      thread_mdc={"r": self.id})
+        comm_flush = getattr(comm, "flush", None)
+        if comm_flush is not None:
+            # batched-send transports hold the dispatcher's datagrams and
+            # put them on the wire in one syscall per iteration
+            self.dispatcher.set_post_hook(comm_flush)
         self.dispatcher.set_external_handler(self._on_external)
         self.dispatcher.register_internal("combine", self._on_combine_result)
         self.dispatcher.register_internal("pp_verified", self._on_pp_verified)

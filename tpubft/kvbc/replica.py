@@ -24,14 +24,16 @@ from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.metrics import Aggregator
 
 
-def open_db(db_path: Optional[str]) -> IDBClient:
+def open_db(db_path: Optional[str],
+            sync_writes: bool = False) -> IDBClient:
     """Storage factory (reference: kvbc storage factories — RocksDB for
-    production, memorydb for tests)."""
+    production, memorydb for tests). `sync_writes` mirrors RocksDB
+    WriteOptions.sync (reference leaves it false)."""
     if db_path is None:
         return MemoryDB()
     from tpubft.storage.native import NativeDB
     os.makedirs(os.path.dirname(db_path) or ".", exist_ok=True)
-    return NativeDB(db_path)
+    return NativeDB(db_path, sync_writes=sync_writes)
 
 
 class KvbcReplica:
@@ -42,7 +44,8 @@ class KvbcReplica:
                  aggregator: Optional[Aggregator] = None,
                  use_device_hashing: Optional[bool] = None,
                  thin_replica_port: Optional[int] = None) -> None:
-        self.db = open_db(db_path)
+        self.db = open_db(db_path,
+                          sync_writes=getattr(cfg, "db_sync_writes", False))
         from tpubft.kvbc import create_blockchain
         # resolve "auto" BEFORE the hashing decision below reads it (the
         # consensus Replica performs the same write-back; both orderings

@@ -49,6 +49,13 @@ class WrapCommunication(ICommunication):
     def max_message_size(self) -> int:
         return self._inner.max_message_size
 
+    def flush(self) -> None:
+        """Pass the dispatcher's end-of-iteration flush through to a
+        batching inner transport (udp sendmmsg plane)."""
+        inner_flush = getattr(self._inner, "flush", None)
+        if inner_flush is not None:
+            inner_flush()
+
 
 def _msg_code(data: bytes) -> int:
     """Peek the consensus msg code without a full parse (every packed

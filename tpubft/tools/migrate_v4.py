@@ -66,7 +66,10 @@ def main() -> int:
                     help="skip the full second read-and-compare pass")
     args = ap.parse_args()
     from tpubft.kvbc.replica import open_db
-    migrate(open_db(args.src), open_db(args.dst),
+    # offline tool: full per-batch durability on the destination (the
+    # replica's unsynced default is a latency tradeoff this tool
+    # doesn't need)
+    migrate(open_db(args.src), open_db(args.dst, sync_writes=True),
             args.src_version, args.dst_version, verify=args.verify)
     return 0
 

@@ -69,7 +69,9 @@ def main() -> int:
                 raise SystemExit(
                     f"target already exists: {args.target} "
                     "(restore provisions a NEW db)")
-            db = open_db(args.target)
+            # offline restore: fsync per batch — the provisioned DB must
+            # survive a power cut the moment the tool reports success
+            db = open_db(args.target, sync_writes=True)
             man = restore_snapshot(args.source, db)
             bc = create_blockchain(db, version=args.kvbc_version,
                                    use_device_hashing=False)
