@@ -208,6 +208,13 @@ def test_add_remove_with_wedge_restart_flow(tmp_path):
         assert _commit(kv, b"post", b"2", timeout_ms=15000)
         assert kv.read([b"pre", b"post"]) == {b"pre": b"1", b"post": b"2"}
 
+        # epoch parity (reference EpochManager): the reconfiguration
+        # bumped the global epoch in reserved pages; every replica
+        # restarted into the new config adopted era 1 and the cluster
+        # keeps ordering in it (the post-restart commits above)
+        for r in range(net.n):
+            assert net.metrics(r).get("replica", "gauges", "epoch") == 1, r
+
 
 def test_pruning_over_processes(tmp_path):
     """Consensus-coordinated pruning on a live process cluster

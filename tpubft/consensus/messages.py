@@ -226,10 +226,13 @@ class PrePrepareMsg(ConsensusMsg):
     requests_digest: bytes
     requests: List[bytes]
     signature: bytes
+    # reconfiguration era (reference PrePrepareMsg epochNum, stamped from
+    # EpochManager); inside the signed payload, rejected on mismatch
+    epoch: int = 0
     SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
             ("first_path", "u8"), ("time", "u64"),
             ("requests_digest", "bytes"), ("requests", ("list", "bytes")),
-            ("signature", "bytes")]
+            ("epoch", "u64"), ("signature", "bytes")]
 
     @staticmethod
     def compute_requests_digest(requests: List[bytes]) -> bytes:
@@ -274,7 +277,9 @@ class StartSlowCommitMsg(ConsensusMsg):
     sender_id: int
     view: int
     seq_num: int
-    SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64")]
+    epoch: int = 0
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
+            ("epoch", "u64")]
 
 
 @dataclass
@@ -286,8 +291,10 @@ class _SignedShareBase(ConsensusMsg):
     seq_num: int
     digest: bytes                 # commit_digest(view, seq, ppDigest)
     sig: bytes                    # share (Partial) or combined (Full)
+    epoch: int = 0                # reconfiguration era (SignedShareMsgs
+                                  # carry epochNum in the reference too)
     SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
-            ("digest", "bytes"), ("sig", "bytes")]
+            ("digest", "bytes"), ("sig", "bytes"), ("epoch", "u64")]
 
     def validate(self) -> None:
         if len(self.digest) != 32:
@@ -372,9 +379,14 @@ class CheckpointMsg(ConsensusMsg):
     # CheckpointMsg carries stateDigest + reservedPagesDigest + rvbDigest)
     res_pages_digest: bytes = b""
     signature: bytes = b""
+    # era of the certifying replica: lower-epoch checkpoints are stale
+    # and dropped; higher-epoch ones are evidence this replica lags a
+    # reconfiguration and feed state-transfer catch-up
+    epoch: int = 0
     SPEC = [("sender_id", "u32"), ("seq_num", "u64"),
             ("state_digest", "bytes"), ("is_stable", "bool"),
-            ("res_pages_digest", "bytes"), ("signature", "bytes")]
+            ("res_pages_digest", "bytes"), ("epoch", "u64"),
+            ("signature", "bytes")]
 
 
 @register
@@ -386,8 +398,9 @@ class SimpleAckMsg(ConsensusMsg):
     seq_num: int
     view: int
     acked_msg_code: int
+    epoch: int = 0
     SPEC = [("sender_id", "u32"), ("seq_num", "u64"), ("view", "u64"),
-            ("acked_msg_code", "u16")]
+            ("acked_msg_code", "u16"), ("epoch", "u64")]
 
 
 # ---------------- pre-execution (reference src/preprocessor/messages) ----
@@ -522,10 +535,14 @@ class ViewChangeMsg(ConsensusMsg):
     last_stable_seq: int
     prepared: List[PreparedCertificate]
     signature: bytes
+    # a dead-era ViewChangeMsg must not count toward a live-era f+1
+    # view-change threshold — epoch rides the signed payload like the
+    # other ordering messages
+    epoch: int = 0
     SPEC = [("sender_id", "u32"), ("new_view", "u64"),
             ("last_stable_seq", "u64"),
             ("prepared", ("list", ("msg", PreparedCertificate))),
-            ("signature", "bytes")]
+            ("epoch", "u64"), ("signature", "bytes")]
 
     def digest(self) -> bytes:
         return sha256(self.signed_payload())
@@ -549,9 +566,10 @@ class NewViewMsg(ConsensusMsg):
     new_view: int
     view_change_digests: List[ReplicaDigest]
     signature: bytes
+    epoch: int = 0
     SPEC = [("sender_id", "u32"), ("new_view", "u64"),
             ("view_change_digests", ("list", ("msg", ReplicaDigest))),
-            ("signature", "bytes")]
+            ("epoch", "u64"), ("signature", "bytes")]
 
 
 @register
@@ -564,8 +582,10 @@ class ReplicaAsksToLeaveViewMsg(ConsensusMsg):
     view: int
     reason: int                   # enum: timeout=0, primary-misbehavior=1…
     signature: bytes
+    epoch: int = 0                # dead-era complaints must not count
+                                  # toward a live-era f+1 threshold
     SPEC = [("sender_id", "u32"), ("view", "u64"), ("reason", "u8"),
-            ("signature", "bytes")]
+            ("epoch", "u64"), ("signature", "bytes")]
 
 
 # ---------------- recovery / status ----------------
@@ -640,8 +660,9 @@ class ReplicaRestartReadyMsg(ConsensusMsg):
     seq_num: int
     reason: int
     signature: bytes
+    epoch: int = 0
     SPEC = [("sender_id", "u32"), ("seq_num", "u64"), ("reason", "u8"),
-            ("signature", "bytes")]
+            ("epoch", "u64"), ("signature", "bytes")]
 
 
 @register

@@ -33,6 +33,7 @@ from tpubft.consensus.clients_manager import ClientsManager
 from tpubft.consensus.collectors import (CollectorPool, CombineResult,
                                          ShareCollector)
 from tpubft.consensus.controller import CommitPathController
+from tpubft.consensus.epoch import EpochManager
 from tpubft.consensus.incoming import Dispatcher, IncomingMsgsStorage
 from tpubft.consensus.keys import ClusterKeys
 from tpubft.consensus.persistent import (InMemoryPersistentStorage,
@@ -298,6 +299,9 @@ class Replica(IReceiver):
         self.m_last_stable = self.metrics.register_gauge("last_stable_seq")
         self.m_retransmitted = self.metrics.register_gauge(
             "retransmitted_total")
+        self.m_epoch = self.metrics.register_gauge("epoch")
+        self.m_epoch_dropped = self.metrics.register_counter(
+            "epoch_mismatch_dropped")
         # a recovered replica must REPORT its recovered position — these
         # gauges otherwise read 0 until the next execution, making an
         # idle-after-restart replica look like it lost its state
@@ -338,6 +342,9 @@ class Replica(IReceiver):
         self.control = ControlStateManager(
             ReservedPagesClient(self.res_pages,
                                 ControlStateManager.CATEGORY))
+        self.epoch_mgr = EpochManager(
+            ReservedPagesClient(self.res_pages, EpochManager.CATEGORY))
+        self.m_epoch.set(self.epoch_mgr.boot_adopt(self.last_executed))
         self.reconfig = None  # ReconfigurationDispatcher (kvbc wiring)
         self.cron_table = CronTable(
             ReservedPagesClient(self.res_pages, CronTable.CATEGORY))
@@ -462,6 +469,11 @@ class Replica(IReceiver):
         self.cron_table.reload()
         self.control.reload()
         self._load_client_replies_from_pages()
+        # the fetched pages may carry a bumped epoch (we missed a
+        # reconfiguration): adopt it if the transferred checkpoint is
+        # past the era boundary, or every peer message gets dropped by
+        # the era gate while we keep stamping a dead epoch
+        self.m_epoch.set(self.epoch_mgr.boot_adopt(seq))
         self._last_progress = time.monotonic()
 
     def set_reconfiguration(self, dispatcher) -> None:
@@ -542,7 +554,25 @@ class Replica(IReceiver):
                        s=getattr(msg, "seq_num", None) or "-"):
             self._dispatch_external(sender, msg)
 
+    @property
+    def epoch(self) -> int:
+        """The reconfiguration era this replica stamps on (and requires
+        of) protocol messages (reference EpochManager selfEpochNumber)."""
+        return self.epoch_mgr.self_epoch
+
     def _dispatch_external(self, sender: int, msg) -> None:
+        # era gate (reference: per-message epochNum checks, e.g.
+        # PrePrepareMsg.cpp:91, ReplicaImp.cpp:2313): traffic from an
+        # older reconfiguration era is dead — drop it before any handler.
+        # A HIGHER-epoch checkpoint is the one exception: it is evidence
+        # this replica missed a reconfiguration, and checkpoints drive
+        # state-transfer catch-up (which also carries the new epoch page).
+        msg_epoch = getattr(msg, "epoch", None)
+        if msg_epoch is not None and msg_epoch != self.epoch_mgr.self_epoch:
+            if not (isinstance(msg, m.CheckpointMsg)
+                    and msg_epoch > self.epoch_mgr.self_epoch):
+                self.m_epoch_dropped.inc()
+                return
         if isinstance(msg, m.ClientRequestMsg):
             # accepted from the client itself OR forwarded by a replica;
             # either way the client's own signature is verified next
@@ -894,6 +924,7 @@ class Replica(IReceiver):
         raw_reqs = [r.pack() for r in batch]
         pp = m.PrePrepareMsg(
             sender_id=self.id, view=self.view, seq_num=seq,
+            epoch=self.epoch,
             first_path=int(self.controller.current_path),
             time=(self.time_service.primary_stamp()
                   if self.cfg.time_service_enabled
@@ -1072,7 +1103,8 @@ class Replica(IReceiver):
         d = share_digest("prepare", self.view, pp.seq_num, pp.digest())
         share = self.slow_signer.sign_share(d)
         msg = m.PreparePartialMsg(sender_id=self.id, view=self.view,
-                                  seq_num=pp.seq_num, digest=d, sig=share)
+                                  seq_num=pp.seq_num, digest=d, sig=share,
+                                  epoch=self.epoch)
         collector_id = self.info.collector_for(self.view, pp.seq_num)
         if collector_id == self.id:
             self._on_share(msg, "prepare")
@@ -1084,7 +1116,8 @@ class Replica(IReceiver):
         d = share_digest("commit", self.view, pp.seq_num, pp.digest())
         share = self.slow_signer.sign_share(d)
         msg = m.CommitPartialMsg(sender_id=self.id, view=self.view,
-                                 seq_num=pp.seq_num, digest=d, sig=share)
+                                 seq_num=pp.seq_num, digest=d, sig=share,
+                                 epoch=self.epoch)
         collector_id = self.info.collector_for(self.view, pp.seq_num)
         if collector_id == self.id:
             self._on_share(msg, "commit")
@@ -1103,6 +1136,7 @@ class Replica(IReceiver):
         signer, _, tag = self._fast_tools(pp.first_path)
         d = share_digest(tag, self.view, pp.seq_num, pp.digest())
         msg = m.PartialCommitProofMsg(sender_id=self.id, view=self.view,
+                                      epoch=self.epoch,
                                       seq_num=pp.seq_num, digest=d,
                                       sig=signer.sign_share(d),
                                       path=pp.first_path)
@@ -1186,7 +1220,8 @@ class Replica(IReceiver):
             d = share_digest(tag, self.view, pp.seq_num, pp.digest())
             full = m.FullCommitProofMsg(sender_id=self.id, view=self.view,
                                         seq_num=res.seq_num, digest=d,
-                                        sig=res.combined_sig)
+                                        sig=res.combined_sig,
+                                        epoch=self.epoch)
             self._broadcast_tracked(full)
             self._accept_full_commit_proof(full)
             return
@@ -1194,11 +1229,13 @@ class Replica(IReceiver):
         if res.kind == "prepare":
             full = m.PrepareFullMsg(sender_id=self.id, view=self.view,
                                     seq_num=res.seq_num, digest=d,
-                                    sig=res.combined_sig)
+                                    sig=res.combined_sig,
+                                    epoch=self.epoch)
             self._broadcast_tracked(full)
             self._accept_prepare_full(full)
         elif res.kind == "commit":
             full = m.CommitFullMsg(sender_id=self.id, view=self.view,
+                                   epoch=self.epoch,
                                    seq_num=res.seq_num, digest=d,
                                    sig=res.combined_sig)
             self._broadcast_tracked(full)
@@ -1387,7 +1424,7 @@ class Replica(IReceiver):
                     and info.pre_prepare.first_path != int(m.CommitPath.SLOW)
                     and now - info.received_at > timeout_s):
                 ssc = m.StartSlowCommitMsg(sender_id=self.id, view=self.view,
-                                           seq_num=seq)
+                                           seq_num=seq, epoch=self.epoch)
                 self._broadcast(ssc)
                 self._start_slow_path(info)
 
@@ -1678,7 +1715,7 @@ class Replica(IReceiver):
         self._restart_announced = point
         msg = m.ReplicaRestartReadyMsg(
             sender_id=self.id, seq_num=point,
-            reason=0, signature=b"")
+            reason=0, signature=b"", epoch=self.epoch)
         msg.signature = self.sig.sign(msg.signed_payload())
         self._my_restart_vote = msg
         log.info("wedged at %d: announcing restart readiness", point)
@@ -1732,7 +1769,7 @@ class Replica(IReceiver):
             self.state_transfer.on_checkpoint_created(seq, state_digest)
         ck = m.CheckpointMsg(sender_id=self.id, seq_num=seq,
                              state_digest=state_digest,
-                             is_stable=False,
+                             is_stable=False, epoch=self.epoch,
                              res_pages_digest=self.res_pages.digest(),
                              signature=b"")
         ck.signature = self.sig.sign(ck.signed_payload())
@@ -1937,7 +1974,8 @@ class Replica(IReceiver):
                         "(primary=%d)", view, self.info.primary_of_view(view))
         self._complained_views.add(view)
         msg = m.ReplicaAsksToLeaveViewMsg(sender_id=self.id, view=view,
-                                          reason=reason, signature=b"")
+                                          reason=reason, signature=b"",
+                                          epoch=self.epoch)
         msg.signature = self.sig.sign(msg.signed_payload())
         if first:
             self.vc.add_complaint(msg)
@@ -1979,7 +2017,8 @@ class Replica(IReceiver):
                        key=lambda c: (c.seq_num, c.kind))
         vc = m.ViewChangeMsg(sender_id=self.id, new_view=target,
                              last_stable_seq=self.last_stable,
-                             prepared=certs, signature=b"")
+                             prepared=certs, signature=b"",
+                             epoch=self.epoch)
         vc.signature = self.sig.sign(vc.signed_payload())
         self._my_vc_msg = vc
         self.vc.add_view_change(vc)
@@ -2036,7 +2075,7 @@ class Replica(IReceiver):
                 return
             quorum = self.vc.quorum_for_new_view(new_view)
             nv = m.NewViewMsg(
-                sender_id=self.id, new_view=new_view,
+                sender_id=self.id, new_view=new_view, epoch=self.epoch,
                 view_change_digests=[
                     m.ReplicaDigest(replica=vc.sender_id, digest=vc.digest())
                     for vc in quorum],
@@ -2226,6 +2265,7 @@ class Replica(IReceiver):
                 requests, pp_time = [], 0
             pp = m.PrePrepareMsg(
                 sender_id=self.id, view=self.view, seq_num=seq,
+                epoch=self.epoch,
                 first_path=int(m.CommitPath.SLOW), time=pp_time,
                 requests_digest=m.PrePrepareMsg.compute_requests_digest(
                     requests),
@@ -2267,7 +2307,7 @@ class Replica(IReceiver):
             return
         self.comm.send(dest, m.SimpleAckMsg(
             sender_id=self.id, seq_num=seq, view=self.view,
-            acked_msg_code=code).pack())
+            acked_msg_code=code, epoch=self.epoch).pack())
 
     def _tran(self):
         storage = self.storage

@@ -110,6 +110,13 @@ class RestartHandler(IReconfigurationHandler):
     def handle(self, cmd, seq_num, replica):
         if not isinstance(cmd, rm.RestartCommand):
             return None
+        # the restart boundary starts a new era: the bumped GLOBAL epoch
+        # rides reserved pages; each replica adopts it when it comes back
+        # up past the wedge (reference EpochManager startNewEpoch flow)
+        effective = (replica.control.wedge_point
+                     if replica.control.wedge_point is not None
+                     else compute_stop_point(seq_num, replica.cfg))
+        replica.epoch_mgr.bump_global_at(seq_num, effective)
         replica.control.mark_restart_ready()
         return rm.ReconfigReply(success=True)
 
@@ -152,7 +159,12 @@ class AddRemoveWithWedgeHandler(IReconfigurationHandler):
             return None
         replica.res_pages.save(self.CATEGORY, 0,
                                cmd.config_descriptor.encode())
+        # new configuration = new era. Live replicas keep ordering in the
+        # old epoch until the wedge point; whoever restarts into the new
+        # config past it adopts the bumped global number from reserved
+        # pages and rejects pre-epoch traffic (reference EpochManager).
         stop = compute_stop_point(seq_num, replica.cfg)
+        replica.epoch_mgr.bump_global_at(seq_num, stop)
         replica.control.set_wedge_point(stop)
         return rm.ReconfigReply(success=True, data=str(stop))
 
