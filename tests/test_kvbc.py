@@ -147,6 +147,60 @@ def test_digest_chain_and_merkle_proof():
                                    hashlib.sha256(b"1").digest(), p)
 
 
+def test_versioned_merkle_proofs():
+    """Historical key@block proves against THAT block's root (reference
+    versioned tree.cpp): overwrites and deletes at later blocks must not
+    invalidate earlier versions' proofs."""
+    bc = _bc()
+    bc.add_block(BlockUpdates().put("m", b"a", b"1", cat_type=BLOCK_MERKLE))
+    bc.add_block(BlockUpdates().put("m", b"a", b"2", cat_type=BLOCK_MERKLE)
+                               .put("m", b"b", b"x", cat_type=BLOCK_MERKLE))
+    bc.add_block(BlockUpdates().delete("m", b"a", cat_type=BLOCK_MERKLE))
+
+    for blk, val in ((1, b"1"), (2, b"2"), (3, None)):
+        root = bc.merkle_root_at("m", blk)
+        assert root == bc.get_block(blk).category_digests["m"]
+        p = bc.prove_at("m", b"a", blk)
+        vh = hashlib.sha256(val).digest() if val is not None else None
+        assert SparseMerkleTree.verify(root, b"a", vh, p), (blk, val)
+        # the proof must NOT verify against the wrong era's root
+        for other in (1, 2, 3):
+            if other != blk and bc.merkle_root_at("m", other) != root:
+                assert not SparseMerkleTree.verify(
+                    bc.merkle_root_at("m", other), b"a", vh, p)
+    # value-hash archive agrees
+    assert bc.merkle_value_hash_at("m", b"a", 1) == \
+        hashlib.sha256(b"1").digest()
+    assert bc.merkle_value_hash_at("m", b"a", 3) is None
+    # a category untouched at a block: root falls back to newest <= block
+    bc.add_block(BlockUpdates().put("v", b"k", b"z"))
+    assert bc.merkle_root_at("m", 4) == bc.merkle_root_at("m", 3)
+    # latest-path proofs unchanged
+    rootL = bc.merkle_root("m")
+    pL = bc.prove("m", b"b")
+    assert SparseMerkleTree.verify(rootL, b"b",
+                                   hashlib.sha256(b"x").digest(), pL)
+
+
+def test_versioned_merkle_prune_gc():
+    """Pruning drops superseded archive rows but keeps every retained
+    block's proofs working."""
+    bc = _bc()
+    for i in range(1, 7):
+        bc.add_block(BlockUpdates().put("m", b"k", str(i).encode(),
+                                        cat_type=BLOCK_MERKLE))
+    t = bc._tree("m")
+    rows_before = sum(1 for _ in bc._db.range_iter(t._arch_family))
+    bc.delete_blocks_until(5)
+    rows_after = sum(1 for _ in bc._db.range_iter(t._arch_family))
+    assert rows_after < rows_before
+    for blk, val in ((5, b"5"), (6, b"6")):
+        root = bc.merkle_root_at("m", blk)
+        p = bc.prove_at("m", b"k", blk)
+        assert SparseMerkleTree.verify(root, b"k",
+                                       hashlib.sha256(val).digest(), p)
+
+
 def test_pruning():
     bc = _bc()
     for i in range(5):

@@ -53,6 +53,91 @@ def test_read_state_with_hash_quorum():
             s.stop()
 
 
+def _merkle_chain() -> KeyValueBlockchain:
+    from tpubft.kvbc import BLOCK_MERKLE
+    bc = KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+    bc.add_block(BlockUpdates().put("m", b"k", b"v1",
+                                    cat_type=BLOCK_MERKLE))
+    bc.add_block(BlockUpdates().put("m", b"k", b"v2",
+                                    cat_type=BLOCK_MERKLE))
+    bc.add_block(BlockUpdates().delete("m", b"k", cat_type=BLOCK_MERKLE))
+    return bc
+
+
+def test_versioned_proof_over_thin_replica():
+    """Historical key@block verifies against that block's root with an
+    f+1 root quorum — the whole reference versioned-proof flow through
+    the thin-replica wire protocol."""
+    import hashlib
+    chains = [_merkle_chain() for _ in range(3)]
+    servers = _servers(chains)
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        assert trc.verified_proof("m", b"k", 1, value=b"v1") == \
+            hashlib.sha256(b"v1").digest()
+        assert trc.verified_proof("m", b"k", 2, value=b"v2") == \
+            hashlib.sha256(b"v2").digest()
+        assert trc.verified_proof("m", b"k", 3) is None  # deleted
+        # wrong claimed value fails the hash binding
+        with pytest.raises(ValueError):
+            trc.verified_proof("m", b"k", 1, value=b"forged")
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_versioned_proof_rejects_block_substitution():
+    """A Byzantine data server answering with an HONEST proof for the
+    wrong block (where the key still existed) must be rejected — the
+    block binding is part of what is proven."""
+    class _SubstitutingServer(ThinReplicaServer):
+        def _serve_proof(self, conn, req):
+            req.block_id = 1            # substitute pre-delete state
+            super()._serve_proof(conn, req)
+
+    chains = [_merkle_chain() for _ in range(3)]
+    evil = _SubstitutingServer(chains[0], FilterSpec(category="kv"))
+    evil.start()
+    servers = [evil] + _servers(chains[1:])
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        with pytest.raises(ValueError, match="asked 3"):
+            trc.verified_proof("m", b"k", 3)   # deleted at 3
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_versioned_proof_detects_lying_data_server():
+    """A data server whose chain diverges serves a self-consistent proof
+    for its forged history — the f+1 root quorum is what kills it."""
+    from tpubft.kvbc import BLOCK_MERKLE
+    forged = KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+    forged.add_block(BlockUpdates().put("m", b"k", b"v1",
+                                        cat_type=BLOCK_MERKLE))
+    forged.add_block(BlockUpdates().put("m", b"k", b"evil",
+                                        cat_type=BLOCK_MERKLE))
+    forged.add_block(BlockUpdates().delete("m", b"k",
+                                           cat_type=BLOCK_MERKLE))
+    honest = [_merkle_chain() for _ in range(2)]
+    servers = _servers([forged] + honest)
+    try:
+        trc = ThinReplicaClient([("127.0.0.1", s.port) for s in servers],
+                                f_val=1)
+        # forged block-2 root never gets a second vote
+        with pytest.raises(ValueError):
+            trc.verified_proof("m", b"k", 2)
+        # blocks where the chains agree still verify
+        import hashlib
+        assert trc.verified_proof("m", b"k", 1) == \
+            hashlib.sha256(b"v1").digest()
+    finally:
+        for s in servers:
+            s.stop()
+
+
 def test_read_state_detects_forged_data_server():
     honest = [_chain_with(3) for _ in range(2)]
     forged = _chain_with(3)

@@ -246,3 +246,38 @@ class KeyValueBlockchain(BlockStoreMixin):
 
     def merkle_root(self, category: str) -> bytes:
         return self._tree(category).root()
+
+    # ---- versioned proofs (reference tree.cpp serves historical
+    # versions; roots are anchored in each block's category digests) ----
+    def prove_at(self, category: str, key: bytes, block_id: int):
+        """Merkle proof for the key AS OF `block_id` (any retained
+        block). Verify against `merkle_root_at(category, block_id)`."""
+        return self._tree(category).prove_at(key, block_id)
+
+    def merkle_root_at(self, category: str,
+                       block_id: int) -> Optional[bytes]:
+        """The category's root at a block — read from the BLOCK ROW (the
+        agreed chain), not the tree, so a verifier checks proofs against
+        consensus-certified state."""
+        blk = self.get_block(block_id)
+        if blk is not None and category in blk.category_digests:
+            return blk.category_digests[category]
+        # the category may not have been touched at exactly block_id:
+        # its root there is the newest tree version ≤ block_id
+        return self._tree(category).root_at(block_id)
+
+    def merkle_value_hash_at(self, category: str, key: bytes,
+                             block_id: int) -> Optional[bytes]:
+        return self._tree(category).get_value_hash_at(key, block_id)
+
+    def delete_blocks_until(self, until_block_id: int) -> int:
+        """Prune block bodies AND the merkle archives' stale nodes: a
+        proof can only be asked against a retained block's root, so
+        archive rows superseded before the new genesis are garbage
+        (reference stale-node GC on pruning). Categories come from the
+        durable registry — the in-memory tree cache forgets categories
+        untouched since the last restart."""
+        genesis = super().delete_blocks_until(until_block_id)
+        for name_b, _ in self._db.range_iter(cat.SMT_REGISTRY_FAMILY):
+            self._tree(name_b.decode()).prune_versions(genesis)
+        return genesis

@@ -22,6 +22,10 @@ BLOCK_MERKLE = "block_merkle"
 VERSIONED_KV = "versioned_kv"
 IMMUTABLE = "immutable"
 
+# names of every merkle category ever written (key = category, value
+# empty) — survives restarts so pruning can GC all tree archives
+SMT_REGISTRY_FAMILY = b"smt.registry"
+
 CATEGORY_TYPES = (BLOCK_MERKLE, VERSIONED_KV, IMMUTABLE)
 
 
@@ -92,9 +96,14 @@ def stage_category(db: IDBClient, wb: WriteBatch, category: str,
     category's state digest contribution for the block."""
     if cat_type == BLOCK_MERKLE:
         tree = merkle_trees(category)
+        # durable registry of merkle categories: archive GC at prune time
+        # must find every tree ever written, including ones untouched
+        # since the last process restart (the in-memory tree cache alone
+        # forgets them)
+        wb.put(category.encode(), b"", SMT_REGISTRY_FAMILY)
         leaf = {k: (hashlib.sha256(v).digest() if v is not None else None)
                 for k, v in updates.kv.items()}
-        root = tree.update_batch(leaf, batch=wb)
+        root = tree.update_batch(leaf, batch=wb, version=block_id)
         for k, v in updates.kv.items():
             if v is None:
                 wb.delete(k, _fam(category, "data"))

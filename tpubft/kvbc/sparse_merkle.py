@@ -11,9 +11,17 @@ amortize device dispatch.
 Layout: key -> path = SHA-256(key), 256 levels. Only non-default nodes are
 persisted (family `smt`); empty subtrees hash to precomputed defaults.
 Leaf hash = H(0x00 || path || value_hash); inner = H(0x01 || l || r).
-The tree mutates in place (latest version); historical roots are retained
-by the blockchain layer per block, and proofs are served for the latest
-state.
+
+Versioning (reference tree.cpp is versioned; internal_node.cpp tracks
+stale nodes): the LATEST state mutates in place — the hot path reads and
+writes exactly one row per node, no version walk. Every node change is
+additionally appended to an archive family keyed `node_key || version`
+(version = block id), so `prove_at(key, version)` can rebuild the audit
+path of any retained block by taking, per node, the newest archive row
+at or below that version (absence = default subtree — any older change
+would have been archived). `prune_versions(before)` is the stale-node
+GC: it drops archive rows superseded before the retention point, exactly
+the role of the reference's stale-node index.
 """
 from __future__ import annotations
 
@@ -71,6 +79,8 @@ class SparseMerkleTree:
         self._db = db
         self._family = family
         self._leaf_family = family + b".leaf"
+        self._arch_family = family + b".arch"        # node_key+ver8 -> hash
+        self._leaf_arch_family = family + b".leafarch"  # path+ver8 -> vh
         self._use_device = use_device
 
     # ---- reads ----
@@ -90,14 +100,18 @@ class SparseMerkleTree:
 
     # ---- batch update ----
     def update_batch(self, updates: Dict[bytes, Optional[bytes]],
-                     batch: Optional[WriteBatch] = None) -> bytes:
+                     batch: Optional[WriteBatch] = None,
+                     version: int = 0) -> bytes:
         """Apply {key: value_hash or None(delete)}; returns the new root.
         If `batch` is given, node writes are staged into it (caller
-        commits atomically with the block); otherwise committed here."""
+        commits atomically with the block); otherwise committed here.
+        `version` (the block id) > 0 additionally archives every changed
+        node so `prove_at` can serve this version later."""
         if not updates:
             return self.root()
         own_batch = batch is None
         wb = WriteBatch() if own_batch else batch
+        ver = version.to_bytes(8, "big") if version > 0 else None
 
         # leaf level
         changed: Dict[int, bytes] = {}
@@ -110,7 +124,10 @@ class SparseMerkleTree:
             else:
                 changed[bits] = _leaf_hash(path, vh)
                 wb.put(path, vh, self._leaf_family)
-        self._stage_level(wb, DEPTH, changed)
+            if ver is not None:
+                wb.put(path + ver, vh if vh is not None else b"",
+                       self._leaf_arch_family)
+        self._stage_level(wb, DEPTH, changed, ver)
 
         # ascend, rehashing all changed nodes of each level in one batch
         for depth in range(DEPTH, 0, -1):
@@ -126,14 +143,15 @@ class SparseMerkleTree:
                 msgs.append(b"\x01" + left + right)
             hashes = _hash_level(msgs, self._use_device)
             changed = dict(zip(parents, hashes))
-            self._stage_level(wb, depth - 1, changed)
+            self._stage_level(wb, depth - 1, changed, ver)
 
         if own_batch:
             self._db.write(wb)
         return changed[0]
 
     def _stage_level(self, wb: WriteBatch, depth: int,
-                     nodes: Dict[int, bytes]) -> None:
+                     nodes: Dict[int, bytes],
+                     ver: Optional[bytes] = None) -> None:
         default = _DEFAULTS[depth]
         for bits, h in nodes.items():
             k = _node_key(depth, bits)
@@ -141,16 +159,80 @@ class SparseMerkleTree:
                 wb.delete(k, self._family)
             else:
                 wb.put(k, h, self._family)
+            if ver is not None:
+                # archive row; default is stored as empty so a historical
+                # walk can tell "reverted to default at ver" from "never
+                # touched" (the latter = default since genesis)
+                wb.put(k + ver, b"" if h == default else h,
+                       self._arch_family)
+
+    # ---- versioned reads ----
+    def _newest_row_at(self, family: bytes, prefix: bytes,
+                       version: int) -> Optional[bytes]:
+        """Newest archive row for `prefix` at or below `version`, or None
+        if the node was never written by then. Rows of one node share a
+        fixed-length prefix, so the range scan is exact."""
+        row = self._db.last_in_range(
+            family, start=prefix,
+            end=prefix + (version + 1).to_bytes(8, "big"))
+        return row[1] if row else None
+
+    def _node_at(self, depth: int, path_bits: int, version: int) -> bytes:
+        row = self._newest_row_at(self._arch_family,
+                                  _node_key(depth, path_bits), version)
+        if row is None or row == b"":
+            return _DEFAULTS[depth]
+        return row
+
+    def root_at(self, version: int) -> bytes:
+        return self._node_at(0, 0, version)
+
+    def get_value_hash_at(self, key: bytes,
+                          version: int) -> Optional[bytes]:
+        path = hashlib.sha256(key).digest()
+        row = self._newest_row_at(self._leaf_arch_family, path, version)
+        return row if row else None        # b"" = deleted at that version
+
+    def prove_at(self, key: bytes, version: int) -> Proof:
+        """Audit path as of `version` (a retained block id). Costs one
+        archive range-scan per level — proof serving, not the hot path."""
+        return self._prove_with(
+            key, lambda depth, bits: self._node_at(depth, bits, version))
+
+    def prune_versions(self, before_version: int) -> int:
+        """Stale-node GC (reference stale-node index role): drop archive
+        rows SUPERSEDED at or below `before_version` — for each node,
+        every row older than its newest row ≤ before stays unreachable
+        from any retained root ≥ before. Returns rows deleted."""
+        wb = WriteBatch()
+        deleted = 0
+        for fam in (self._arch_family, self._leaf_arch_family):
+            prev_key: Optional[bytes] = None   # candidate superseded row
+            for k, _v in self._db.range_iter(fam):
+                prefix, ver = k[:-8], int.from_bytes(k[-8:], "big")
+                if (prev_key is not None and prev_key[:-8] == prefix
+                        and ver <= before_version):
+                    wb.delete(prev_key, fam)   # newer row ≤ before exists
+                    deleted += 1
+                prev_key = k if ver <= before_version else None
+        if deleted:
+            self._db.write(wb)
+        return deleted
 
     # ---- proofs ----
     def prove(self, key: bytes) -> Proof:
+        return self._prove_with(key, self._node)
+
+    def _prove_with(self, key: bytes, node) -> Proof:
+        """One audit-path walk for both latest and versioned proofs —
+        the bitmap compression must never diverge between the two."""
         path = hashlib.sha256(key).digest()
         bits = int.from_bytes(path, "big")
         bitmap = bytearray(32)
         siblings: List[bytes] = []
         node_bits = bits
         for depth in range(DEPTH, 0, -1):
-            sib = self._node(depth, node_bits ^ 1)
+            sib = node(depth, node_bits ^ 1)
             if sib != _DEFAULTS[depth]:
                 i = DEPTH - depth
                 bitmap[i // 8] |= 1 << (i % 8)

@@ -103,6 +103,22 @@ class ThinReplicaClient:
         # hash what we RECEIVED — the data server's self-reported digest
         # proves nothing (a forger would ship honest digest + fake data)
         local_digest = tm.update_hash(done.block_id, list(state.items()))
+        if not self._collect_votes(
+                lambda: tm.ReadStateHashRequest(block_id=done.block_id,
+                                                key_prefix=self.key_prefix),
+                lambda h: (isinstance(h, tm.StateDone)
+                           and h.digest == local_digest
+                           and h.block_id == done.block_id)):
+            raise ValueError("state hash quorum not reached")
+        self._delivered_up_to = done.block_id
+        return state
+
+    def _collect_votes(self, make_request, matches) -> bool:
+        """The trust kernel shared by every one-shot verification: ask f
+        OTHER servers, count those whose reply `matches`; a server
+        answering ProtocolError('ahead') is still catching up and gets
+        retried until the deadline. True once f votes are in (f+1 total
+        with the data server ⇒ at least one honest replica agrees)."""
         votes = 0
         deadline = time.monotonic() + 10
         pending = list(self.endpoints[1:])
@@ -110,23 +126,67 @@ class ThinReplicaClient:
             ep = pending.pop(0)
             try:
                 c = _Conn(ep)
-                c.send(tm.ReadStateHashRequest(block_id=done.block_id,
-                                               key_prefix=self.key_prefix))
-                h = c.recv()
+                c.send(make_request())
+                reply = c.recv()
                 c.close()
             except OSError:
                 continue
-            if isinstance(h, tm.StateDone) and h.digest == local_digest \
-                    and h.block_id == done.block_id:
+            if matches(reply):
                 votes += 1
-            elif isinstance(h, tm.ProtocolError) and h.reason == "ahead":
-                # hash server still catching up to our snapshot height
+            elif isinstance(reply, tm.ProtocolError) \
+                    and reply.reason == "ahead":
                 pending.append(ep)
                 time.sleep(0.2)
-        if votes < self.f:
-            raise ValueError("state hash quorum not reached")
-        self._delivered_up_to = done.block_id
-        return state
+        return votes >= self.f
+
+    # ---- versioned merkle proof verification ----
+    def verified_proof(self, category: str, key: bytes,
+                       block_id: int,
+                       value: Optional[bytes] = None) -> Optional[bytes]:
+        """Prove `key`'s state AS OF `block_id` (reference versioned
+        sparse-merkle proofs) without trusting any single server:
+
+        1. fetch proof + root + value hash from the data server,
+        2. verify the audit path locally against the root,
+        3. require the SAME root for that block from f other servers
+           (f+1 total ⇒ at least one honest replica vouches for it),
+        4. if the caller supplies the `value` it believes, bind it to
+           the proven value hash.
+
+        Returns the proven value hash (None = key absent at that block);
+        raises ValueError when verification fails."""
+        import hashlib
+
+        from tpubft.kvbc.sparse_merkle import Proof, SparseMerkleTree
+        c = _Conn(self.endpoints[0])
+        c.send(tm.ReadProofRequest(block_id=block_id, category=category,
+                                   key=key))
+        reply = c.recv()
+        c.close()
+        if not isinstance(reply, tm.ProofReply):
+            raise ValueError(f"no proof from data server: {reply!r}")
+        if block_id and reply.block_id != block_id:
+            # a proof for ANOTHER retained block would verify and gather
+            # an honest quorum for that block's root — the binding to the
+            # asked block is part of what is being proven
+            raise ValueError(f"proof is for block {reply.block_id}, "
+                             f"asked {block_id}")
+        vh = reply.value_hash or None
+        if not SparseMerkleTree.verify(
+                reply.root, key, vh,
+                Proof(bitmap=reply.bitmap, siblings=list(reply.siblings))):
+            raise ValueError("audit path does not reach the root")
+        if not self._collect_votes(
+                lambda: tm.ReadProofRequest(block_id=reply.block_id,
+                                            category=category, key=key),
+                lambda other: (isinstance(other, tm.ProofReply)
+                               and other.block_id == reply.block_id
+                               and other.root == reply.root)):
+            raise ValueError("proof root quorum not reached")
+        if value is not None \
+                and hashlib.sha256(value).digest() != (vh or b""):
+            raise ValueError("value does not match proven hash")
+        return vh
 
     # ---- live subscription ----
     STALL_TIMEOUT_S = 5.0

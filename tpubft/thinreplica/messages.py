@@ -74,10 +74,34 @@ class ProtocolError:
     SPEC = [("reason", "str")]
 
 
+@dataclass
+class ReadProofRequest:
+    """Merkle proof for a block_merkle key AS OF a retained block
+    (reference versioned sparse_merkle proofs via the kvbc adapter)."""
+    ID = 9
+    block_id: int = 0
+    category: str = ""
+    key: bytes = b""
+    SPEC = [("block_id", "u64"), ("category", "str"), ("key", "bytes")]
+
+
+@dataclass
+class ProofReply:
+    ID = 10
+    block_id: int = 0
+    root: bytes = b""           # category root anchored in that block
+    value_hash: bytes = b""     # b"" = key absent at that block
+    bitmap: bytes = b""         # sparse_merkle.Proof compressed path
+    siblings: List[bytes] = field(default_factory=list)
+    SPEC = [("block_id", "u64"), ("root", "bytes"),
+            ("value_hash", "bytes"), ("bitmap", "bytes"),
+            ("siblings", ("list", "bytes"))]
+
+
 _TYPES = {cls.ID: cls for cls in
           (ReadStateRequest, ReadStateHashRequest, SubscribeRequest,
            UnsubscribeRequest, Update, UpdateHash, StateDone,
-           ProtocolError)}
+           ProtocolError, ReadProofRequest, ProofReply)}
 
 
 def pack(msg) -> bytes:

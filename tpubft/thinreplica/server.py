@@ -122,6 +122,8 @@ class ThinReplicaServer:
                 self._serve_state_hash(conn, req)
             elif isinstance(req, tm.SubscribeRequest):
                 self._serve_subscription(conn, req)
+            elif isinstance(req, tm.ReadProofRequest):
+                self._serve_proof(conn, req)
             else:
                 conn.sendall(tm.pack(tm.ProtocolError(reason="bad request")))
         except Exception:  # noqa: BLE001 — connection teardown
@@ -207,6 +209,31 @@ class ThinReplicaServer:
         block_id, kv = self._state_snapshot(req.key_prefix)
         conn.sendall(tm.pack(tm.StateDone(
             block_id=block_id, digest=tm.update_hash(block_id, kv))))
+
+    def _serve_proof(self, conn: socket.socket,
+                     req: tm.ReadProofRequest) -> None:
+        """Versioned merkle proof (reference sparse_merkle historical
+        versions): audit path for key@block plus the root anchored in
+        that block's category digests. The CLIENT verifies — this server
+        is untrusted; the root gains authority from an f+1 cross-server
+        match."""
+        bid = req.block_id or self.bc.last_block_id
+        if bid > self.bc.last_block_id:
+            conn.sendall(tm.pack(tm.ProtocolError(reason="ahead")))
+            return
+        if bid < self.bc.genesis_block_id:
+            conn.sendall(tm.pack(tm.ProtocolError(reason="pruned")))
+            return
+        try:
+            proof = self.bc.prove_at(req.category, req.key, bid)
+            root = self.bc.merkle_root_at(req.category, bid) or b""
+            vh = self.bc.merkle_value_hash_at(req.category, req.key, bid)
+        except Exception:  # noqa: BLE001 — malformed request data
+            conn.sendall(tm.pack(tm.ProtocolError(reason="bad proof req")))
+            return
+        conn.sendall(tm.pack(tm.ProofReply(
+            block_id=bid, root=root, value_hash=vh or b"",
+            bitmap=proof.bitmap, siblings=proof.siblings)))
 
     # ---- subscriptions ----
     def _block_kv(self, block_id: int,
