@@ -77,6 +77,43 @@ def test_time_service_manager():
     assert ts2.last_agreed_ms == t1      # persisted
 
 
+def test_time_service_voting_envelope():
+    """Replica time voting: with f+1 clocks represented, a primary stamp
+    outside the MEDIAN's skew bound is rejected even when the local
+    clock alone would accept it (local clock racing with the primary)."""
+    now = [1000.0]          # local clock, seconds — skewed 5s AHEAD
+    mono = [50.0]
+    pages = ReservedPagesClient(ReservedPages(MemoryDB()), "time")
+    ts = TimeServiceManager(pages, max_skew_ms=100,
+                            clock=lambda: now[0], mono=lambda: mono[0])
+    stamp = 1000_000 - 5000 + 4000      # 4s behind local, 1s ahead median
+    # before quorum: only the local bound applies — stamp accepted
+    assert ts.validate(stamp)
+    # opinions from 2 peers put the cluster median 5s behind our clock
+    ts.opinion_quorum = 3               # f=1 -> 2f+1 incl. self
+    assert ts.add_opinion(1, 1000_000 - 5000)
+    assert ts.add_opinion(2, 1000_000 - 5100)
+    # replayed (non-monotone) and wildly implausible opinions are refused
+    assert not ts.add_opinion(1, 1000_000 - 60_000)
+    assert not ts.add_opinion(2, 1000_000 + 3_600_000)
+    median = ts.envelope_median_ms()
+    assert median is not None and abs(median - (1000_000 - 5000)) <= 200
+    # the same stamp is now outside the agreed envelope -> rejected
+    assert not ts.validate(stamp)
+    # a stamp near the cluster median is accepted
+    assert ts.validate(1000_000 - 5000 + 50)
+    # opinions age with monotonic time: extrapolation keeps the envelope
+    mono[0] += 2.0
+    now[0] += 2.0
+    assert ts.validate(1000_000 - 5000 + 2050)
+    # stale opinions (past TTL) drop out of the estimate; below quorum
+    # the envelope deactivates and only local bounds apply again
+    mono[0] += 11.0
+    now[0] += 11.0
+    assert ts.envelope_median_ms() is None
+    assert ts.validate(int(now[0] * 1000) - 3000)
+
+
 # ---------------- through consensus ----------------
 
 @pytest.mark.slow

@@ -183,15 +183,62 @@ class TimeServiceManager:
 
     CATEGORY = "time"
 
+    # opinions older than this are stale (their holder may be dead; the
+    # estimate would drift with the receipt-age extrapolation)
+    OPINION_TTL_S = 10.0
+
     def __init__(self, pages: ReservedPagesClient,
                  max_skew_ms: int = 1000,
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 mono: Callable[[], float] = time.monotonic) -> None:
         self._pages = pages
         self._clock = clock
+        self._mono = mono
         self.max_skew_ms = max_skew_ms
         raw = pages.load()
         self.last_agreed_ms = int.from_bytes(raw, "big") if raw else 0
         self._last_stamp = 0
+        # replica time voting: peer id -> (claimed t_ms, receipt mono).
+        # quorum = 2f+1 clocks incl. our own: the median of >= 2f+1
+        # samples with at most f faulty is BRACKETED by honest clocks —
+        # f+1 would let f fresh faulty opinions plus our own clock put
+        # the median entirely under attacker control.
+        self.opinions: dict = {}
+        self.opinion_quorum = 0         # set by the replica (2f+1); 0=off
+
+    def add_opinion(self, replica_id: int, t_ms: int) -> bool:
+        """Record a peer's clock reading. Rejects non-monotone values —
+        a replayed old (still validly signed) opinion must not replace a
+        newer one, or a single faulty replica could re-send the cluster's
+        hour-old opinions and drag the median arbitrarily into the past —
+        and implausible ones (farther from our clock than any envelope
+        could tolerate; such a clock can never contribute a useful vote,
+        but unbounded it could steer the median)."""
+        prev = self.opinions.get(replica_id)
+        if prev is not None and t_ms <= prev[0]:
+            return False
+        plaus = 10 * self.max_skew_ms + int(self.OPINION_TTL_S * 1000)
+        if abs(t_ms - int(self._clock() * 1000)) > plaus:
+            return False
+        self.opinions[replica_id] = (t_ms, self._mono())
+        return True
+
+    def envelope_median_ms(self) -> Optional[int]:
+        """The cluster's agreed 'now': median of fresh peer opinions
+        (each extrapolated by its receipt age) plus our own clock. None
+        until opinion_quorum distinct clocks are represented."""
+        if self.opinion_quorum <= 0:
+            return None
+        now_mono = self._mono()
+        estimates = [int(self._clock() * 1000)]
+        for t_ms, at in self.opinions.values():
+            age = now_mono - at
+            if age <= self.OPINION_TTL_S:
+                estimates.append(t_ms + int(age * 1000))
+        if len(estimates) < self.opinion_quorum:
+            return None
+        estimates.sort()
+        return estimates[len(estimates) // 2]
 
     def primary_stamp(self) -> int:
         """Strictly increasing across PIPELINED proposals too — two
@@ -205,7 +252,16 @@ class TimeServiceManager:
     def validate(self, t_ms: int) -> bool:
         if t_ms <= self.last_agreed_ms:
             return False
-        return t_ms <= int(self._clock() * 1000) + self.max_skew_ms
+        if t_ms > int(self._clock() * 1000) + self.max_skew_ms:
+            return False
+        # voting envelope: with f+1 clocks represented, the primary's
+        # stamp must also sit within the median's skew bound — a primary
+        # whose clock races ahead of the cluster is rejected even by a
+        # backup whose own clock races with it
+        median = self.envelope_median_ms()
+        if median is not None and abs(t_ms - median) > self.max_skew_ms:
+            return False
+        return True
 
     def on_executed(self, t_ms: int) -> None:
         if t_ms > self.last_agreed_ms:

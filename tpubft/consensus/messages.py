@@ -55,6 +55,7 @@ class MsgCode(enum.IntEnum):
     PreProcessBatchRequest = 25
     PreProcessBatchReply = 26
     AskForCheckpoint = 27
+    TimeOpinion = 28
 
 
 class RequestFlag(enum.IntFlag):
@@ -401,6 +402,24 @@ class SimpleAckMsg(ConsensusMsg):
     epoch: int = 0
     SPEC = [("sender_id", "u32"), ("seq_num", "u64"), ("view", "u64"),
             ("acked_msg_code", "u16"), ("epoch", "u64")]
+
+
+@register
+@dataclass
+class TimeOpinionMsg(ConsensusMsg):
+    """A replica's signed clock reading (time-service voting extension of
+    the reference TimeServiceManager.hpp model, where each replica only
+    bounds the primary's stamp against its LOCAL clock): collecting f+1
+    fresh opinions lets every replica bound the primary against the
+    CLUSTER's median clock, so one fast primary + one fast backup clock
+    cannot drift the agreed time."""
+    CODE = MsgCode.TimeOpinion
+    sender_id: int
+    t_ms: int                     # sender's clock, ms since epoch
+    signature: bytes
+    epoch: int = 0
+    SPEC = [("sender_id", "u32"), ("t_ms", "u64"), ("epoch", "u64"),
+            ("signature", "bytes")]
 
 
 # ---------------- pre-execution (reference src/preprocessor/messages) ----

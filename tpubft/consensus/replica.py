@@ -338,6 +338,13 @@ class Replica(IReceiver):
         self.time_service = TimeServiceManager(
             ReservedPagesClient(self.res_pages, TimeServiceManager.CATEGORY),
             max_skew_ms=cfg.time_max_skew_ms)
+        if cfg.time_service_enabled:
+            # replica time voting: broadcast our signed clock reading and
+            # bound the primary against the cluster's median. 2f+1 clocks
+            # (incl. self) so the median is bracketed by honest values
+            # even with f faulty opinions present.
+            self.time_service.opinion_quorum = 2 * cfg.f_val + 1
+            self.dispatcher.add_timer(1.0, self._broadcast_time_opinion)
         from tpubft.consensus.control import ControlStateManager
         self.control = ControlStateManager(
             ReservedPagesClient(self.res_pages,
@@ -652,6 +659,9 @@ class Replica(IReceiver):
             return
         if isinstance(msg, m.CheckpointMsg):
             self._on_checkpoint(msg)
+            return
+        if isinstance(msg, m.TimeOpinionMsg):
+            self._on_time_opinion(sender, msg)
             return
         if isinstance(msg, m.ReplicaStatusMsg):
             if self.info.is_replica(sender):
@@ -1781,6 +1791,30 @@ class Replica(IReceiver):
         for ro in self.info.ro_replica_ids:
             self.comm.send(ro, raw)
         self._store_checkpoint(ck)
+
+    def _broadcast_time_opinion(self) -> None:
+        if not self._running:
+            return
+        op = m.TimeOpinionMsg(sender_id=self.id,
+                              t_ms=int(time.time() * 1000),
+                              signature=b"", epoch=self.epoch)
+        op.signature = self.sig.sign(op.signed_payload())
+        self._broadcast(op)
+
+    def _on_time_opinion(self, sender: int, msg: m.TimeOpinionMsg) -> None:
+        # transport binding: opinions are live clock readings, never
+        # relayed on another's behalf — a peer re-broadcasting someone
+        # else's (validly signed, old) opinion is exactly the replay
+        # vector the monotonicity check in add_opinion also closes
+        if not self.cfg.time_service_enabled \
+                or msg.sender_id != sender \
+                or not self.info.is_replica(msg.sender_id) \
+                or msg.sender_id == self.id:
+            return
+        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
+                               msg.signature):
+            return
+        self.time_service.add_opinion(msg.sender_id, msg.t_ms)
 
     def _on_checkpoint(self, ck: m.CheckpointMsg) -> None:
         if not self.info.is_replica(ck.sender_id):

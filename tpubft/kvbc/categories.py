@@ -23,8 +23,10 @@ VERSIONED_KV = "versioned_kv"
 IMMUTABLE = "immutable"
 
 # names of every merkle category ever written (key = category, value
-# empty) — survives restarts so pruning can GC all tree archives
-SMT_REGISTRY_FAMILY = b"smt.registry"
+# empty) — survives restarts so pruning can GC all tree archives.
+# Deliberately OUTSIDE the "smt.<category>" namespace: a merkle category
+# literally named "registry" must not collide with this family.
+SMT_REGISTRY_FAMILY = b"kvbc.smtcats"
 
 CATEGORY_TYPES = (BLOCK_MERKLE, VERSIONED_KV, IMMUTABLE)
 

@@ -203,7 +203,13 @@ class SparseMerkleTree:
         """Stale-node GC (reference stale-node index role): drop archive
         rows SUPERSEDED at or below `before_version` — for each node,
         every row older than its newest row ≤ before stays unreachable
-        from any retained root ≥ before. Returns rows deleted."""
+        from any retained root ≥ before. Returns rows deleted.
+
+        Cost: one pass over the archive family (O(retained history), a
+        maintenance operation like the reference's stale-node sweep, not
+        the ordering hot path). A per-write stale index would make this
+        O(deleted) at the price of one extra read per node on every
+        block commit — wrong trade while prune frequency << block rate."""
         wb = WriteBatch()
         deleted = 0
         for fam in (self._arch_family, self._leaf_arch_family):
