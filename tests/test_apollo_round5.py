@@ -1,0 +1,66 @@
+"""Round-5 process scenarios: asymmetric-partition view-change traps
+(reference apollo bft_network_partitioning.py one-direction iptables
+DROP, rebuilt as the in-process FaultyComm drop planes)."""
+import time
+
+import pytest
+
+from tpubft.testing.network import BftTestNetwork
+
+pytestmark = pytest.mark.slow
+
+
+def _commit(kv, key, value, timeout_ms=8000, tries=6):
+    for _ in range(tries):
+        try:
+            if kv.write([(key, value)], timeout_ms=timeout_ms).success:
+                return True
+        except Exception:
+            pass
+    return False
+
+
+def test_deaf_primary_forces_view_change(tmp_path):
+    """Primary can SEND but not RECEIVE — the classic VC liveness trap:
+    its status beacons keep flowing, so a detector keyed on 'have I heard
+    from the primary' never fires; progress-keyed complaint logic must
+    still assemble f+1 complaints and move the view. The deaf old
+    primary, still sending stale view-0 traffic, must not stall the new
+    view, and after healing it catches back up."""
+    with BftTestNetwork(f=1, db_dir=str(tmp_path),
+                        view_change_timeout_ms=2500) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"pre", b"1")
+        assert all((net.current_view(r) or 0) == 0 for r in range(net.n))
+
+        net.deafen_replica(0)          # view-0 primary: sends, hears nothing
+        # writes during the deafness must eventually land via the new view
+        deadline = time.monotonic() + 60
+        landed = False
+        while time.monotonic() < deadline and not landed:
+            landed = _commit(kv, b"during", b"2", timeout_ms=10000, tries=1)
+        assert landed, "cluster never recovered from the deaf primary"
+        views = [net.current_view(r) or 0 for r in range(1, net.n)]
+        assert all(v >= 1 for v in views), views
+
+        net.heal(0)
+        # the old primary rejoins the live view and the cluster keeps
+        # ordering with it back in rotation
+        net.wait_for(lambda: (net.current_view(0) or 0) >= 1, timeout=45)
+        assert _commit(kv, b"post", b"3", timeout_ms=15000)
+        assert kv.read([b"pre", b"during", b"post"]) == {
+            b"pre": b"1", b"during": b"2", b"post": b"3"}
+
+
+def test_one_way_link_does_not_wedge_ordering(tmp_path):
+    """A single one-direction link cut between two BACKUPS (2→3 dropped,
+    3→2 flows) must not cost liveness at all: quorums of 3 exist without
+    the broken direction, and retransmissions ride the healthy paths."""
+    with BftTestNetwork(f=1, db_dir=str(tmp_path)) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"a", b"1")
+        net.drop_link(2, 3)
+        for i in range(4):
+            assert _commit(kv, b"k%d" % i, b"v", timeout_ms=15000), i
+        net.heal(2)
+        assert _commit(kv, b"b", b"2")

@@ -285,6 +285,22 @@ class BftTestNetwork:
         assert fault_command(self.fault_base + r, cmd="set",
                              drop_to=others, drop_from=others) is not None
 
+    def deafen_replica(self, r: int) -> None:
+        """The classic view-change liveness trap (reference apollo
+        partitioning's one-direction iptables DROP): replica r keeps
+        SENDING — status beacons, PrePrepares, shares all flow out, so it
+        looks alive to naive failure detection — but receives NOTHING
+        (peers, clients, operator). If r is the primary, the cluster must
+        view-change away despite the heartbeats."""
+        from tpubft.consensus.replicas_info import ReplicasInfo
+        from tpubft.testing.faults import fault_command
+        op_id = ReplicasInfo.from_config(self._node_cfg()).operator_id
+        everyone = [i for i in
+                    list(range(self.n + self.num_ro + self.num_clients))
+                    + [op_id] if i != r]
+        assert fault_command(self.fault_base + r, cmd="set",
+                             drop_from=everyone) is not None
+
     def set_loss(self, r: int, loss: float) -> None:
         """Uniform probabilistic message loss at replica r."""
         from tpubft.testing.faults import fault_command
