@@ -54,6 +54,68 @@ def _device_available() -> bool:
         time.sleep(min(30.0, remaining))
 
 
+def _secondary_metrics(platform: str) -> dict:
+    """Kernel rows for the OTHER hot crypto paths (configs 3/5's client
+    sigs and every threshold-bls config's certificate combine), so the
+    driver artifact carries the full device story, not just Ed25519.
+    Batches sized for a bounded runtime on the degraded CPU backend;
+    TPUBFT_BENCH_ECDSA_BATCH sweeps amortization on hardware."""
+    out: dict = {}
+
+    # ECDSA batch verification — both deployed curves (reference
+    # crypto_utils.hpp secp256k1/secp256r1 via OpenSSL, one-at-a-time)
+    from tpubft.crypto import cpu as ccpu
+    from tpubft.ops import ecdsa as eops
+    eb = max(1, int(os.environ.get("TPUBFT_BENCH_ECDSA_BATCH",
+                                   "512" if platform != "cpu" else "64")))
+    for curve in ("secp256r1", "secp256k1"):
+        signer = ccpu.EcdsaSigner.generate(
+            curve=curve, seed=b"bench-" + curve.encode())
+        pk = signer.public_bytes()
+        items = []
+        for i in range(eb):
+            msg = b"ecdsa-bench-%d" % (i % 64)
+            items.append((msg, signer.sign(msg), pk))
+        verdict = eops.verify_batch(curve, items)         # compile
+        assert eb and bool(verdict.all()), curve
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            eops.verify_batch(curve, items)
+        dt = (time.perf_counter() - t0) / reps
+        out["ecdsa-%s-verifies/sec" % curve] = round(eb / dt, 1)
+
+    # BLS threshold combine — Lagrange + k-point G1 MSM, the per-slot
+    # certificate cost of every threshold-bls config (reference
+    # FastMultExp.cpp role). k=3 quorum of config 2's n=7 shape at CPU
+    # fallback speed; the capture ladder runs the k=667 flood separately.
+    from tpubft.crypto.digest import digest as sha256d
+    from tpubft.crypto.systems import Cryptosystem
+    k, n = (3, 7)
+    system = Cryptosystem("threshold-bls", k, n, seed=b"bench-bls")
+    dg = sha256d(b"bls-bench")
+    shares = [system.create_threshold_signer(i).sign_share(dg)
+              for i in range(1, k + 1)]
+    verifier = system.create_threshold_verifier()
+
+    def combine():
+        acc = verifier.new_accumulator(with_share_verification=False)
+        acc.set_expected_digest(dg)
+        for sid, share in enumerate(shares, start=1):
+            acc.add(sid, share)
+        return acc.get_full_signed_data()
+
+    combined = combine()                                  # warm
+    assert verifier.verify(dg, combined)
+    reps = 8
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        combine()
+    out["bls-combine-ms (k=%d/n=%d)" % (k, n)] = round(
+        (time.perf_counter() - t0) / reps * 1e3, 2)
+    return out
+
+
 def main() -> None:
     use_default_platform = _device_available()
 
@@ -153,6 +215,22 @@ def main() -> None:
         "unit": "verifies/sec",
         "vs_baseline": round(tpu_rate / cpu_rate, 3),
     }
+    # bounded SUBPROCESS: on this box the characteristic failure is a
+    # HANG (tunnel window closing mid-compute), which no except clause
+    # catches — the headline number must never be forfeited to it
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--secondary", platform],
+            capture_output=True, timeout=600)
+        if r.returncode == 0 and r.stdout.strip():
+            record["secondary"] = json.loads(r.stdout)
+        else:
+            print("bench: secondary metrics failed: %s"
+                  % r.stderr[-400:], file=sys.stderr)
+    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
+        print("bench: secondary metrics skipped: %r" % (e,),
+              file=sys.stderr)
     if platform == "cpu":
         record["degraded"] = True  # no accelerator at capture time
         # surface the most recent archived hardware capture (written by
@@ -169,4 +247,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--secondary":
+        # subprocess entry for the bounded secondary pass: inherit the
+        # parent's platform decision instead of re-probing the device
+        platform_arg = sys.argv[2]
+        import jax
+        if platform_arg == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        from benchmarks.common import setup_cache
+        setup_cache()
+        print(json.dumps(_secondary_metrics(platform_arg)))
+    else:
+        main()
