@@ -1,0 +1,135 @@
+"""Multi-chip scaling sweep: sharded verify + MSM at 1/2/4/8 devices.
+
+Usage:  python -m benchmarks.bench_scaling [--devices 1,2,4,8]
+        [--batch 2048] [--msm-k 64]
+
+Each width runs in a fresh SUBPROCESS (the virtual-device count is a
+process-level XLA flag) and prints one JSON row:
+  {"devices": D, "verify_rate": r, "msm_ms": m,
+   "verify_shards": D, "shard_rows": batch/D}
+
+What the sweep proves depends on the platform:
+- on a REAL multi-chip TPU mesh the rows give the scaling slope
+  (verifies/sec should grow toward linear; combine-ms should stay flat
+  as the all_gather payload is tiny);
+- on the virtual CPU mesh of a 1-core host every "device" multiplexes
+  the same core, so wall-clock CANNOT improve — there the sweep
+  validates that the sharded programs compile and execute at every
+  width, that the partitioner actually splits the batch (shard_rows
+  = batch/D on each device), and that going wide costs bounded
+  overhead (the regression test's bound).
+
+Reference point: the reference runs both loops on one CPU thread
+(SigManager.cpp:197 verify loop; FastMultExp.cpp:27 accumulation) —
+its scaling story ends at one core, which is the gap this module's
+mesh design exists to beat.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def run_width(d: int, batch: int, msm_k: int,
+              platform: str = "cpu") -> dict:
+    """One width, current process. Assumes XLA device count already set.
+    platform="cpu" pins the virtual CPU mesh (the 1-host validation
+    mode); "native" leaves the backend alone so a real chip mesh
+    produces the actual scaling slope."""
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from benchmarks.common import setup_cache
+    setup_cache()
+    import numpy as np
+
+    from tpubft.crypto import cpu as ccpu
+    from tpubft.ops import ed25519 as ops
+    from tpubft.parallel import sharding as sh
+
+    mesh = sh.make_mesh(d)
+    assert mesh.devices.size == d
+
+    # ---- data-parallel verify ----
+    signer = ccpu.Ed25519Signer.generate(seed=b"scale")
+    pk = signer.public_bytes()
+    msgs = [b"scale-%d" % (i % 64) for i in range(batch)]
+    items = [(m, signer.sign(m), pk) for m in msgs]
+    prep = ops.prepare_batch(items)
+    kernel = sh.sharded_verify_ed25519(mesh)
+    args = (prep.s_win, prep.h_win, prep.a_y, prep.a_sign,
+            prep.r_y, prep.r_sign)
+    out = kernel(*args)
+    out.block_until_ready()                     # compile
+    assert bool(np.asarray(out).all())
+    shards = out.addressable_shards
+    shard_rows = shards[0].data.shape[0]
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = kernel(*args)
+    out.block_until_ready()
+    verify_rate = batch / ((time.perf_counter() - t0) / reps)
+
+    # ---- sharded MSM (threshold-share accumulation shape) ----
+    from tpubft.crypto import bls12381 as bls
+    pts = [bls.g1_mul(bls.G1_GEN, i + 1) for i in range(msm_k)]
+    scalars = [(7 * i + 3) % bls.R for i in range(msm_k)]
+    t0 = time.perf_counter()
+    acc = sh.sharded_msm(pts, scalars, mesh)
+    compile_and_first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    acc = sh.sharded_msm(pts, scalars, mesh)
+    msm_ms = (time.perf_counter() - t0) * 1e3
+    # correctness anchor vs the host golden model
+    assert acc == bls.g1_msm(pts, scalars), "sharded MSM result mismatch"
+
+    return {"devices": d, "batch": batch,
+            "verify_rate": round(verify_rate, 1),
+            "verify_shards": len(shards), "shard_rows": int(shard_rows),
+            "msm_k": msm_k, "msm_ms": round(msm_ms, 1),
+            "msm_first_s": round(compile_and_first_s, 1)}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", default="1,2,4,8")
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--msm-k", type=int, default=64)
+    ap.add_argument("--one-width", type=int, default=0,
+                    help="internal: run this width in-process")
+    ap.add_argument("--platform", default="cpu",
+                    choices=("cpu", "native"),
+                    help="cpu = virtual host-device mesh (1-host "
+                         "validation); native = real accelerator mesh "
+                         "(the actual scaling slope)")
+    args = ap.parse_args()
+    if args.one_width:
+        print(json.dumps(run_width(args.one_width, args.batch, args.msm_k,
+                                   platform=args.platform)))
+        return
+    for d in [int(x) for x in args.devices.split(",")]:
+        env = dict(os.environ)
+        if args.platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={d}").strip()
+        r = subprocess.run(
+            [sys.executable, "-m", "benchmarks.bench_scaling",
+             "--one-width", str(d), "--batch", str(args.batch),
+             "--msm-k", str(args.msm_k), "--platform", args.platform],
+            env=env, capture_output=True, text=True, timeout=1800)
+        if r.returncode != 0:
+            print(json.dumps({"devices": d,
+                              "error": r.stderr[-400:]}))
+            continue
+        print(r.stdout.strip().splitlines()[-1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,5 @@
 """Sharded crypto kernels on the virtual 8-device CPU mesh."""
+import os
 import random
 
 import jax
@@ -60,3 +61,47 @@ def test_sharded_ed25519_verify():
     want = ops.verify_batch(items)
     assert got.tolist() == want.tolist()
     assert got.tolist() == [i % 5 != 0 for i in range(16)]
+
+
+@pytest.mark.slow
+def test_scaling_sweep_1_to_4_devices():
+    """Multi-chip scaling harness (benchmarks/bench_scaling.py): the
+    sharded programs must compile AND execute at several mesh widths
+    with the partitioner genuinely splitting the batch, and going wide
+    must cost bounded overhead. On this 1-core host all virtual devices
+    multiplex one core, so a wall-clock SPEEDUP cannot be asserted —
+    the slope claim needs real chips; what must hold everywhere is that
+    sharding is not a regression and the split is real. Drives the
+    SHIPPED sweep entrypoint (one --devices 1,4 invocation), not a
+    reimplementation of its orchestration."""
+    import json
+    import subprocess
+    import sys
+
+    def sweep():
+        r = subprocess.run(
+            [sys.executable, "-m", "benchmarks.bench_scaling",
+             "--devices", "1,4", "--batch", "512", "--msm-k", "16"],
+            capture_output=True, text=True, timeout=1800,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr[-800:]
+        rows = {}
+        for line in r.stdout.strip().splitlines():
+            row = json.loads(line)
+            assert "error" not in row, row
+            rows[row["devices"]] = row
+        return rows
+
+    rows = sweep()
+    # deterministic: the partitioner genuinely splits the batch
+    assert rows[1]["verify_shards"] == 1
+    assert rows[4]["verify_shards"] == 4
+    assert rows[4]["shard_rows"] == 512 // 4
+    # perf bounds are load-sensitive on a contended 1-core host: one
+    # retry before declaring a regression (split asserts stay strict)
+    ok = (rows[4]["verify_rate"] >= 0.7 * rows[1]["verify_rate"]
+          and rows[4]["msm_ms"] <= 2.5 * rows[1]["msm_ms"])
+    if not ok:
+        rows = sweep()
+        assert rows[4]["verify_rate"] >= 0.7 * rows[1]["verify_rate"], rows
+        assert rows[4]["msm_ms"] <= 2.5 * rows[1]["msm_ms"], rows
