@@ -1,0 +1,148 @@
+"""TLS multiplex transport (reference TlsMultiplexCommunication):
+endpoint-numbered frames, many principals per physical connection."""
+import threading
+import time
+
+import pytest
+
+from tpubft.comm import CommConfig
+from tpubft.comm.multiplex import MultiplexClientHub, MultiplexTransport
+from tpubft.comm.tcp import PlainTcpCommunication
+
+
+class _Collector:
+    def __init__(self):
+        self.got = []
+        self.evt = threading.Event()
+
+    def on_connection_status_changed(self, *_):
+        pass
+
+    def on_new_message(self, sender, data):
+        self.got.append((int(sender), data))
+        self.evt.set()
+
+    def wait(self, n, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while len(self.got) < n and time.monotonic() < deadline:
+            time.sleep(0.02)
+        return len(self.got) >= n
+
+
+def _eps():
+    # node 0 = "replica", node 4 = carrier; principals 5,6 ride node 4's
+    # connection and have no sockets of their own
+    from tests.test_comm import free_ports
+    p0, p4 = free_ports(2)
+    return {0: ("127.0.0.1", p0), 4: ("127.0.0.1", p4)}
+
+
+def test_multiplex_routing_and_reply_learning():
+    eps = _eps()
+    replica_rx = _Collector()
+    replica = MultiplexTransport(
+        PlainTcpCommunication(CommConfig(self_id=0, endpoints=eps)),
+        self_id=0, is_client=lambda i: i >= 4)
+    replica.start(replica_rx)
+
+    hub = MultiplexClientHub(
+        PlainTcpCommunication(CommConfig(self_id=4, endpoints=eps)))
+    p5, p6 = hub.endpoint(5), hub.endpoint(6)
+    rx5, rx6 = _Collector(), _Collector()
+    p5.start(rx5)
+    p6.start(rx6)
+    try:
+        # two principals, one carrier: the replica sees each principal
+        # as the sender even though the socket belongs to node 4
+        p5.send(0, b"from-5")
+        p6.send(0, b"from-6")
+        assert replica_rx.wait(2)
+        assert sorted(replica_rx.got) == [(5, b"from-5"), (6, b"from-6")]
+        # replies route back over the LEARNED carrier and land at the
+        # right principal's receiver
+        replica.send(5, b"to-5")
+        replica.send(6, b"to-6")
+        assert rx5.wait(1) and rx6.wait(1)
+        assert rx5.got == [(0, b"to-5")]
+        assert rx6.got == [(0, b"to-6")]
+    finally:
+        hub.stop()
+        replica.stop()
+
+
+def test_multiplex_spoof_guards():
+    eps = _eps()
+    replica_rx = _Collector()
+    replica = MultiplexTransport(
+        PlainTcpCommunication(CommConfig(self_id=0, endpoints=eps)),
+        self_id=0, is_client=lambda i: i >= 4)
+    replica.start(replica_rx)
+    raw = PlainTcpCommunication(CommConfig(self_id=4, endpoints=eps))
+
+    class _Null:
+        def on_new_message(self, *_):
+            pass
+
+        def on_connection_status_changed(self, *_):
+            pass
+    raw.start(_Null())
+    try:
+        import struct
+        # a client carrier claiming a REPLICA-space endpoint: dropped
+        raw.send(0, struct.pack("<I", 1) + b"spoof-replica")
+        # a truncated frame: dropped
+        raw.send(0, b"\x05")
+        # a legitimate principal frame still flows afterwards
+        raw.send(0, struct.pack("<I", 7) + b"ok")
+        assert replica_rx.wait(1)
+        assert replica_rx.got == [(7, b"ok")]
+    finally:
+        raw.stop()
+        replica.stop()
+
+
+@pytest.mark.slow
+def test_tls_mux_cluster_end_to_end(tmp_path):
+    """Full cluster on the tls-mux transport: replicas demultiplex, a
+    client HUB shares one TLS connection set between two principals, and
+    ordering works for both (the reference clientservice shape)."""
+    from tpubft.apps import skvbc
+    from tpubft.bftclient import BftClient, ClientConfig
+    from tpubft.comm.tls import TlsConfig, TlsTcpCommunication
+    from tpubft.consensus.keys import ClusterKeys
+    from tpubft.testing.network import BftTestNetwork
+
+    with BftTestNetwork(f=1, db_dir=str(tmp_path),
+                        transport="tls-mux") as net:
+        # per-principal clients through the harness (1-principal
+        # carriers) work unchanged on the mux wire
+        kv = net.skvbc_client(0)
+        assert kv.write([(b"solo", b"1")], timeout_ms=30000).success
+
+        # a hub: principals for clients 1 and 2 share ONE carrier
+        from tpubft.apps.simple_test import endpoint_table
+        cfg = net._node_cfg()
+        carrier_id = net.n + net.num_ro + 1
+        eps = endpoint_table(net.base_port, net.n + net.num_ro,
+                             net.num_clients)
+        hub = MultiplexClientHub(TlsTcpCommunication(TlsConfig(
+            self_id=carrier_id, endpoints=eps,
+            certs_dir=net.certs_dir)))
+        try:
+            kvs = []
+            for idx in (1, 2):
+                pid = net.n + net.num_ro + idx
+                keys = ClusterKeys.generate(
+                    cfg, net.num_clients,
+                    seed=net.seed.encode()).for_node(pid)
+                cl = BftClient(ClientConfig(client_id=pid, f_val=net.f,
+                                            request_timeout_ms=15000),
+                               keys, hub.endpoint(pid))
+                cl.start()
+                kvs.append(skvbc.SkvbcClient(cl))
+            assert kvs[0].write([(b"mux-a", b"2")]).success
+            assert kvs[1].write([(b"mux-b", b"3")]).success
+            assert kvs[0].read([b"solo", b"mux-a", b"mux-b"]) == {
+                b"solo": b"1", b"mux-a": b"2", b"mux-b": b"3"}
+        finally:
+            hub.stop()

@@ -51,7 +51,7 @@ def main() -> None:
     p.add_argument("--seed", default="tpubft-skvbc")
     p.add_argument("--checkpoint-window", type=int, default=150)
     p.add_argument("--transport", default="udp",
-                   choices=("udp", "tcp", "tls"))
+                   choices=("udp", "tcp", "tls", "tls-mux"))
     p.add_argument("--certs-dir", default=None,
                    help="TLS material dir (node-<id>.key/.crt)")
     p.add_argument("--config-override", action="append", default=[],
@@ -75,14 +75,18 @@ def main() -> None:
                                 ).for_node(args.replica)
     # the endpoint table covers replicas + RO + clients contiguously
     eps = endpoint_table(args.base_port, cfg.n_val + args.ro, args.clients)
-    if args.transport == "tls":
+    if args.transport in ("tls", "tls-mux"):
         import os as _os
 
+        from tpubft.comm.multiplex import client_floor
         from tpubft.comm.tls import TlsConfig
         comm_cfg = TlsConfig(self_id=args.replica, endpoints=eps,
                              certs_dir=args.certs_dir,
                              key_password=_os.environ.get(
-                                 "TPUBFT_TLS_KEY_PASSWORD"))
+                                 "TPUBFT_TLS_KEY_PASSWORD"),
+                             mux_client_floor=(
+                                 client_floor(cfg.n_val, args.ro)
+                                 if args.transport == "tls-mux" else None))
     else:
         comm_cfg = CommConfig(self_id=args.replica, endpoints=eps)
     comm = create_communication(comm_cfg, args.transport)

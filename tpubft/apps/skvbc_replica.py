@@ -48,12 +48,16 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     from tpubft.consensus.replicas_info import ReplicasInfo
     eps = endpoint_table(args.base_port, cfg.n_val + args.ro, args.clients,
                          operator_id=ReplicasInfo.from_config(cfg).operator_id)
-    if args.transport == "tls":
+    if args.transport in ("tls", "tls-mux"):
+        from tpubft.comm.multiplex import client_floor
         from tpubft.comm.tls import TlsConfig
         comm_cfg = TlsConfig(self_id=args.replica, endpoints=eps,
                              certs_dir=args.certs_dir,
                              key_password=os.environ.get(
-                                 "TPUBFT_TLS_KEY_PASSWORD"))
+                                 "TPUBFT_TLS_KEY_PASSWORD"),
+                             mux_client_floor=(
+                                 client_floor(cfg.n_val, args.ro)
+                                 if args.transport == "tls-mux" else None))
     else:
         comm_cfg = CommConfig(self_id=args.replica, endpoints=eps)
     comm = create_communication(comm_cfg, args.transport)
@@ -88,7 +92,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--db-dir", default=None)
     p.add_argument("--seed", default="tpubft-skvbc")
     p.add_argument("--transport", default="udp",
-                   choices=("udp", "tcp", "tls"))
+                   choices=("udp", "tcp", "tls", "tls-mux"))
     p.add_argument("--certs-dir", default=None,
                    help="TLS material dir (node-<id>.key/.crt)")
     p.add_argument("--view-change-timeout-ms", type=int, default=4000)

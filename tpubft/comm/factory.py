@@ -31,4 +31,17 @@ def create_communication(config: CommConfig,
             raise TypeError("tls transport needs a TlsConfig "
                             "(certs_dir with node keys/certs)")
         return TlsTcpCommunication(config)
+    if transport == "tls-mux":
+        # reference TlsMultiplexCommunication: endpoint-numbered frames
+        # over the TLS transport so many principals share connections
+        if not isinstance(config, TlsConfig):
+            raise TypeError("tls-mux transport needs a TlsConfig")
+        if config.mux_client_floor is None:
+            raise ValueError("tls-mux needs TlsConfig.mux_client_floor "
+                             "(first client-space principal id)")
+        from tpubft.comm.multiplex import MultiplexTransport
+        floor = config.mux_client_floor
+        return MultiplexTransport(TlsTcpCommunication(config),
+                                  self_id=config.self_id,
+                                  is_client=lambda i: i >= floor)
     raise ValueError(f"unknown transport {transport!r}")

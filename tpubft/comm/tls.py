@@ -57,6 +57,10 @@ class TlsConfig(CommConfig):
     encrypted-at-rest (keygen --password, the secretsmanager role)."""
     certs_dir: str = ""
     key_password: Optional[str] = None
+    # multiplex mode (reference TlsMultiplexConfig): ids at or above this
+    # floor are client-space principals that may share carrier
+    # connections; None = plain one-connection-per-pair TLS
+    mux_client_floor: Optional[int] = None
 
 
 def _fingerprint(der: bytes) -> bytes:

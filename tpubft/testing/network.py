@@ -102,7 +102,7 @@ class BftTestNetwork:
         # process as --config-override FIELD=VALUE
         self.cfg_overrides = dict(cfg_overrides or {})
         self.certs_dir = None
-        if transport == "tls":
+        if transport in ("tls", "tls-mux"):
             # pinned-cert material for every principal (replicas +
             # clients + operator), like keygen --tls-certs
             assert db_dir, "TLS transport needs db_dir for cert material"
@@ -371,12 +371,16 @@ class BftTestNetwork:
                              client_sig_scheme=self.client_sig_scheme)
 
     def _make_comm(self, node_id: int, eps):
-        if self.transport == "tls":
+        if self.transport in ("tls", "tls-mux"):
             from tpubft.comm import create_communication
+            from tpubft.comm.multiplex import client_floor
             from tpubft.comm.tls import TlsConfig
+            floor = (client_floor(self.n, self.num_ro)
+                     if self.transport == "tls-mux" else None)
             return create_communication(
                 TlsConfig(self_id=node_id, endpoints=eps,
-                          certs_dir=self.certs_dir), "tls")
+                          certs_dir=self.certs_dir,
+                          mux_client_floor=floor), self.transport)
         return PlainUdpCommunication(CommConfig(self_id=node_id,
                                                 endpoints=eps))
 
