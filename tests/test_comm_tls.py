@@ -24,8 +24,12 @@ class Sink(IReceiver):
         self.evt.set()
 
 
-def _eps(base_port, ids):
-    return {i: ("127.0.0.1", base_port + i) for i in ids}
+def _eps(_base_port, ids):
+    # OS-assigned ports: a random base collides with concurrent clusters
+    # under full-suite load (observed flake)
+    from tests.test_comm import free_ports
+    ports = free_ports(len(ids))
+    return {i: ("127.0.0.1", p) for i, p in zip(ids, ports)}
 
 
 def _mk(certs_dir, node, eps) -> TlsTcpCommunication:
