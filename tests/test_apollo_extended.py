@@ -185,6 +185,9 @@ def test_config5_ecdsa_bls_tls_view_change_storm(tmp_path):
     BLS threshold commit certificates + pinned-cert TLS transport, under
     a view-change storm (two consecutive primaries killed mid-stream).
     Real replica OS processes, real TLS sockets."""
+    pytest.importorskip("cryptography",
+                        reason="TLS cert generation needs the optional "
+                               "`cryptography` package")
     with BftTestNetwork(f=1, db_dir=str(tmp_path), transport="tls",
                         threshold_scheme="threshold-bls",
                         client_sig_scheme="ecdsa-p256",

@@ -1,0 +1,68 @@
+"""Tier-1 wiring for the import-hygiene lint (tools/check_imports.py):
+no module under tpubft/ may hard-import a non-stdlib, non-approved
+third-party package at module level — optional deps (e.g. the OpenSSL
+`cryptography` accelerator) must be probed at runtime."""
+import importlib.util
+import os
+import sys
+
+_TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_imports.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_imports",
+                                                  os.path.abspath(_TOOL))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_no_module_level_thirdparty_imports_in_tpubft():
+    tool = _load_tool()
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
+                                        "tpubft"))
+    violations = tool.find_violations(root)
+    assert violations == [], (
+        "module-level third-party imports found (soft-import these):\n"
+        + "\n".join(f"{p}:{ln}: {m}" for p, ln, m in violations))
+
+
+def test_lint_catches_a_violation(tmp_path):
+    """The lint itself must actually detect a hard import (and must not
+    flag try-guarded, TYPE_CHECKING, or function-level imports)."""
+    tool = _load_tool()
+    bad = tmp_path / "bad.py"
+    bad.write_text("import cryptography\n")
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import os\nimport jax\nimport tpubft\n"
+        "from typing import TYPE_CHECKING\n"
+        "try:\n    import cryptography\nexcept ImportError:\n"
+        "    cryptography = None\n"
+        "if TYPE_CHECKING:\n    import pandas\n"
+        "def f():\n    import requests\n")
+    violations = tool.find_violations(str(tmp_path))
+    assert [(os.path.basename(p), m) for p, _, m in violations] \
+        == [("bad.py", "cryptography")]
+
+
+def test_lint_descends_import_time_compound_bodies(tmp_path):
+    """for/while/with bodies and a try's else/finally all execute at
+    import time — an import smuggled there is still a hard dependency."""
+    tool = _load_tool()
+    (tmp_path / "sneaky.py").write_text(
+        "import contextlib\n"
+        "with contextlib.suppress(TypeError):\n    import requests\n"
+        "for _ in range(1):\n    import cryptography\n"
+        "try:\n    pass\nfinally:\n    import pandas\n")
+    mods = sorted(m for _, _, m in tool.find_violations(str(tmp_path)))
+    assert mods == ["cryptography", "pandas", "requests"]
+
+
+def test_cli_exit_codes(tmp_path):
+    tool = _load_tool()
+    (tmp_path / "clean.py").write_text("import os\n")
+    assert tool.main(["check_imports", str(tmp_path)]) == 0
+    (tmp_path / "dirty.py").write_text("from requests import get\n")
+    assert tool.main(["check_imports", str(tmp_path)]) == 1

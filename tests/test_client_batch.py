@@ -231,3 +231,37 @@ def test_ask_for_checkpoint_reply_and_rate_limit():
         rep.incoming.push_external(9999, ask.pack())
         _t.sleep(0.3)
         assert not [1 for d, _ in sent if d == 9999]
+
+
+def test_backup_relays_pipelined_batches_on_seq_advance():
+    """Suppression is (last head req_seq, time) per client: a client
+    pipelining batches faster than 1/s still gets backup relay for each
+    NEW batch (seq advanced), so a lost client->primary copy recovers
+    without waiting out the old 1s principal-wide window (ADVICE r5)."""
+    import time
+    with InProcessCluster(f=1, num_clients=1,
+                          cfg_overrides={"crypto_backend": "cpu"}) as cl:
+        c = cl.client(0)
+        c.start()
+
+        def batch_of(first_seq, deltas):
+            reqs = []
+            for i, delta in enumerate(deltas):
+                r = m.ClientRequestMsg(sender_id=c.cfg.client_id,
+                                       req_seq_num=first_seq + i, flags=0,
+                                       request=counter.encode_add(delta),
+                                       cid="", signature=b"")
+                r.signature = c._signer.sign(r.signed_payload())
+                reqs.append(r)
+            return m.ClientBatchRequestMsg(
+                sender_id=c.cfg.client_id, cid="",
+                requests=[r.pack() for r in reqs], signature=b"")
+
+        # two batches, back-to-back (<<1s apart), both ONLY to a backup:
+        # the second reaches the primary only if relay keys on seq advance
+        c.comm.send(2, batch_of(1, (4, 6)).pack())
+        c.comm.send(2, batch_of(3, (5, 7)).pack())
+        deadline = time.time() + 20
+        while time.time() < deadline and cl.handlers[0].value != 22:
+            time.sleep(0.05)
+        assert cl.handlers[0].value == 22

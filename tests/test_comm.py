@@ -158,3 +158,59 @@ def test_tcp_many_messages_in_order():
     finally:
         c0.stop()
         c1.stop()
+
+
+def test_udp_batched_flush_path():
+    """The sendmmsg batch plane: the flusher thread's sends buffer and go
+    out on flush() through the native batched sender (defined-byte-order
+    wire records), falling back transparently when g++/netio is absent."""
+    p0, p1 = free_ports(2)
+    eps = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    c0 = PlainUdpCommunication(CommConfig(self_id=0, endpoints=eps))
+    c1 = PlainUdpCommunication(CommConfig(self_id=1, endpoints=eps))
+    r1 = Collector()
+    c0.start(Collector())
+    c1.start(r1)
+    try:
+        c0.flush()                      # register this thread as flusher
+        for i in range(20):
+            c0.send(1, b"b%03d" % i)
+        if c0._netio is not None:
+            assert c0._batch, "flusher-thread sends must buffer"
+        c0.flush()
+        assert r1.wait_for(20)
+        assert sorted(d for _, d in r1.msgs) == [b"b%03d" % i
+                                                 for i in range(20)]
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_udp_sendmmsg_failure_falls_back_to_sendto():
+    """A -1 (malformed buffer) return from net_sendmmsg must NOT drop the
+    batch: _drain re-sends every record per-datagram."""
+    p0, p1 = free_ports(2)
+    eps = {0: ("127.0.0.1", p0), 1: ("127.0.0.1", p1)}
+    c0 = PlainUdpCommunication(CommConfig(self_id=0, endpoints=eps))
+    c1 = PlainUdpCommunication(CommConfig(self_id=1, endpoints=eps))
+
+    class BrokenNetio:
+        def net_sendmmsg(self, *a):
+            return -1
+
+    c0._netio = BrokenNetio()
+    r1 = Collector()
+    c0.start(Collector())
+    c1.start(r1)
+    try:
+        c0.flush()
+        for i in range(5):
+            c0.send(1, b"f%d" % i)
+        assert c0._batch
+        c0.flush()
+        assert r1.wait_for(5)
+        assert sorted(d for _, d in r1.msgs) == [b"f%d" % i
+                                                 for i in range(5)]
+    finally:
+        c0.stop()
+        c1.stop()

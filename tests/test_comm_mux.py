@@ -106,6 +106,9 @@ def test_tls_mux_cluster_end_to_end(tmp_path):
     """Full cluster on the tls-mux transport: replicas demultiplex, a
     client HUB shares one TLS connection set between two principals, and
     ordering works for both (the reference clientservice shape)."""
+    pytest.importorskip("cryptography",
+                        reason="TLS cert generation needs the optional "
+                               "`cryptography` package")
     from tpubft.apps import skvbc
     from tpubft.bftclient import BftClient, ClientConfig
     from tpubft.comm.tls import TlsConfig, TlsTcpCommunication

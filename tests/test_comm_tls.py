@@ -8,6 +8,12 @@ import time
 
 import pytest
 
+# cert GENERATION is the one feature that genuinely needs the optional
+# OpenSSL stack (x509); the transport itself runs on stdlib ssl
+pytest.importorskip("cryptography",
+                    reason="TLS cert generation needs the optional "
+                           "`cryptography` package")
+
 from tpubft.comm import CommConfig, create_communication
 from tpubft.comm.interfaces import IReceiver
 from tpubft.comm.tls import (TlsConfig, TlsTcpCommunication,

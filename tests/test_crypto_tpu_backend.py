@@ -155,7 +155,7 @@ def test_ordering_continues_while_batch_in_flight():
         orig = backup.sig.verify_batch
         first = [True]
 
-        def gated(items, seq=None):
+        def gated(items, seq=None, **kw):
             # target seq 1's PrePrepare batch specifically: admission
             # batches (seq=None) ride a different worker and must not
             # spring the trap
@@ -163,7 +163,7 @@ def test_ordering_continues_while_batch_in_flight():
                 first[0] = False
                 blocked.set()
                 gate.wait(20)
-            return orig(items, seq=seq)
+            return orig(items, seq=seq, **kw)
 
         backup.sig.verify_batch = gated
         try:

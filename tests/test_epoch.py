@@ -107,3 +107,23 @@ def test_restart_into_new_epoch_rejects_old_traffic(tmp_path):
                 and rep.m_epoch_dropped.value == before:
             time.sleep(0.05)
         assert rep.m_epoch_dropped.value == before + 1
+
+
+def test_epoch_bump_guard_is_monotone():
+    """Two bump commands in one replayed window (ADVICE r5): replaying
+    the OLDER command after the newer one has bumped must be a no-op —
+    an equality-only guard would see a mismatched stored seq and
+    double-bump, diverging this replica's page digest from the cluster."""
+    db = MemoryDB()
+    pages = ReservedPages(db)
+    em = EpochManager(ReservedPagesClient(pages, EpochManager.CATEGORY))
+    assert em.bump_global_at(cmd_seq=42, effective_seq=60) == 1
+    assert em.bump_global_at(cmd_seq=90, effective_seq=120) == 2
+    # crash-recovery replays BOTH commands, oldest first — neither bumps
+    assert em.bump_global_at(cmd_seq=42, effective_seq=60) == 2
+    assert em.bump_global_at(cmd_seq=90, effective_seq=120) == 2
+    assert em.global_epoch() == 2
+    # a genuinely newer ordered command still bumps
+    assert em.bump_global_at(cmd_seq=91, effective_seq=140) == 3
+    # cmd_seq=0 (no-seq context) is never treated as a replay
+    assert em.bump_global_at(cmd_seq=0, effective_seq=150) == 4

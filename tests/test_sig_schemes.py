@@ -75,3 +75,75 @@ def test_verify_batch_mixed_routes_schemes():
         ("ecdsa-secp256k1", ec.public_bytes(), b"bad", ec.sign(b"good")),
     ]
     assert verify_batch_mixed(items) == [True, True, False, False]
+
+
+def test_verify_memo_short_circuits_duplicates():
+    """The verified-signature memo: retransmit/duplicate verifies hit the
+    LRU instead of re-paying engine cost; failures are never memoized;
+    key rotation invalidates by construction (entries bind the pubkey)."""
+    cfg = ReplicaConfig(f_val=1, num_of_client_proxies=1)
+    keys = ClusterKeys.generate(cfg, 1, seed=b"memo-test")
+    sm = SigManager(keys.for_node(0))
+    sig = SigManager(keys.for_node(1)).sign(b"payload")
+    assert sm.verify(1, b"payload", sig)
+    assert (sm.memo_hits.value, sm.scalar_fallbacks.value) == (0, 1)
+    for _ in range(3):                      # retransmits: memo hits
+        assert sm.verify(1, b"payload", sig)
+    assert (sm.memo_hits.value, sm.scalar_fallbacks.value) == (3, 1)
+    assert sm.sigs_verified.value == 4      # hits still count as verified
+    # failures are re-checked every time, never memoized
+    assert not sm.verify(1, b"forged", sig)
+    assert not sm.verify(1, b"forged", sig)
+    assert sm.sig_failures.value == 2
+    # rotation: entries bound the OLD pubkey, so they stop matching, and
+    # (with no seq/view context) the old key must not verify via grace
+    sm.set_replica_key(1, b"\x07" * 32)
+    hits = sm.memo_hits.value
+    assert not sm.verify(1, b"payload", sig)
+    assert sm.memo_hits.value == hits
+
+
+def test_verify_memo_bounded_lru():
+    cfg = ReplicaConfig(f_val=1, num_of_client_proxies=1)
+    keys = ClusterKeys.generate(cfg, 1, seed=b"memo-cap")
+    signer = SigManager(keys.for_node(1))
+    sm = SigManager(keys.for_node(0), memo_capacity=2)
+    msgs = [b"m%d" % i for i in range(3)]
+    sigs = [signer.sign(mi) for mi in msgs]
+    for mi, si in zip(msgs, sigs):
+        assert sm.verify(1, mi, si)
+    # m0 was evicted (capacity 2): re-verifying it is a miss
+    assert sm.verify(1, msgs[0], sigs[0])
+    assert sm.memo_hits.value == 0
+    # m2 is still resident
+    assert sm.verify(1, msgs[2], sigs[2])
+    assert sm.memo_hits.value == 1
+    # memo_capacity=0 disables the memo entirely
+    sm_off = SigManager(keys.for_node(0), memo_capacity=0)
+    assert sm_off.verify(1, msgs[0], sigs[0])
+    assert sm_off.verify(1, msgs[0], sigs[0])
+    assert sm_off.memo_hits.value == 0
+    assert sm_off.scalar_fallbacks.value == 2
+
+
+def test_verify_batch_memo_and_coalesced_counters():
+    """verify_batch: first pass dispatches through the coalesced batch
+    plane (batched_verifies), an identical second pass is pure memo."""
+    from tpubft.crypto.tpu import verify_batch_mixed
+    cfg = ReplicaConfig(f_val=1, num_of_client_proxies=2,
+                        client_sig_scheme="ecdsa-secp256k1")
+    keys = ClusterKeys.generate(cfg, 2, seed=b"memo-batch")
+    client_id = cfg.n_val + cfg.num_ro_replicas
+    sm = SigManager(keys.for_node(0), batch_fn=verify_batch_mixed,
+                    device_min_batch=1)
+    items = [(1, b"payload", SigManager(keys.for_node(1)).sign(b"payload")),
+             (client_id, b"cpay",
+              SigManager(keys.for_node(client_id)).sign(b"cpay"))]
+    assert sm.verify_batch(items) == [True, True]
+    assert (sm.batched_verifies.value, sm.memo_hits.value) == (2, 0)
+    assert sm.verify_batch(items) == [True, True]
+    assert (sm.batched_verifies.value, sm.memo_hits.value) == (2, 2)
+    # a fresh item joins memo hits without re-dispatching the rest
+    items.append((1, b"new", SigManager(keys.for_node(1)).sign(b"new")))
+    assert sm.verify_batch(items) == [True, True, True]
+    assert (sm.batched_verifies.value, sm.memo_hits.value) == (3, 4)

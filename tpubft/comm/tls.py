@@ -164,9 +164,14 @@ def generate_tls_material(certs_dir: str, node_ids,
     os.makedirs(certs_dir, exist_ok=True)
     for node in node_ids:
         if seed is not None:
-            from tpubft.crypto.cpu import EcdsaSigner
-            sk = EcdsaSigner.generate(
-                "secp256r1", seed=seed + b"|tls|" + str(node).encode())._sk
+            # same P-256 seed derivation as the signing keyfiles (the
+            # scalar engine owns the formula); x509 needs an OpenSSL key
+            # object regardless, so build one from the derived value
+            from tpubft.crypto.scalar import ecdsa_seed_to_private
+            sk = ec.derive_private_key(
+                ecdsa_seed_to_private(seed + b"|tls|" + str(node).encode(),
+                                      "secp256r1"),
+                ec.SECP256R1())
         else:
             sk = ec.generate_private_key(ec.SECP256R1())
         name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME,

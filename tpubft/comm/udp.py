@@ -48,7 +48,10 @@ class PlainUdpCommunication(ICommunication):
         self._netio = _load_netio()
         self._flush_tid: Optional[int] = None
         self._batch: list = []
-        # dest -> packed "ipv4(4, network) + port(2, host)" record prefix
+        # dest -> packed "ipv4(4, network) + port(2, little-endian)"
+        # record prefix. Little-endian is the DEFINED wire order of the
+        # netio record (netio.cpp assembles the field byte-by-byte), not
+        # an assumption about the host.
         self._addr_pfx = {}
         for node, (host, port) in self._cfg.endpoints.items():
             try:
@@ -126,9 +129,13 @@ class PlainUdpCommunication(ICommunication):
             return
         blob = b"".join(batch)
         try:
-            self._netio.net_sendmmsg(self._sock.fileno(), blob, len(blob),
-                                     len(batch))
-        except Exception:  # noqa: BLE001 — fall back to per-datagram
+            rc = self._netio.net_sendmmsg(self._sock.fileno(), blob,
+                                          len(blob), len(batch))
+        except Exception:  # noqa: BLE001 — treat like a malformed buffer
+            rc = -1
+        if rc < 0:
+            # -1 = malformed record buffer (not an exception): the batch
+            # must not be silently dropped — re-send per datagram
             for rec in batch:
                 try:
                     ip = socket.inet_ntoa(rec[:4])

@@ -47,10 +47,14 @@ class EpochManager:
         stays part of the agreed state. Keyed on the command's seq to be
         IDEMPOTENT: crash-recovery replays re-execute committed commands,
         and a read-modify-write bump would double-count and diverge this
-        replica's page digest from the cluster."""
+        replica's page digest from the cluster. The guard is MONOTONE
+        (any cmd_seq at or below the stored one is a replay), not an
+        equality check: two bump commands in one replayed window would
+        otherwise double-bump — the older replay sees the newer stored
+        seq, mismatches, and bumps again (ADVICE r5)."""
         epoch, seq, eff = self._read()
-        if seq == cmd_seq and cmd_seq != 0:
-            return epoch                # replay of the same ordered cmd
+        if cmd_seq != 0 and cmd_seq <= seq:
+            return epoch                # replay of an already-bumped cmd
         nxt = epoch + 1
         self._pages.save(index=0, data=(nxt.to_bytes(8, "little")
                                         + cmd_seq.to_bytes(8, "little")

@@ -25,7 +25,7 @@ import abc
 import struct
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from tpubft.comm.interfaces import ICommunication, IReceiver
 from tpubft.consensus import messages as m
@@ -213,7 +213,9 @@ class Replica(IReceiver):
         self._vc_started_at = 0.0
         self._last_progress = time.monotonic()
         self._forwarded: Dict[tuple, float] = {}   # (client, req_seq) -> time
-        self._batch_relayed: Dict[int, float] = {}  # client -> last relay t
+        # client -> (head req_seq of last relayed batch, relay time):
+        # backup batch-relay suppression (see _dispatch_external)
+        self._batch_relayed: Dict[int, Tuple[int, float]] = {}
         self._ck_asked: Dict[int, float] = {}      # AskForCheckpoint rate
         self._self_ck_latest: Optional[m.CheckpointMsg] = None
 
@@ -620,16 +622,29 @@ class Replica(IReceiver):
             # retrying lost replies would otherwise trigger an
             # (n-1)x-amplified re-relay of the largest message type on
             # every retry).
-            # Suppression is keyed on the principal ALONE: the client
-            # enforces one outstanding batch per principal, and keying on
-            # any element-derived value would let a spoofer mint fresh
-            # keys (and unbounded relays) by varying that element. The
-            # map is therefore bounded by the client count — no pruning.
-            if not self.is_primary and not self.in_view_change:
+            # The suppression MAP is keyed on the principal alone so it
+            # stays bounded by the client count (keying entries on any
+            # element-derived value would let a spoofer mint unbounded
+            # keys). The per-client record is (head req_seq, time): a
+            # relay fires when the batch head's req_seq ADVANCES past
+            # the last relayed one — a client pipelining faster than
+            # 1 batch/s still gets backup relay for each new batch —
+            # while a re-presented head (client retransmit of the same
+            # batch) is still rate-bounded to one relay per second
+            # (ADVICE r5). Seq advance happens pre-verify (head_seq is
+            # attacker-influencable), but that mints no amplification:
+            # each received batch yields at most ONE relay of the same
+            # bytes to one destination (the primary), so a flooder gets
+            # exactly the 1:1 traffic it could send the primary directly
+            # — the old 1/s cap only obscured the origin, it did not
+            # reduce attacker power.
+            if inners and not self.is_primary and not self.in_view_change:
                 now = time.monotonic()
+                head_seq = inners[0].req_seq_num
                 last = self._batch_relayed.get(msg.sender_id)
-                if last is None or now - last > 1.0:
-                    self._batch_relayed[msg.sender_id] = now
+                if last is None or head_seq > last[0] \
+                        or now - last[1] > 1.0:
+                    self._batch_relayed[msg.sender_id] = (head_seq, now)
                     self.comm.send(self.primary, msg.pack())
             for inner in inners:
                 self._on_client_request(inner, relay=False)

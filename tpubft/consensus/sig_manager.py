@@ -5,17 +5,24 @@ Rebuild of the reference's SigManager singleton
 SigManager.cpp:197, sign :240): holds this replica's signer plus a verifier
 per principal (replicas + clients), with verified/failed metrics.
 
-TPU-first delta: `verify_async` enqueues into a batching dispatcher
-(BatchVerifier) instead of verifying inline — callers get a future-like
-handle; the batch drains to the backend's `verify_batch`, which the TPU
-backend implements as one vmapped kernel call
-(tpubft.ops.ed25519.verify_kernel). This takes the per-message sig check
-off the dispatcher thread, the reference's RequestThreadPool role.
+TPU-first delta: ALL verification flows through one batched plane.
+`verify` is a batch of one; `BatchVerifier` coalesces async admission
+traffic into fixed-size batches; `verify_batch` front-runs everything
+with a bounded LRU memo of already-verified (principal, digest, sig)
+triples (retransmissions and view-change re-validation re-present
+identical items), then dispatches the residue as per-curve kernel calls
+(tpubft.ops.ed25519 / ops.ecdsa via the configured batch_fn) or the
+per-principal scalar fallback. Per-path counters (`memo_hits`,
+`batched_verifies`, `scalar_fallbacks`) ride the metrics component.
+This takes the per-message sig check off the dispatcher thread, the
+reference's RequestThreadPool role.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tpubft.consensus.keys import ClusterKeys
@@ -32,7 +39,8 @@ class SigManager:
                  batch_fn: Optional[Callable[
                      [Sequence[Tuple[bytes, bytes, bytes]]],
                      List[bool]]] = None,
-                 device_min_batch: int = 1):
+                 device_min_batch: int = 1,
+                 memo_capacity: int = 4096):
         self._keys = keys
         # cross-principal batch backend: [(scheme, pubkey, data, sig)] ->
         # verdicts in ONE dispatch per scheme (the TPU path; None =
@@ -72,6 +80,25 @@ class SigManager:
         # (dispatch count, not verdicts — failures land in sig_failures)
         self.sigs_device_dispatched = self.metrics.register_counter(
             "sigs_device_dispatched")
+        # verified-signature memo: bounded LRU of (principal, current
+        # pubkey, sha256(data), sig) that already verified under the
+        # CURRENT key. Retransmissions and view-change re-validation
+        # re-present identical triples; a hit short-circuits the full
+        # kernel/scalar cost. Keying on the pubkey makes rotation safe
+        # for free: a rotated principal's entries simply stop matching
+        # (and sigs accepted only via a grace key are never memoized).
+        self._memo: "OrderedDict[Tuple, None]" = OrderedDict()
+        self._memo_capacity = memo_capacity
+        self._memo_lock = threading.Lock()
+        # per-path counters (ROADMAP: make the batched plane *the* hot
+        # path and prove it) — memo short-circuits, items verified
+        # through the coalesced cross-principal batch, and items that
+        # fell back to the per-principal scalar loop
+        self.memo_hits = self.metrics.register_counter("memo_hits")
+        self.batched_verifies = self.metrics.register_counter(
+            "batched_verifies")
+        self.scalar_fallbacks = self.metrics.register_counter(
+            "scalar_fallbacks")
 
     # ---- signing ----
     def sign(self, data: bytes) -> bytes:
@@ -199,41 +226,113 @@ class SigManager:
     def has_principal(self, principal: int) -> bool:
         return self._pubkey_of(self._alias(principal)) is not None
 
+    # ---- verified-signature memo ----
+    # entries are (aliased principal, CURRENT pubkey, sha256(data), sig);
+    # keys are built inline in _verify_items from one batched pubkey
+    # resolution. No entry exists for unknown principals, and
+    # memo_capacity=0 disables the memo (benchmarks measuring the raw
+    # engine).
+    def _memo_hit(self, key: Tuple) -> bool:
+        with self._memo_lock:
+            if key in self._memo:
+                self._memo.move_to_end(key)
+                return True
+        return False
+
+    def _memo_add(self, key: Tuple) -> None:
+        with self._memo_lock:
+            self._memo[key] = None
+            self._memo.move_to_end(key)
+            while len(self._memo) > self._memo_capacity:
+                self._memo.popitem(last=False)
+
     def verify(self, principal: int, data: bytes, sig: bytes,
                seq: Optional[int] = None,
                view_scoped: bool = False) -> bool:
-        """Verify one signature. `seq` is the consensus seqnum the message
-        belongs to, when it has one; `view_scoped` marks view-change-family
-        messages (no seqnum, still in-flight protocol traffic). One of the
-        two is required for the post-rotation grace fallback —
-        verifications without protocol context never accept a rotated-away
-        key."""
-        try:
-            ok = self._verifier(principal).verify(data, sig)
-        except KeyError:
-            ok = False
-        if not ok:
-            grace = self._grace_verifier(principal, seq, view_scoped)
-            if grace is not None:
-                ok = grace.verify(data, sig)
-        (self.sigs_verified if ok else self.sig_failures).inc()
-        return ok
+        """Verify one signature — a thin wrapper over the batched plane
+        (a batch of one), so every hot-path verify shares the memo and
+        the coalescing machinery. `seq` is the consensus seqnum the
+        message belongs to, when it has one; `view_scoped` marks
+        view-change-family messages (no seqnum, still in-flight protocol
+        traffic). One of the two is required for the post-rotation grace
+        fallback — verifications without protocol context never accept a
+        rotated-away key."""
+        return self._verify_items([(principal, data, sig)], seq,
+                                  view_scoped)[0]
 
     def verify_batch(self, items: Sequence[Tuple[int, bytes, bytes]],
-                     seq: Optional[int] = None) -> List[bool]:
-        """Verify [(principal, data, sig)] — one cross-principal device
-        dispatch when a batch backend is configured (TPU) and the batch is
-        big enough to amortize it, otherwise grouped per principal with
-        each verifier free to vectorize."""
-        if self._batch_fn is not None and len(items) >= self.device_min_batch:
-            out = self._verify_batch_cross(items, seq)
-            for ok in out:
-                (self.sigs_verified if ok else self.sig_failures).inc()
-            return out
+                     seq: Optional[int] = None,
+                     view_scoped: bool = False) -> List[bool]:
+        """Verify [(principal, data, sig)] — the batch-plane entry the
+        BatchVerifier/collector workers drain into (kept as the public
+        seam: tests and wrappers intercept it to shape the async plane
+        without touching inline dispatcher verifies)."""
+        return self._verify_items(items, seq, view_scoped)
+
+    def _verify_items(self, items: Sequence[Tuple[int, bytes, bytes]],
+                      seq: Optional[int],
+                      view_scoped: bool) -> List[bool]:
+        """The one verification path: memo short-circuit first, then ONE
+        cross-principal dispatch (per-curve kernel calls) when a batch
+        backend is configured (TPU) and the residue is big enough to
+        amortize it, otherwise grouped per principal with each verifier
+        free to vectorize. Fresh verdicts verified under the current key
+        are memoized for retransmit/duplicate traffic."""
+        out: List[bool] = [False] * len(items)
+        keys: List[Optional[Tuple]] = [None] * len(items)
+        pending: List[int] = []
+        # ONE lock acquisition resolves every principal's current pubkey;
+        # the list feeds both the memo keys and the cross-batch dispatch
+        # (per-item locking on a 1000-item admission batch is pure
+        # overhead, and dispatch must not see a different key epoch than
+        # the memo did)
+        aliased = [self._alias(p) for p, _, _ in items]
+        with self._lock:
+            pks = [self._pubkey_of(a) for a in aliased]
+        memo_on = self._memo_capacity > 0
+        for i, ((p, data, sig), a, pk) in enumerate(zip(items, aliased,
+                                                        pks)):
+            key = ((a, pk, hashlib.sha256(data).digest(), bytes(sig))
+                   if memo_on and pk is not None else None)
+            if key is not None and self._memo_hit(key):
+                out[i] = True
+                self.memo_hits.inc()
+            else:
+                keys[i] = key
+                pending.append(i)
+        if pending:
+            sub = [items[i] for i in pending]
+            if self._batch_fn is not None \
+                    and len(sub) >= self.device_min_batch:
+                verdicts, via_grace = self._verify_batch_cross(
+                    sub, seq, view_scoped,
+                    aliased=[aliased[i] for i in pending],
+                    pks=[pks[i] for i in pending])
+                self.batched_verifies.inc(len(sub))
+            else:
+                verdicts, via_grace = self._verify_batch_grouped(
+                    sub, seq, view_scoped)
+                self.scalar_fallbacks.inc(len(sub))
+            for i, ok, grace in zip(pending, verdicts, via_grace):
+                out[i] = ok
+                # grace-key acceptances are deliberately NOT memoized:
+                # the memo must never outlive the grace window
+                if ok and not grace and keys[i] is not None:
+                    self._memo_add(keys[i])
+        for ok in out:
+            (self.sigs_verified if ok else self.sig_failures).inc()
+        return out
+
+    def _verify_batch_grouped(self, items: Sequence[Tuple[int, bytes, bytes]],
+                              seq: Optional[int], view_scoped: bool
+                              ) -> Tuple[List[bool], List[bool]]:
+        """Per-principal fallback: group items, let each verifier
+        vectorize its group. Returns (verdicts, accepted-via-grace-key)."""
         by_principal: Dict[int, List[int]] = {}
         for i, (p, _, _) in enumerate(items):
             by_principal.setdefault(p, []).append(i)
         out = [False] * len(items)
+        via_grace = [False] * len(items)
         for p, idxs in by_principal.items():
             try:
                 verifier = self._verifier(p)
@@ -241,43 +340,45 @@ class SigManager:
                 continue
             results = verifier.verify_batch(
                 [(items[i][1], items[i][2]) for i in idxs])
-            grace = self._grace_verifier(p, seq)
+            grace = self._grace_verifier(p, seq, view_scoped)
             for i, ok in zip(idxs, results):
-                if not ok and grace is not None:
-                    ok = grace.verify(items[i][1], items[i][2])
+                if not ok and grace is not None \
+                        and grace.verify(items[i][1], items[i][2]):
+                    ok = via_grace[i] = True
                 out[i] = ok
-        for ok in out:
-            (self.sigs_verified if ok else self.sig_failures).inc()
-        return out
+        return out, via_grace
 
     def _verify_batch_cross(self, items: Sequence[Tuple[int, bytes, bytes]],
-                            seq: Optional[int]) -> List[bool]:
-        """Resolve principals to (scheme, pubkey), run the whole batch
-        through the backend in one call (one device dispatch per scheme
-        present); failed items retry against grace keys."""
+                            seq: Optional[int], view_scoped: bool,
+                            aliased: List[int],
+                            pks: List[Optional[bytes]]
+                            ) -> Tuple[List[bool], List[bool]]:
+        """Run the whole batch through the backend in one call (one
+        device dispatch per scheme present); failed items retry against
+        grace keys. `aliased`/`pks` carry the caller's already-resolved
+        principals (resolved under the lock — a worker must not race a
+        key rotation into treating the rotated-away key as current).
+        Returns (verdicts, accepted-via-grace-key)."""
         entries = []
         keyed = []
-        with self._lock:
-            # pubkey resolution under the lock: a worker must not race a
-            # key rotation into treating the rotated-away key as current
-            resolved = [self._pubkey_of(self._alias(p))
-                        for p, _, _ in items]
-        for i, ((p, data, sig), pk) in enumerate(zip(items, resolved)):
+        for i, ((p, data, sig), a, pk) in enumerate(zip(items, aliased,
+                                                        pks)):
             if pk is not None:
-                entries.append((self._scheme_of(self._alias(p)), pk,
-                                data, sig))
+                entries.append((self._scheme_of(a), pk, data, sig))
                 keyed.append(i)
         verdicts = self._batch_fn(entries)
         # counts only what actually reached the device dispatch
         self.sigs_device_dispatched.inc(len(entries))
         out = [False] * len(items)
+        via_grace = [False] * len(items)
         for i, ok in zip(keyed, verdicts):
             if not ok:
-                grace = self._grace_verifier(items[i][0], seq)
-                if grace is not None:
-                    ok = grace.verify(items[i][1], items[i][2])
+                grace = self._grace_verifier(items[i][0], seq, view_scoped)
+                if grace is not None and grace.verify(items[i][1],
+                                                      items[i][2]):
+                    ok = via_grace[i] = True
             out[i] = ok
-        return out
+        return out, via_grace
 
 
 class PendingVerdict:

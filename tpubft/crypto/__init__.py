@@ -2,8 +2,11 @@
 
 Interfaces mirror the reference plugin boundary (IThresholdSigner/Verifier/
 Accumulator, ISigner/IVerifier, Cryptosystem) so consensus code is backend-
-agnostic; backends are "cpu" (OpenSSL via `cryptography` + pure-python BLS
-reference math) and "tpu" (batched JAX kernels in tpubft.ops).
+agnostic. The stack is self-hosted: "cpu" is the pure-stdlib scalar engine
+(crypto/scalar.py — RFC 8032 Ed25519 + RFC 6979 ECDSA) with OpenSSL via
+`cryptography` as a soft optional accelerator (runtime feature probe, never
+a module-level import), plus the pure-python BLS reference math; "tpu" is
+the batched JAX kernels in tpubft.ops — the primary verification plane.
 """
 from tpubft.crypto.interfaces import (  # noqa: F401
     ISigner, IVerifier, IThresholdSigner, IThresholdVerifier,

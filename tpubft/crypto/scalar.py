@@ -1,0 +1,438 @@
+"""Self-hosted scalar signature engine — no OpenSSL, stdlib only.
+
+Pure-Python Ed25519 (RFC 8032) and ECDSA over secp256k1 / secp256r1
+(RFC 6979 deterministic nonces, SHA-256) for the host-side sign /
+keygen / single-verify paths. The batched device kernels
+(tpubft/ops/ed25519.py, ops/ecdsa.py) stay the hot verification plane;
+this module is what makes them the PRIMARY engine rather than an
+accelerator bolted onto a third-party dependency: the whole crypto
+stack now lives in-repo, and `cryptography` (OpenSSL) is a soft
+optional speedup probed at runtime by tpubft/crypto/cpu.py.
+
+Byte compatibility contracts (locked by tests/test_crypto_scalar.py):
+  * Ed25519 keys/sigs are RFC 8032 raw encodings (32B pk, 64B sig) —
+    identical to the OpenSSL backend and the kernel verifiers;
+  * ECDSA pubkeys are SEC1 uncompressed (0x04||x||y, 65B), signatures
+    fixed-width raw r||s (64B), hash SHA-256 — the wire formats the
+    existing keyfiles and kernels already use;
+  * seed → private-key derivations reproduce the historical formulas
+    (sha256("ed25519-keygen"+seed); sha512("ecdsa-keygen"+seed) folded
+    into [1, n-1]), so keyfiles written by tpubft.tools.keygen before
+    this engine existed still load and sign identically.
+
+The group math is plain python ints: extended twisted-Edwards
+coordinates for ed25519 (same add-2008-hwcd-3 / dbl-2008-hwcd formulas
+as the device kernel in ops/ed25519.py), Jacobian coordinates for the
+short-Weierstrass curves (parameters mirrored from ops/ecdsa.CURVES).
+Fixed-base multiplications walk cached 2^i·G tables so signing and
+keygen cost ~128 group additions, not a full double-and-add ladder.
+This is NOT constant-time — neither was the OpenSSL-via-python path
+for batch shapes — and replica keys here already assume a trusted host.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import hmac
+import os
+from typing import Iterator, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Ed25519 (RFC 8032)
+# ---------------------------------------------------------------------------
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = -121665 * pow(121666, -1, P) % P
+_K2D = 2 * D % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+BASE_X = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+BASE_Y = 46316835694926478169428394003475163141307993866256225615783033603165251855960
+
+# extended coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z
+_EXT_IDENT = (0, 1, 1, 0)
+
+
+def _ext_add(p, q):
+    """Unified extended addition (add-2008-hwcd-3, a=-1, k=2d) — the
+    int-scalar twin of ops/ed25519.point_add."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = t1 * t2 % P * _K2D % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _ext_double(p):
+    """Dedicated doubling (dbl-2008-hwcd, a=-1) — twin of point_dbl."""
+    x, y, z, _ = p
+    a = x * x % P
+    b = y * y % P
+    c = 2 * z * z % P
+    e = ((x + y) * (x + y) - a - b) % P
+    g = (b - a) % P
+    h = (-a - b) % P
+    f = (g - c) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _ext_neg(p):
+    x, y, z, t = p
+    return (P - x if x else 0, y, z, P - t if t else 0)
+
+
+@functools.lru_cache(maxsize=1)
+def _base_comb_table():
+    """Comb table for fixed-base mults: tab[j][d] = [d·16^j]B for
+    j in 0..63, d in 0..15 — a 256-bit scalar mult becomes ≤64 additions
+    with zero doublings. ~1k point ops to build, built once."""
+    tab = []
+    win = (BASE_X, BASE_Y, 1, BASE_X * BASE_Y % P)
+    for _ in range(64):
+        row = [_EXT_IDENT, win]
+        for _ in range(14):
+            row.append(_ext_add(row[-1], win))
+        tab.append(row)
+        # 16^(j+1)·B = 15·16^j·B + 16^j·B
+        win = _ext_add(row[-1], row[1])
+    return tab
+
+
+def _mul_base(k: int):
+    """[k]B via the cached comb table (≤64 additions, no doublings)."""
+    acc = _EXT_IDENT
+    tab = _base_comb_table()
+    j = 0
+    while k:
+        d = k & 15
+        if d:
+            acc = _ext_add(acc, tab[j][d])
+        k >>= 4
+        j += 1
+    return acc
+
+
+def _ext_mul(k: int, pt):
+    """[k]P, 4-bit fixed-window ladder (variable base: verify only) —
+    15 table adds + 4 doublings and ≤1 add per window."""
+    row = [_EXT_IDENT, pt]
+    for _ in range(14):
+        row.append(_ext_add(row[-1], pt))
+    acc = _EXT_IDENT
+    started = False
+    for shift in range((max(k.bit_length(), 1) + 3) // 4 * 4 - 4, -1, -4):
+        if started:
+            acc = _ext_double(_ext_double(_ext_double(_ext_double(acc))))
+        d = (k >> shift) & 15
+        if d:
+            acc = _ext_add(acc, row[d])
+            started = True
+    return acc
+
+
+def _compress(pt) -> bytes:
+    x, y, z, _ = pt
+    zi = pow(z, -1, P)
+    x, y = x * zi % P, y * zi % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _decompress(b32: bytes):
+    """Canonical RFC 8032 decoding: reject y >= p and x=0 with sign=1 —
+    the same strictness as the device kernel's host prechecks."""
+    y = int.from_bytes(b32, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    if y >= P:
+        return None
+    y2 = y * y % P
+    u = (y2 - 1) % P
+    v = (D * y2 + 1) % P
+    # x = sqrt(u/v) via the (p-5)/8 exponent trick
+    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    vx2 = v * x % P * x % P
+    if vx2 != u:
+        if vx2 != P - u:
+            return None
+        x = x * SQRT_M1 % P
+    if x == 0 and sign:
+        return None
+    if (x & 1) != sign:
+        x = P - x
+    return (x, y, 1, x * y % P)
+
+
+def _clamp(b32: bytes) -> int:
+    a = int.from_bytes(b32, "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+def ed25519_seed_to_private(seed: bytes) -> bytes:
+    """Historical keyfile derivation — must never change: existing
+    keygen'd key material depends on it."""
+    return hashlib.sha256(b"ed25519-keygen" + seed).digest()
+
+
+def ed25519_public_key(sk: bytes) -> bytes:
+    h = hashlib.sha512(sk).digest()
+    return _compress(_mul_base(_clamp(h[:32])))
+
+
+def ed25519_sign(sk: bytes, msg: bytes, pk: Optional[bytes] = None) -> bytes:
+    """RFC 8032 deterministic signature — byte-identical to OpenSSL's.
+    `pk` (the signer's own public key) is recomputed when not supplied;
+    long-lived signers pass their cached copy."""
+    h = hashlib.sha512(sk).digest()
+    a = _clamp(h[:32])
+    prefix = h[32:]
+    if pk is None:
+        pk = _compress(_mul_base(a))
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+    rb = _compress(_mul_base(r))
+    k = int.from_bytes(hashlib.sha512(rb + pk + msg).digest(), "little") % L
+    s = (r + k * a) % L
+    return rb + s.to_bytes(32, "little")
+
+
+def ed25519_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """Strict cofactorless verify: s < L, canonical A and R encodings,
+    encode([s]B - [k]A) == R — the same equation and strictness as the
+    batched kernel (ops/ed25519.verify_kernel), so scalar and device
+    verdicts can never diverge."""
+    if len(sig) != 64 or len(pk) != 32:
+        return False
+    sig, pk = bytes(sig), bytes(pk)
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False                    # malleability: reject s >= L
+    a_pt = _decompress(pk)
+    if a_pt is None:
+        return False
+    k = int.from_bytes(hashlib.sha512(sig[:32] + pk + msg).digest(),
+                       "little") % L
+    q = _ext_add(_mul_base(s), _ext_mul(k, _ext_neg(a_pt)))
+    # a non-canonical R encoding can never equal a canonical compress
+    return _compress(q) == sig[:32]
+
+
+# ---------------------------------------------------------------------------
+# ECDSA over short-Weierstrass curves (SHA-256, RFC 6979 nonces)
+# ---------------------------------------------------------------------------
+
+# Parameters mirror ops/ecdsa.CURVES (cross-checked by
+# tests/test_crypto_scalar.py) — duplicated so this module stays
+# importable with zero heavyweight deps (ops/ecdsa pulls in jax).
+CURVES = {
+    "secp256k1": dict(
+        p=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F,
+        a=0, b=7,
+        gx=0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+        gy=0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+        n=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141),
+    "secp256r1": dict(
+        p=0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF,
+        a=-3, b=0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B,
+        gx=0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296,
+        gy=0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5,
+        n=0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551),
+}
+
+_JAC_IDENT = (0, 1, 0)
+
+
+def _jac_double(pt, p: int, a: int):
+    x, y, z = pt
+    if z == 0:
+        return _JAC_IDENT
+    ys = y * y % p
+    s = 4 * x * ys % p
+    z2 = z * z % p
+    m = (3 * x * x + a * z2 % p * z2) % p
+    x3 = (m * m - 2 * s) % p
+    y3 = (m * (s - x3) - 8 * ys * ys) % p
+    z3 = 2 * y * z % p
+    return (x3, y3, z3)
+
+
+def _jac_add(q, r, p: int, a: int):
+    if q[2] == 0:
+        return r
+    if r[2] == 0:
+        return q
+    z1z1 = q[2] * q[2] % p
+    z2z2 = r[2] * r[2] % p
+    u1 = q[0] * z2z2 % p
+    u2 = r[0] * z1z1 % p
+    s1 = q[1] * z2z2 % p * r[2] % p
+    s2 = r[1] * z1z1 % p * q[2] % p
+    if u1 == u2:
+        if s1 != s2:
+            return _JAC_IDENT           # P + (-P)
+        return _jac_double(q, p, a)
+    h = (u2 - u1) % p
+    rr = (s2 - s1) % p
+    h2 = h * h % p
+    h3 = h * h2 % p
+    v = u1 * h2 % p
+    x3 = (rr * rr - h3 - 2 * v) % p
+    y3 = (rr * (v - x3) - s1 * h3) % p
+    z3 = h * q[2] % p * r[2] % p
+    return (x3, y3, z3)
+
+
+def _jac_to_affine(pt, p: int) -> Optional[Tuple[int, int]]:
+    x, y, z = pt
+    if z == 0:
+        return None
+    zi = pow(z, -1, p)
+    zi2 = zi * zi % p
+    return (x * zi2 % p, y * zi2 % p * zi % p)
+
+
+@functools.lru_cache(maxsize=None)
+def _g_table(curve_name: str):
+    """2^i·G in Jacobian coords — fixed-base mult for sign/keygen."""
+    cv = CURVES[curve_name]
+    p, a = cv["p"], cv["a"]
+    tab = []
+    pt = (cv["gx"], cv["gy"], 1)
+    for _ in range(256):
+        tab.append(pt)
+        pt = _jac_double(pt, p, a)
+    return tab
+
+
+def _mul_g(k: int, curve_name: str):
+    cv = CURVES[curve_name]
+    p, a = cv["p"], cv["a"]
+    acc = _JAC_IDENT
+    tab = _g_table(curve_name)
+    i = 0
+    while k:
+        if k & 1:
+            acc = _jac_add(acc, tab[i], p, a)
+        k >>= 1
+        i += 1
+    return acc
+
+
+def _jac_mul(k: int, affine, cv):
+    p, a = cv["p"], cv["a"]
+    acc = _JAC_IDENT
+    base = (affine[0], affine[1], 1)
+    for i in range(k.bit_length() - 1, -1, -1):
+        acc = _jac_double(acc, p, a)
+        if (k >> i) & 1:
+            acc = _jac_add(acc, base, p, a)
+    return acc
+
+
+def ecdsa_seed_to_private(seed: bytes, curve_name: str) -> int:
+    """Historical keyfile derivation — must never change (see
+    ed25519_seed_to_private)."""
+    n = CURVES[curve_name]["n"]
+    v = int.from_bytes(hashlib.sha512(b"ecdsa-keygen" + seed).digest(), "big")
+    return v % (n - 1) + 1
+
+
+def ecdsa_random_private(curve_name: str) -> int:
+    n = CURVES[curve_name]["n"]
+    return int.from_bytes(os.urandom(48), "big") % (n - 1) + 1
+
+
+def ecdsa_public_key(d: int, curve_name: str) -> bytes:
+    """SEC1 uncompressed point: 0x04 || x || y (65 bytes)."""
+    aff = _jac_to_affine(_mul_g(d, curve_name), CURVES[curve_name]["p"])
+    assert aff is not None, "private value is a multiple of the order"
+    return b"\x04" + aff[0].to_bytes(32, "big") + aff[1].to_bytes(32, "big")
+
+
+def _rfc6979_nonces(x: int, h1: bytes, q: int) -> Iterator[int]:
+    """RFC 6979 §3.2 deterministic nonce stream (HMAC-SHA256), qlen=256."""
+    qlen = (q.bit_length() + 7) // 8
+
+    def bits2int(b: bytes) -> int:
+        v = int.from_bytes(b, "big")
+        extra = len(b) * 8 - q.bit_length()
+        return v >> extra if extra > 0 else v
+
+    bx = x.to_bytes(qlen, "big") + (bits2int(h1) % q).to_bytes(qlen, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + bx, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + bx, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        t = b""
+        while len(t) < qlen:
+            v = hmac.new(k, v, hashlib.sha256).digest()
+            t += v
+        cand = bits2int(t)
+        if 1 <= cand < q:
+            yield cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def ecdsa_sign(d: int, msg: bytes, curve_name: str) -> bytes:
+    """Deterministic ECDSA (RFC 6979, SHA-256), raw r||s output. The
+    OpenSSL path signs with a random nonce — both verify identically;
+    determinism here buys reproducible tests and no RNG dependence."""
+    cv = CURVES[curve_name]
+    n = cv["n"]
+    h1 = hashlib.sha256(msg).digest()
+    z = int.from_bytes(h1, "big") % n
+    for k in _rfc6979_nonces(d, h1, n):
+        aff = _jac_to_affine(_mul_g(k, curve_name), cv["p"])
+        if aff is None:
+            continue
+        r = aff[0] % n
+        if r == 0:
+            continue
+        s = pow(k, -1, n) * (z + r * d) % n
+        if s == 0:
+            continue
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    raise AssertionError("unreachable: RFC 6979 stream exhausted")
+
+
+def ecdsa_on_curve(x: int, y: int, curve_name: str) -> bool:
+    cv = CURVES[curve_name]
+    p = cv["p"]
+    if not (0 <= x < p and 0 <= y < p):
+        return False
+    return (y * y - (x * x * x + cv["a"] * x + cv["b"])) % p == 0
+
+
+def ecdsa_verify(pk: bytes, msg: bytes, sig: bytes, curve_name: str) -> bool:
+    """Standard ECDSA verify with the same admission checks as the
+    batched kernel's host precheck (ops/ecdsa.prepare_batch): shapes,
+    0 < r,s < n, pubkey on curve; then x([u1]G + [u2]Q) ≡ r (mod n)."""
+    cv = CURVES[curve_name]
+    p, n = cv["p"], cv["n"]
+    if len(sig) != 64 or len(pk) != 65 or pk[0] != 0x04:
+        return False
+    sig, pk = bytes(sig), bytes(pk)
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    x = int.from_bytes(pk[1:33], "big")
+    y = int.from_bytes(pk[33:], "big")
+    if not (0 < r < n and 0 < s < n):
+        return False
+    if not ecdsa_on_curve(x, y, curve_name):
+        return False
+    z = int.from_bytes(hashlib.sha256(msg).digest(), "big") % n
+    w = pow(s, -1, n)
+    u1, u2 = z * w % n, r * w % n
+    pt = _jac_add(_mul_g(u1, curve_name), _jac_mul(u2, (x, y), cv),
+                  p, cv["a"])
+    aff = _jac_to_affine(pt, p)
+    if aff is None:
+        return False                    # R' is the identity
+    return aff[0] % n == r

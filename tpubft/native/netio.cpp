@@ -10,8 +10,13 @@
 // changing wire behavior.
 //
 // Input: n records packed back-to-back, each
-//   | u32 ipv4 (network byte order) | u16 port (host order) |
-//   | u32 payload length            | payload bytes          |
+//   | u32 ipv4 (network byte order) | u16 port (LITTLE-endian) |
+//   | u32 payload length (LITTLE-endian) | payload bytes       |
+// The wire record byte order is DEFINED (little-endian for the scalar
+// fields, assembled byte-by-byte below) rather than inherited from the
+// host: the Python side packs with to_bytes(..., "little"), and a
+// host-order memcpy here would silently byte-swap port/length on a
+// big-endian host.
 // Returns datagrams handed to the kernel (best-effort, like UDP), or -1
 // on a malformed buffer.
 #include <arpa/inet.h>
@@ -35,11 +40,13 @@ int net_sendmmsg(int fd, const uint8_t* buf, uint32_t buflen, int n) {
     const int batch = n > kMaxBatch ? kMaxBatch : n;
     for (int i = 0; i < batch; i++) {
       if (p + 10 > end) return -1;
-      uint32_t ip, len;
-      uint16_t port;
-      memcpy(&ip, p, 4);
-      memcpy(&port, p + 4, 2);
-      memcpy(&len, p + 6, 4);
+      uint32_t ip;
+      memcpy(&ip, p, 4);  // already network order: passed through as-is
+      const uint16_t port = static_cast<uint16_t>(p[4] | (p[5] << 8));
+      const uint32_t len = static_cast<uint32_t>(p[6]) |
+                           (static_cast<uint32_t>(p[7]) << 8) |
+                           (static_cast<uint32_t>(p[8]) << 16) |
+                           (static_cast<uint32_t>(p[9]) << 24);
       p += 10;
       if (p + len > end) return -1;
       memset(&addrs[i], 0, sizeof(sockaddr_in));

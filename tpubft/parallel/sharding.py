@@ -22,6 +22,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS = "shard"
 
 
+def _shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """Version shim: jax >= 0.5 exposes jax.shard_map (replication check
+    flag `check_vma`); 0.4.x has jax.experimental.shard_map.shard_map
+    (flag `check_rep`). The check is disabled either way — the ladder's
+    initial carry is an unvarying constant (identity point) which the
+    varying-manual-axes checker rejects."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
@@ -48,11 +62,9 @@ def sharded_msm_kernel(mesh: Mesh):
         return total.x, total.y, total.z
 
     shard = P(None, AXIS)
-    # check_vma=False: the ladder's initial carry is an unvarying constant
-    # (identity point) which the varying-manual-axes checker rejects.
-    fn = jax.shard_map(local_msm, mesh=mesh,
-                       in_specs=(shard, shard, shard, P(AXIS)),
-                       out_specs=(P(None, None),) * 3, check_vma=False)
+    fn = _shard_map(local_msm, mesh,
+                    in_specs=(shard, shard, shard, P(AXIS)),
+                    out_specs=(P(None, None),) * 3)
     return jax.jit(fn)
 
 
