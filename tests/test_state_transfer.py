@@ -203,6 +203,264 @@ def test_source_rejects_out_of_range():
     assert rejected and isinstance(rejected[0], stm.RejectFetching)
 
 
+# ---------------- pipelined fetch: fault matrix ----------------
+
+def _pipelined_setup(n_blocks, n_sources, dest_cfg, src_cfg=None):
+    """n_sources honest managers over one chain + an empty destination;
+    returns (chain, net, dest_bc, dest, done). Sources/dest are bound
+    with quorum == n_sources so EVERY source becomes a fetch candidate."""
+    chain = _make_chain(n_blocks)
+    net = _Net()
+    for r in range(n_sources):
+        mgr = StateTransferManager(r, chain, src_cfg)
+        net.add(r, mgr)
+        mgr.bind(net.sender(r), lambda s, d: None,
+                 replica_ids=list(range(n_sources)) + [9], f_val=1)
+        mgr.on_checkpoint_stable(5, chain.state_digest())
+    dest_bc = KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+    dest = StateTransferManager(9, dest_bc, dest_cfg)
+    net.add(9, dest)
+    done = []
+    dest.bind(net.sender(9), lambda s, d: done.append((s, d)),
+              replica_ids=list(range(n_sources)), f_val=n_sources - 1)
+    return chain, net, dest_bc, dest, done
+
+
+def test_pipelined_source_death_mid_window():
+    """A source that stops answering mid-transfer stalls only ITS range:
+    the tick timeout charges that source and re-assigns the range to the
+    next-best candidate without resetting the whole transfer."""
+    import time
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        32, 3, StConfig(fetch_batch_blocks=4, window_ranges=3,
+                        retry_timeout_s=0.05))
+    served = {"n": 0}
+
+    # source 0 is deterministically the first pick (all-zero scoreboard
+    # ties break on id) — kill THAT one so a stalled range is guaranteed
+    def die_after_first_item(from_id, dest_id, payload):
+        if from_id == 0 and isinstance(stm.unpack(payload), stm.ItemData):
+            served["n"] += 1
+            if served["n"] > 2:
+                return None                      # source 1 went dark
+        return payload
+    net.taps.append(die_after_first_item)
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    deadline = time.monotonic() + 10
+    while not done and time.monotonic() < deadline:
+        time.sleep(0.06)
+        dest.tick()
+    assert done == [(5, chain.state_digest())]
+    assert dest_bc.state_digest() == chain.state_digest()
+    assert dest.metrics.snapshot()["counters"]["source_failovers"] >= 1
+
+
+def test_pipelined_corruption_punishes_only_guilty_source():
+    """Corrupt payloads from one source fail that WINDOW's digest batch;
+    only the guilty source is charged and only its ranges re-assigned —
+    ranges served by honest sources are never re-fetched."""
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        24, 3, StConfig(fetch_batch_blocks=4, window_ranges=3))
+
+    # corrupt the deterministic first pick — over the synchronous test
+    # net ranges complete inline, so the guilty source must be the one
+    # the scoreboard actually selects
+    def corrupt(from_id, dest_id, payload):
+        if from_id == 0:
+            msg = stm.unpack(payload)
+            if isinstance(msg, stm.ItemData):
+                msg.payload = b"\x00" + msg.payload[1:]
+                return stm.pack(msg)
+        return payload
+    net.taps.append(corrupt)
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    assert done == [(5, chain.state_digest())]
+    assert dest_bc.state_digest() == chain.state_digest()
+    counters = dest.metrics.snapshot()["counters"]
+    assert counters["source_failovers"] >= 1
+    # exactly one range re-queued per failover — honest sources' in-flight
+    # ranges survived every punishment
+    assert counters["ranges_requeued"] == counters["source_failovers"]
+    # scoreboard: the lying source burned its budget; the honest ones are
+    # clean (their failure counts were never touched or were cleared on
+    # linked ranges)
+    assert dest.sources.stats(0) is None or \
+        dest.sources.stats(0).abandoned or dest.sources.stats(0).failures > 0
+    for honest in (1, 2):
+        st = dest.sources.stats(honest)
+        assert st is not None and not st.abandoned and st.failures == 0
+
+
+def test_pipelined_out_of_order_completion_links_correctly():
+    """A later range completing before an earlier one stages out of order;
+    the chain links only when the prefix arrives, and ends identical."""
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        16, 2, StConfig(fetch_batch_blocks=8, window_ranges=2,
+                        retry_timeout_s=60.0))
+    held = []
+
+    def hold_source0_items(from_id, dest_id, payload):
+        if from_id == 0 and isinstance(stm.unpack(payload), stm.ItemData):
+            held.append(payload)
+            return None
+        return payload
+    net.taps.append(hold_source0_items)
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    # range [9,16] (source 1) finished and staged; range [1,8] (source 0)
+    # is held, so nothing is linkable yet
+    assert not done
+    assert dest_bc.last_block_id == 0
+    assert dest_bc.has_st_block(16) and not dest_bc.has_st_block(1)
+    net.taps.clear()
+    for payload in held:
+        dest.handle_message(0, payload)
+    assert done == [(5, chain.state_digest())]
+    assert dest_bc.last_block_id == 16
+    assert dest_bc.state_digest() == chain.state_digest()
+
+
+def test_pipelined_window_one_degenerates_to_stop_and_wait():
+    """window_ranges=1 is the old behavior: never more than one range in
+    flight, requests strictly sequential."""
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        20, 2, StConfig(fetch_batch_blocks=4, window_ranges=1))
+    max_inflight = {"n": 0}
+
+    def watch(from_id, dest_id, payload):
+        max_inflight["n"] = max(max_inflight["n"], len(dest._ranges))
+        return payload
+    net.taps.append(watch)
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    assert done == [(5, chain.state_digest())]
+    assert max_inflight["n"] <= 1
+    assert dest_bc.state_digest() == chain.state_digest()
+
+
+def test_window_digests_route_through_device_kernel(monkeypatch):
+    """Full windows hash their leaves via ops/sha256 in ONE batched call
+    per window (counter-visible); the tail window below the cutoff stays
+    on hashlib."""
+    import tpubft.ops.sha256 as ops_sha
+    calls = []
+    real = ops_sha.sha256_batch_mixed
+    monkeypatch.setattr(ops_sha, "sha256_batch_mixed",
+                        lambda msgs: (calls.append(len(msgs)), real(msgs))[1])
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        20, 2, StConfig(fetch_batch_blocks=8, window_ranges=2,
+                        device_digest_threshold=8,
+                        use_device_digests=True))
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    assert done == [(5, chain.state_digest())]
+    counters = dest.metrics.snapshot()["counters"]
+    # 20 blocks / range 8 -> two full windows on device + a 4-block tail
+    # under the cutoff on hashlib
+    assert calls == [8, 8]
+    assert counters["device_digest_batches"] == 2
+    assert counters["scalar_digests"] == 4
+
+
+def test_no_device_run_falls_back_to_hashlib(monkeypatch):
+    """With no usable device (the kernel raises), window verification
+    degrades to scalar hashlib digests and the transfer still completes."""
+    import tpubft.ops.sha256 as ops_sha
+
+    def boom(msgs):
+        raise RuntimeError("no device")
+    monkeypatch.setattr(ops_sha, "sha256_batch_mixed", boom)
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        16, 2, StConfig(fetch_batch_blocks=8, window_ranges=2,
+                        device_digest_threshold=8,
+                        use_device_digests=True))
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    assert done == [(5, chain.state_digest())]
+    counters = dest.metrics.snapshot()["counters"]
+    assert counters["device_digest_batches"] == 0
+    assert counters["scalar_digests"] == 16
+    assert counters["source_failovers"] == 0
+
+
+def test_chunk_total_flip_punishes_source():
+    """A byzantine source flipping total_chunks between chunks of the
+    same block must not confuse reassembly: the flip is detected, the
+    source punished, and the transfer completes from honest peers."""
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        8, 2, StConfig(fetch_batch_blocks=4, window_ranges=2),
+        # small source-side chunks so every block ships as several chunks
+        src_cfg=StConfig(max_chunk_bytes=48))
+
+    def flip_total(from_id, dest_id, payload):
+        if from_id == 0:
+            msg = stm.unpack(payload)
+            if isinstance(msg, stm.ItemData) and msg.chunk_idx == 1:
+                msg.total_chunks += 1
+                return stm.pack(msg)
+        return payload
+    net.taps.append(flip_total)
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    assert done == [(5, chain.state_digest())]
+    assert dest_bc.state_digest() == chain.state_digest()
+    assert dest.metrics.snapshot()["counters"]["source_failovers"] >= 1
+    st = dest.sources.stats(0)
+    assert st is not None and (st.abandoned or st.failures > 0)
+
+
+def test_chunk_proof_flip_punishes_source():
+    """Same for the RVT proof: all chunks of one block must carry the
+    SAME proof — a mid-block proof swap is malformed, not trusted."""
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        8, 2, StConfig(fetch_batch_blocks=4, window_ranges=2),
+        src_cfg=StConfig(max_chunk_bytes=48))
+
+    def flip_proof(from_id, dest_id, payload):
+        if from_id == 0:
+            msg = stm.unpack(payload)
+            if isinstance(msg, stm.ItemData) and msg.chunk_idx == 1:
+                msg.proof = stm.RvtProof(path=[b"\x13" * 32], peaks=[])
+                return stm.pack(msg)
+        return payload
+    net.taps.append(flip_proof)
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    assert done == [(5, chain.state_digest())]
+    assert dest_bc.state_digest() == chain.state_digest()
+    st = dest.sources.stats(0)
+    assert st is not None and (st.abandoned or st.failures > 0)
+
+
+def test_implausible_chunk_count_punishes_source():
+    """total_chunks is attacker-chosen metadata: a value no real block
+    could need (reassembly buffers chunks until all arrive!) must punish
+    the source BEFORE anything is buffered, not stream into memory."""
+    chain, net, dest_bc, dest, done = _pipelined_setup(
+        8, 2, StConfig(fetch_batch_blocks=4, window_ranges=2))
+
+    def huge_total(from_id, dest_id, payload):
+        if from_id == 0:
+            msg = stm.unpack(payload)
+            if isinstance(msg, stm.ItemData) and msg.chunk_idx == 0:
+                msg.total_chunks = 1 << 30
+                return stm.pack(msg)
+        return payload
+    net.taps.append(huge_total)
+    dest.start_collecting(5, {5: (chain.state_digest(), b"")})
+    assert done == [(5, chain.state_digest())]
+    assert dest_bc.state_digest() == chain.state_digest()
+    st = dest.sources.stats(0)
+    assert st is not None and (st.abandoned or st.failures > 0)
+
+
+def test_link_st_chain_segments_large_suffix(monkeypatch):
+    """A staged suffix larger than LINK_SEGMENT_BLOCKS links in several
+    bounded atomic segments; merkle reads that cross a segment boundary
+    must see the previous segment's committed writes."""
+    src = _make_chain(10)
+    dst = KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+    monkeypatch.setattr(KeyValueBlockchain, "LINK_SEGMENT_BLOCKS", 4)
+    dst.add_raw_st_blocks({b: src.get_raw_block(b) for b in range(1, 11)})
+    assert dst.link_st_chain() == 10
+    assert dst.state_digest() == src.state_digest()
+    assert dst.merkle_root("m") == src.merkle_root("m")
+
+
 # ---------------- end-to-end: lagging replica catches up ----------------
 
 def _skvbc_factory(_r=None):

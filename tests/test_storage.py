@@ -85,6 +85,35 @@ def test_native_persistence_and_recovery(tmp_path):
     db.close()
 
 
+def test_native_sync_families_carveout(tmp_path):
+    """sync_writes=False + sync_families: batches touching a carved-out
+    family (consensus metadata) fsync, everything else stays unsynced —
+    and all data is durable across a clean close/reopen either way."""
+    from tpubft.storage.interfaces import WriteBatch
+    path = str(tmp_path / "db.kvlog")
+    db = NativeDB(path, sync_writes=False,
+                  sync_families=(b"metadata", b"metaseq"))
+    # metadata batch -> hits the kvlog_sync path
+    db.write(WriteBatch().put(b"\x00\x00\x00\x02", b"desc", b"metadata"))
+    db.write(WriteBatch().put((5).to_bytes(8, "big"), b"row", b"metaseq"))
+    # block-data batch -> no sync
+    db.write(WriteBatch().put(b"blk1", b"payload", b"blk.blocks"))
+    # a family whose name merely PREFIXES a sync family must not match
+    # (prefix check runs on the length-prefixed physical key)
+    db.write(WriteBatch().put(b"x", b"y", b"meta"))
+    db.close()
+    db = NativeDB(path)
+    assert db.get(b"\x00\x00\x00\x02", b"metadata") == b"desc"
+    assert db.get((5).to_bytes(8, "big"), b"metaseq") == b"row"
+    assert db.get(b"blk1", b"blk.blocks") == b"payload"
+    assert db.get(b"x", b"meta") == b"y"
+    db.close()
+    # sync_writes=True ignores the carve-out (everything already syncs)
+    db = NativeDB(path, sync_writes=True, sync_families=(b"metadata",))
+    assert db._sync_prefixes == ()
+    db.close()
+
+
 def test_native_compaction(tmp_path):
     path = str(tmp_path / "db.kvlog")
     db = NativeDB(path, sync_writes=False)

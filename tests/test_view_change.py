@@ -159,8 +159,25 @@ def test_forged_certificate_rejected():
             return False
 
     from tpubft.consensus.replica import share_digest
+    sd = lambda kind, view, seq, d: share_digest(kind, 0, view, seq, d)
     assert vc.validate_certificate(
-        cert, share_digest, lambda kind: RejectingVerifier()) is None
+        cert, sd, lambda kind: RejectingVerifier()) is None
+
+
+def test_share_digest_binds_epoch():
+    """The signed share digest must change with the reconfiguration era:
+    a share (or combined certificate) minted in a dead epoch can never
+    match the digest a current-era collector or view-change validator
+    derives — the era gate no longer rests on the unauthenticated wire
+    field (ADVICE r5)."""
+    from tpubft.consensus.replica import share_digest
+    d0 = share_digest("prepare", 0, 1, 5, b"\x07" * 32)
+    d1 = share_digest("prepare", 1, 1, 5, b"\x07" * 32)
+    assert d0 != d1
+    # and it still separates kind / view / seq as before
+    assert d0 != share_digest("commit", 0, 1, 5, b"\x07" * 32)
+    assert d0 != share_digest("prepare", 0, 2, 5, b"\x07" * 32)
+    assert d0 != share_digest("prepare", 0, 1, 6, b"\x07" * 32)
 
 
 def test_restriction_rejects_wrong_body():
@@ -190,6 +207,7 @@ def test_restriction_rejects_wrong_body():
 
 def test_restrictions_pick_highest_view():
     from tpubft.consensus.replica import share_digest
+    sd = lambda kind, view, seq, d: share_digest(kind, 0, view, seq, d)
 
     class AcceptingVerifier:
         threshold = 3
@@ -211,7 +229,7 @@ def test_restrictions_pick_highest_view():
 
     restr = vc.compute_restrictions(
         [make_vc(1, 0), make_vc(2, 2), make_vc(3, 1)],
-        share_digest, lambda kind: AcceptingVerifier(), report_quorum=2)
+        sd, lambda kind: AcceptingVerifier(), report_quorum=2)
     assert restr[3].view == 2
 
 
@@ -220,6 +238,7 @@ def test_signed_reports_restrict_fast_path():
     restriction — this is the only evidence a fast-path commit leaves at
     the share signers."""
     from tpubft.consensus.replica import share_digest
+    sd = lambda kind, view, seq, d: share_digest(kind, 0, view, seq, d)
     pp = m.PrePrepareMsg(
         sender_id=0, view=0, seq_num=7, first_path=0, time=0,
         requests_digest=m.PrePrepareMsg.compute_requests_digest([]),
@@ -234,11 +253,11 @@ def test_signed_reports_restrict_fast_path():
                                signature=b"")
 
     # below quorum: no restriction
-    restr = vc.compute_restrictions([make_vc(1)], share_digest,
+    restr = vc.compute_restrictions([make_vc(1)], sd,
                                     lambda kind: None, report_quorum=2)
     assert 7 not in restr
     # at quorum: restricted (digest-only until the body resolves)
-    restr = vc.compute_restrictions([make_vc(1), make_vc(2)], share_digest,
+    restr = vc.compute_restrictions([make_vc(1), make_vc(2)], sd,
                                     lambda kind: None, report_quorum=2)
     assert restr[7].pp_digest == pp.digest()
     assert not restr[7].resolved

@@ -290,10 +290,14 @@ class _SignedShareBase(ConsensusMsg):
     sender_id: int
     view: int
     seq_num: int
-    digest: bytes                 # commit_digest(view, seq, ppDigest)
+    digest: bytes                 # share_digest(kind, epoch, view, seq, ppD)
     sig: bytes                    # share (Partial) or combined (Full)
     epoch: int = 0                # reconfiguration era (SignedShareMsgs
-                                  # carry epochNum in the reference too)
+                                  # carry epochNum in the reference too).
+                                  # The era is ALSO bound inside `digest`
+                                  # (replica.share_digest), so the gate on
+                                  # these messages is authenticated — this
+                                  # wire field is a fast-drop hint only
     SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
             ("digest", "bytes"), ("sig", "bytes"), ("epoch", "u64")]
 

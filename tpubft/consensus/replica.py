@@ -59,12 +59,17 @@ from tpubft.utils.metrics import Aggregator, Component
 log = get_logger("replica")
 
 
-def share_digest(kind: str, view: int, seq_num: int, pp_digest: bytes) -> bytes:
+def share_digest(kind: str, epoch: int, view: int, seq_num: int,
+                 pp_digest: bytes) -> bytes:
     """Domain-separated digest each threshold share signs: 'prepare' and
     'commit' rounds must not be cross-replayable (the reference separates
-    them by message type inside the signed blob)."""
-    return sha256(kind.encode() + b"|" + struct.pack("<QQ", view, seq_num)
-                  + pp_digest)
+    them by message type inside the signed blob). The reconfiguration ERA
+    is bound into the signed bytes, so the era gate on Prepare/Commit
+    shares and FullCommitProof no longer rests on the unauthenticated
+    `epoch` wire field — a share signed in a dead era can never combine
+    into (or validate as) a certificate for the current one."""
+    return sha256(kind.encode() + b"|"
+                  + struct.pack("<QQQ", epoch, view, seq_num) + pp_digest)
 
 
 class IRequestsHandler(abc.ABC):
@@ -568,6 +573,14 @@ class Replica(IReceiver):
         """The reconfiguration era this replica stamps on (and requires
         of) protocol messages (reference EpochManager selfEpochNumber)."""
         return self.epoch_mgr.self_epoch
+
+    def _share_digest(self, kind: str, view: int, seq_num: int,
+                      pp_digest: bytes) -> bytes:
+        """share_digest bound to OUR current era — every share signed or
+        validated by this replica (including certificate validation
+        during view change) authenticates the epoch instead of trusting
+        the wire field."""
+        return share_digest(kind, self.epoch, view, seq_num, pp_digest)
 
     def _dispatch_external(self, sender: int, msg) -> None:
         # era gate (reference: per-message epochNum checks, e.g.
@@ -1125,7 +1138,7 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     def _send_prepare_partial(self, info: SeqNumInfo) -> None:
         pp = info.pre_prepare
-        d = share_digest("prepare", self.view, pp.seq_num, pp.digest())
+        d = self._share_digest("prepare", self.view, pp.seq_num, pp.digest())
         share = self.slow_signer.sign_share(d)
         msg = m.PreparePartialMsg(sender_id=self.id, view=self.view,
                                   seq_num=pp.seq_num, digest=d, sig=share,
@@ -1138,7 +1151,7 @@ class Replica(IReceiver):
 
     def _send_commit_partial(self, info: SeqNumInfo) -> None:
         pp = info.pre_prepare
-        d = share_digest("commit", self.view, pp.seq_num, pp.digest())
+        d = self._share_digest("commit", self.view, pp.seq_num, pp.digest())
         share = self.slow_signer.sign_share(d)
         msg = m.CommitPartialMsg(sender_id=self.id, view=self.view,
                                  seq_num=pp.seq_num, digest=d, sig=share,
@@ -1159,7 +1172,7 @@ class Replica(IReceiver):
         """Fast path share (reference sendPartialProof ReplicaImp.cpp:1319)."""
         pp = info.pre_prepare
         signer, _, tag = self._fast_tools(pp.first_path)
-        d = share_digest(tag, self.view, pp.seq_num, pp.digest())
+        d = self._share_digest(tag, self.view, pp.seq_num, pp.digest())
         msg = m.PartialCommitProofMsg(sender_id=self.id, view=self.view,
                                       epoch=self.epoch,
                                       seq_num=pp.seq_num, digest=d,
@@ -1207,7 +1220,7 @@ class Replica(IReceiver):
                 _, verifier, tag = self._fast_tools(pp.first_path)
             else:
                 verifier, tag = self.slow_verifier, kind
-            d = share_digest(tag, self.view, pp.seq_num, pp.digest())
+            d = self._share_digest(tag, self.view, pp.seq_num, pp.digest())
             col = ShareCollector(self.view, pp.seq_num, kind, d, verifier)
             setattr(info, attr, col)
         return col
@@ -1242,7 +1255,7 @@ class Replica(IReceiver):
         pp = info.pre_prepare
         if res.kind == "fast":
             _, _, tag = self._fast_tools(pp.first_path)
-            d = share_digest(tag, self.view, pp.seq_num, pp.digest())
+            d = self._share_digest(tag, self.view, pp.seq_num, pp.digest())
             full = m.FullCommitProofMsg(sender_id=self.id, view=self.view,
                                         seq_num=res.seq_num, digest=d,
                                         sig=res.combined_sig,
@@ -1250,7 +1263,7 @@ class Replica(IReceiver):
             self._broadcast_tracked(full)
             self._accept_full_commit_proof(full)
             return
-        d = share_digest(res.kind, self.view, pp.seq_num, pp.digest())
+        d = self._share_digest(res.kind, self.view, pp.seq_num, pp.digest())
         if res.kind == "prepare":
             full = m.PrepareFullMsg(sender_id=self.id, view=self.view,
                                     seq_num=res.seq_num, digest=d,
@@ -1283,8 +1296,8 @@ class Replica(IReceiver):
             _, verifier, tag = self._fast_tools(info.pre_prepare.first_path)
         else:
             verifier, tag = self.slow_verifier, kind
-        d = share_digest(tag, self.view, msg.seq_num,
-                         info.pre_prepare.digest())
+        d = self._share_digest(tag, self.view, msg.seq_num,
+                               info.pre_prepare.digest())
         if msg.digest != d:
             return None
         return verifier, d
@@ -2137,7 +2150,7 @@ class Replica(IReceiver):
                     self._broadcast(vc)
             self._broadcast(nv)
             restrictions = compute_restrictions(
-                quorum, share_digest, self._verifier_for_cert_kind,
+                quorum, self._share_digest, self._verifier_for_cert_kind,
                 self.info.f + self.info.c + 1)
             self._entered_view_proof = (nv, list(quorum))
             self._resolve_and_enter(new_view, restrictions)
@@ -2149,7 +2162,7 @@ class Replica(IReceiver):
             if matched is None:
                 return                          # still missing VC msgs
             restrictions = compute_restrictions(
-                matched, share_digest, self._verifier_for_cert_kind,
+                matched, self._share_digest, self._verifier_for_cert_kind,
                 self.info.f + self.info.c + 1)
             self._entered_view_proof = (nv, list(matched))
             self._resolve_and_enter(new_view, restrictions)

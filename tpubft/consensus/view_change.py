@@ -161,8 +161,11 @@ def validate_certificate(cert: m.PreparedCertificate, share_digest_fn,
     compute_restrictions.
 
     `share_digest_fn(tag, view, seq, pp_digest)` must be the replica's
-    share-digest derivation; `verifier_for_kind(kind)` returns the
-    IThresholdVerifier whose combined signature the cert carries.
+    share-digest derivation — in production Replica._share_digest, which
+    additionally binds the replica's current reconfiguration epoch, so a
+    certificate assembled from dead-era shares cannot validate here;
+    `verifier_for_kind(kind)` returns the IThresholdVerifier whose
+    combined signature the cert carries.
     """
     tag = _CERT_TAG.get(cert.kind)
     if tag is None:

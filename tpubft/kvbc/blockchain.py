@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from tpubft.kvbc import categories as cat
 from tpubft.kvbc.sparse_merkle import SparseMerkleTree
-from tpubft.storage.interfaces import IDBClient, WriteBatch
+from tpubft.storage.interfaces import IDBClient, WriteBatch, fkey
 from tpubft.utils import serialize as ser
 
 _BLOCKS = b"blk.blocks"
@@ -51,6 +51,58 @@ def _bid(block_id: int) -> bytes:
     return block_id.to_bytes(8, "big")
 
 
+class _MirroredBatch(WriteBatch):
+    """WriteBatch that mirrors every op into an overlay dict (physical
+    key -> value-or-None) so staging reads issued later in the SAME batch
+    observe earlier staged writes (read-your-writes for batched ST
+    linking)."""
+
+    def __init__(self, overlay: Dict[bytes, Optional[bytes]]) -> None:
+        super().__init__()
+        self._overlay = overlay
+
+    def put(self, key: bytes, value: bytes,
+            family: bytes = b"default") -> "WriteBatch":
+        self._overlay[fkey(family, key)] = bytes(value)
+        return super().put(key, value, family)
+
+    def delete(self, key: bytes,
+               family: bytes = b"default") -> "WriteBatch":
+        self._overlay[fkey(family, key)] = None
+        return super().delete(key, family)
+
+
+class _StagedReadView(IDBClient):
+    """Read view over (overlay, base db) used while linking several
+    staged blocks into one WriteBatch: block N+1's staging must see block
+    N's pending writes (parent block row, merkle nodes, immutable-rewrite
+    checks) before anything hits the real DB. Every staging read in both
+    ledger engines is a point `get`; mutations during staging go through
+    the shared batch, never this view."""
+
+    def __init__(self, base: IDBClient,
+                 overlay: Dict[bytes, Optional[bytes]]) -> None:
+        self._base = base
+        self._overlay = overlay
+
+    def get(self, key: bytes, family: bytes = b"default"):
+        pk = fkey(family, key)
+        if pk in self._overlay:
+            return self._overlay[pk]
+        return self._base.get(key, family)
+
+    def write(self, batch: WriteBatch) -> None:
+        raise BlockchainError("staged read view is read-only")
+
+    def range_iter(self, family: bytes = b"default", start=None, end=None):
+        # staging never range-scans; reads that do (proof serving) run
+        # outside the link path, against the committed base
+        return self._base.range_iter(family, start, end)
+
+    def close(self) -> None:  # pragma: no cover - never owned
+        pass
+
+
 class BlockStoreMixin:
     """Shared block-store + ST-staging + pruning plumbing for both ledger
     engines (categorized and v4 — they differ only in keyspace names and
@@ -62,6 +114,13 @@ class BlockStoreMixin:
     _F_BLOCKS: bytes
     _F_MISC: bytes
     _F_ST: bytes
+
+    # blocks adopted per atomic commit inside link_st_chain: bounds the
+    # in-memory batch + overlay when a huge staged suffix becomes
+    # linkable at once (a slow front range can back the whole rest of a
+    # transfer up behind it), and keeps one kvlog record well under the
+    # engine's u32 payload limit. Class attribute so tests can shrink it.
+    LINK_SEGMENT_BLOCKS = 256
 
     def _load_head(self) -> None:
         last = self._db.get(_K_LAST, self._F_MISC)
@@ -157,44 +216,108 @@ class BlockStoreMixin:
             return
         self._db.put(_bid(block_id), raw, self._F_ST)
 
+    def add_raw_st_blocks(self, blocks: Dict[int, bytes]) -> int:
+        """Stage a whole verified window of raw blocks in ONE WriteBatch
+        (vs one put per block) — the adoption path of the pipelined state
+        transfer. Returns the number of blocks actually staged."""
+        wb = WriteBatch()
+        n = 0
+        for block_id in sorted(blocks):
+            if block_id <= self._last:
+                continue
+            wb.put(_bid(block_id), blocks[block_id], self._F_ST)
+            n += 1
+        if n:
+            self._db.write(wb)
+        return n
+
     def has_st_block(self, block_id: int) -> bool:
         return self._db.has(_bid(block_id), self._F_ST)
 
+    # hooks for read-your-writes during batched linking; the categorized
+    # engine overrides them to rebind its cached merkle trees too
+    def _begin_staged_reads(self, view: "_StagedReadView") -> None:
+        self._base_db = self._db
+        self._db = view
+
+    def _end_staged_reads(self) -> None:
+        self._db = self._base_db
+
     def link_st_chain(self) -> int:
-        """Adopt contiguous staged blocks after the head, re-executing
-        their updates and verifying recorded digests so a Byzantine
-        source can't inject state. Returns the new head."""
-        while True:
-            nxt = self._last + 1
-            raw = self._db.get(_bid(nxt), self._F_ST)
-            if raw is None:
-                return self._last
+        """Adopt ALL contiguous staged blocks after the head in one
+        atomic WriteBatch, re-executing their updates and verifying
+        recorded digests so a Byzantine source can't inject state.
+
+        Staging block N+1 must read state block N just wrote (parent
+        block row, merkle nodes, immutable-rewrite checks), so the loop
+        stages against a read-your-writes overlay and commits once per
+        LINK_SEGMENT_BLOCKS-sized segment of the contiguous prefix
+        instead of once per block (bounding batch memory on huge
+        suffixes). On a bad staged block the verified prefix before it
+        still commits, the bad row is dropped (so retries can re-fetch
+        from another source instead of wedging on the same bytes), and
+        the error propagates. Returns the new head."""
+        base_db = self._db
+        nxt = self._last + 1
+        prev_digest = self.block_digest(self._last) if self._last else b""
+        bad: Optional[int] = None
+        error: Optional[BaseException] = None
+
+        def commit(master: WriteBatch,
+                   adopted: List[Tuple[int, "cat.BlockUpdates"]]) -> None:
+            if bad is not None:
+                master.delete(_bid(bad), self._F_ST)
+            if master.ops:
+                self._db.write(master)
+            if adopted:
+                self._last = adopted[-1][0]
+                if self._genesis == 0:
+                    self._genesis = 1
+                for block_id, updates in adopted:
+                    self._notify(block_id, updates)
+
+        while error is None:
+            overlay: Dict[bytes, Optional[bytes]] = {}
+            view = _StagedReadView(base_db, overlay)
+            master = WriteBatch()
+            adopted: List[Tuple[int, "cat.BlockUpdates"]] = []
+            self._begin_staged_reads(view)
             try:
-                blk = ser.decode_msg(raw, Block)
-                if blk.block_id != nxt:
-                    raise BlockchainError(
-                        f"staged block id mismatch: {blk.block_id} != {nxt}")
-                expect_parent = (self.block_digest(self._last)
-                                 if self._last else b"")
-                if blk.parent_digest != expect_parent:
-                    raise BlockchainError(f"parent digest mismatch at {nxt}")
-                updates = cat.decode_block_updates(blk.updates_blob)
-                wb = WriteBatch()
-                rebuilt = self._stage_block(wb, nxt, updates)
-                if rebuilt.category_digests != blk.category_digests:
-                    raise BlockchainError(
-                        f"category digest mismatch at {nxt}")
-            except Exception:
-                # drop the bad staged block so retries can re-fetch it from
-                # another source instead of wedging on the same bytes
-                self._db.delete(_bid(nxt), self._F_ST)
-                raise
-            wb.delete(_bid(nxt), self._F_ST)
-            self._db.write(wb)
-            self._last = nxt
-            if self._genesis == 0:
-                self._genesis = 1
-            self._notify(nxt, updates)
+                while len(adopted) < self.LINK_SEGMENT_BLOCKS:
+                    raw = base_db.get(_bid(nxt), self._F_ST)
+                    if raw is None:
+                        break
+                    wb = _MirroredBatch(overlay)
+                    try:
+                        blk = ser.decode_msg(raw, Block)
+                        if blk.block_id != nxt:
+                            raise BlockchainError(
+                                f"staged block id mismatch: "
+                                f"{blk.block_id} != {nxt}")
+                        if blk.parent_digest != prev_digest:
+                            raise BlockchainError(
+                                f"parent digest mismatch at {nxt}")
+                        updates = cat.decode_block_updates(blk.updates_blob)
+                        rebuilt = self._stage_block(wb, nxt, updates)
+                        if rebuilt.category_digests != blk.category_digests:
+                            raise BlockchainError(
+                                f"category digest mismatch at {nxt}")
+                    except Exception as e:  # noqa: BLE001 — commit prefix
+                        bad, error = nxt, e
+                        break
+                    wb.delete(_bid(nxt), self._F_ST)
+                    master.ops.extend(wb.ops)
+                    adopted.append((nxt, updates))
+                    prev_digest = blk.digest()
+                    nxt += 1
+            finally:
+                self._end_staged_reads()
+            commit(master, adopted)
+            if len(adopted) < self.LINK_SEGMENT_BLOCKS:
+                break               # ran out of staged blocks (or hit bad)
+        if error is not None:
+            raise error
+        return self._last
 
 
 class KeyValueBlockchain(BlockStoreMixin):
@@ -215,6 +338,20 @@ class KeyValueBlockchain(BlockStoreMixin):
                                  use_device=self._use_device)
             self._trees[category] = t
         return t
+
+    # batched-link read redirection must cover the cached merkle trees:
+    # a block's update reads sibling nodes the previous block in the same
+    # batch may have written
+    def _begin_staged_reads(self, view) -> None:
+        super()._begin_staged_reads(view)
+        for t in self._trees.values():
+            t._db = view
+
+    def _end_staged_reads(self) -> None:
+        super()._end_staged_reads()
+        # trees created during staging bound to the view; rebind all
+        for t in self._trees.values():
+            t._db = self._db
 
     def _stage_block(self, wb: WriteBatch, block_id: int,
                      updates: cat.BlockUpdates) -> Block:

@@ -90,7 +90,7 @@ class ReadOnlyReplica(IReceiver):
         self.pages = ReservedPages(self.db)
         self.state_transfer = StateTransferManager(
             self.id, self.blockchain, st_cfg or StConfig(),
-            reserved_pages=self.pages)
+            reserved_pages=self.pages, aggregator=self.aggregator)
         self.state_transfer.bind(
             send_fn=lambda dest, payload: self.comm.send(
                 dest, m.StateTransferMsg(sender_id=self.id,

@@ -25,15 +25,19 @@ from tpubft.utils.metrics import Aggregator
 
 
 def open_db(db_path: Optional[str],
-            sync_writes: bool = False) -> IDBClient:
+            sync_writes: bool = False,
+            sync_families=()) -> IDBClient:
     """Storage factory (reference: kvbc storage factories — RocksDB for
     production, memorydb for tests). `sync_writes` mirrors RocksDB
-    WriteOptions.sync (reference leaves it false)."""
+    WriteOptions.sync (reference leaves it false); `sync_families` keeps
+    the named families fsync-durable regardless (the consensus-metadata
+    carve-out)."""
     if db_path is None:
         return MemoryDB()
     from tpubft.storage.native import NativeDB
     os.makedirs(os.path.dirname(db_path) or ".", exist_ok=True)
-    return NativeDB(db_path, sync_writes=sync_writes)
+    return NativeDB(db_path, sync_writes=sync_writes,
+                    sync_families=sync_families)
 
 
 class KvbcReplica:
@@ -44,8 +48,13 @@ class KvbcReplica:
                  aggregator: Optional[Aggregator] = None,
                  use_device_hashing: Optional[bool] = None,
                  thin_replica_port: Optional[int] = None) -> None:
-        self.db = open_db(db_path,
-                          sync_writes=getattr(cfg, "db_sync_writes", False))
+        from tpubft.storage.metadata import CONSENSUS_META_FAMILIES
+        self.db = open_db(
+            db_path,
+            sync_writes=getattr(cfg, "db_sync_writes", False),
+            sync_families=(CONSENSUS_META_FAMILIES
+                           if getattr(cfg, "db_sync_metadata", True)
+                           else ()))
         from tpubft.kvbc import create_blockchain
         # resolve "auto" BEFORE the hashing decision below reads it (the
         # consensus Replica performs the same write-back; both orderings
@@ -71,9 +80,15 @@ class KvbcReplica:
                                aggregator=aggregator,
                                reserved_pages=pages)
         from tpubft.statetransfer import StateTransferManager
-        self.state_transfer = StateTransferManager(cfg.replica_id,
-                                                   self.blockchain,
-                                                   reserved_pages=pages)
+        from tpubft.statetransfer.manager import StConfig
+        self.state_transfer = StateTransferManager(
+            cfg.replica_id, self.blockchain,
+            StConfig(fetch_batch_blocks=cfg.state_transfer_batch_blocks,
+                     max_chunk_bytes=cfg.max_block_chunk_bytes,
+                     window_ranges=cfg.st_window_ranges,
+                     device_digest_threshold=cfg.st_device_digest_threshold,
+                     use_device_digests=use_device_hashing),
+            reserved_pages=pages, aggregator=aggregator)
         self.replica.set_state_transfer(self.state_transfer)
         from tpubft.reconfiguration.dispatcher import standard_dispatcher
         ckpt_dir = (os.path.join(os.path.dirname(db_path), "db_checkpoints")

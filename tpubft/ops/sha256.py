@@ -136,3 +136,59 @@ def sha256_batch(messages: Sequence[bytes]) -> List[bytes]:
     return out[:n]
 
 
+@functools.partial(jax.jit, static_argnums=())
+def sha256_kernel_masked(words: jnp.ndarray,
+                         nblocks: jnp.ndarray) -> jnp.ndarray:
+    """Variable-length variant: words (B, max_nb, 16) where each message
+    is FIPS-padded at its OWN block count and zero-filled to max_nb;
+    nblocks (B,) gives the real count. The scan runs max_nb compressions
+    for everyone but a lane's state freezes once its message ends, so one
+    compiled program hashes a whole mixed-size batch (state-transfer
+    windows: block sizes vary with workload)."""
+    batch = words.shape[0]
+    state0 = jnp.broadcast_to(jnp.asarray(_H0)[:, None], (8, batch))
+
+    def per_block(state, inp):
+        block, idx = inp
+        nxt = _compress(state, block)
+        keep = (idx < nblocks)[None, :]           # (1, B) -> broadcast (8, B)
+        return jnp.where(keep, nxt, state), None
+
+    idxs = jnp.arange(words.shape[1], dtype=jnp.uint32)
+    state, _ = jax.lax.scan(per_block, state0,
+                            (jnp.transpose(words, (1, 0, 2)), idxs))
+    return jnp.transpose(state)
+
+
+def prepare_mixed(messages: Sequence[bytes]):
+    """Pad a mixed-size batch: each message FIPS-padded at its own block
+    count, zero-extended to a COMMON max rounded up to a power of two (so
+    recompiles are bounded by log(size spread), not every distinct max).
+    -> (words (B, nb, 16), nblocks (B,))."""
+    nbs = [blocks_needed(len(m)) for m in messages]
+    nb_max = 1 << (max(nbs) - 1).bit_length()
+    words = np.zeros((len(messages), nb_max, 16), dtype=np.uint32)
+    for i, (m, nb) in enumerate(zip(messages, nbs)):
+        words[i, :nb] = _pad_to_words(m, nb)
+    return words, np.asarray(nbs, dtype=np.uint32)
+
+
+def sha256_batch_mixed(messages: Sequence[bytes]) -> List[bytes]:
+    """Hash a batch of ARBITRARY-size messages in one device call.
+    Same-block-count batches take the uniform kernel (no masking cost);
+    mixed batches take the masked kernel. Batch is padded to a power of
+    two like sha256_batch to bound compiled shapes."""
+    if not messages:
+        return []
+    n = len(messages)
+    nbs = {blocks_needed(len(m)) for m in messages}
+    if len(nbs) == 1:
+        return sha256_batch(messages)
+    padded_n = 1 << (n - 1).bit_length()
+    padded = list(messages) + [messages[0]] * (padded_n - n)
+    words, nblocks = prepare_mixed(padded)
+    out = digest_words_to_bytes(
+        sha256_kernel_masked(jnp.asarray(words), jnp.asarray(nblocks)))
+    return out[:n]
+
+

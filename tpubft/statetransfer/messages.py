@@ -1,7 +1,15 @@
 """State-transfer wire messages, carried inside the consensus-level
 StateTransferMsg envelope (reference: bcstatetransfer/Messages.hpp —
 AskForCheckpointSummariesMsg, CheckpointSummaryMsg, FetchBlocksMsg,
-ItemDataMsg, RejectFetchingMsg)."""
+ItemDataMsg, RejectFetchingMsg).
+
+Concurrency contract: the destination may keep SEVERAL FetchBlocks
+ranges outstanding at once, each under its own `msg_id` and each against
+a different source (the pipelined fetch window). `reply_to` is therefore
+the range identity — a source answers with the msg_id it was asked
+under, and late/stray ItemData for a range that was re-assigned simply
+misses the window and is dropped. Sources need no new state: each
+FetchBlocks is still served independently."""
 from __future__ import annotations
 
 from dataclasses import dataclass, field
@@ -56,6 +64,11 @@ class FetchBlocks:
 
 @dataclass
 class ItemData:
+    """One chunk of one block. INVARIANT (enforced by the destination):
+    every chunk of the same block must carry the same `total_chunks` and
+    the same `proof` — a source flipping either mid-block is malformed
+    and is punished, so byzantine metadata can never confuse reassembly
+    or smuggle a second proof past the window verification."""
     ID = 4
     reply_to: int = 0
     block_id: int = 0

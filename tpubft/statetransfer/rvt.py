@@ -199,6 +199,20 @@ class RangeValidationTree:
             return False
         return RangeValidationTree.compute_root(n_leaves, peaks) == root
 
+    @staticmethod
+    def verify_window(root: bytes, first_leaf_i: int, n_leaves: int,
+                      leaf_hashes: List[bytes],
+                      proofs: List[RvtProof]) -> bool:
+        """Verify a contiguous window of leaves against one root — the
+        per-window proof check of the pipelined state transfer (leaf
+        digests arrive pre-batched from the device hash kernel)."""
+        if len(leaf_hashes) != len(proofs):
+            return False
+        return all(
+            RangeValidationTree.verify(root, first_leaf_i + k, n_leaves,
+                                       lh, pr)
+            for k, (lh, pr) in enumerate(zip(leaf_hashes, proofs)))
+
     def sync_to(self, blockchain) -> None:
         """Lazily extend with digests of blocks appended since last sync
         (the RVBManager 'add pending blocks on checkpoint' duty)."""

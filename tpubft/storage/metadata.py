@@ -71,6 +71,12 @@ _OBJ_STATE = 1
 # Row codec: the repo-standard length-prefixed primitives from
 # utils/serialize (bounds-checked; corrupt rows raise, not garbage).
 _SEQ_FAMILY = b"metaseq"
+
+# the families a NativeDB must keep durable even with db_sync_writes
+# off (ReplicaConfig.db_sync_metadata → open_db sync_families): losing
+# a vote/prepare this replica persisted here is a protocol-safety
+# hazard under correlated power loss; everything else is re-derivable
+CONSENSUS_META_FAMILIES = (_FAMILY, _SEQ_FAMILY)
 _KEY_DESC = b"\x00\x00\x00\x02"
 _KEY_VC = b"\x00\x00\x00\x03"
 

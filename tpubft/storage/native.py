@@ -4,7 +4,7 @@ layer (/root/reference/storage/src/rocksdb_client.cpp), via ctypes."""
 from __future__ import annotations
 
 import ctypes
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from tpubft.native.build import load
 from tpubft.storage.interfaces import (DEFAULT_FAMILY, IDBClient, StorageError,
@@ -64,15 +64,25 @@ def _decode_scan(buf: bytes) -> List[Tuple[bytes, bytes]]:
 class NativeDB(IDBClient):
     """Crash-consistent persistent KV store. `sync_writes=False` trades
     durability-per-batch for throughput (recovery still sees a prefix of
-    committed batches — record CRCs stop replay at the torn tail)."""
+    committed batches — record CRCs stop replay at the torn tail).
+
+    `sync_families` carves out families that stay durable anyway: a batch
+    touching any of them is fsync'd after apply even when
+    sync_writes=False (the consensus-metadata carve-out — losing a
+    prepare this replica voted on is a safety hazard; block data is
+    re-derivable from the quorum). Ignored when sync_writes=True (every
+    batch already syncs)."""
 
     def __init__(self, path: str, sync_writes: bool = True,
-                 compact_bytes: int = 64 << 20) -> None:
+                 compact_bytes: int = 64 << 20,
+                 sync_families: Sequence[bytes] = ()) -> None:
         self._lib = _lib()
         self._h = self._lib.kvlog_open(path.encode(), 1 if sync_writes else 0)
         if not self._h:
             raise StorageError(f"kvlog_open failed for {path}")
         self._compact_bytes = compact_bytes
+        self._sync_prefixes: Tuple[bytes, ...] = () if sync_writes else \
+            tuple(bytes([len(f)]) + f for f in sync_families)
 
     def _handle(self):
         if not self._h:
@@ -102,6 +112,11 @@ class NativeDB(IDBClient):
         rc = self._lib.kvlog_apply(self._h, payload, len(payload))
         if rc != 0:
             raise StorageError(f"kvlog_apply rc={rc}")
+        if self._sync_prefixes and any(
+                k.startswith(self._sync_prefixes) for k, _ in batch.ops):
+            rc = self._lib.kvlog_sync(self._h)
+            if rc != 0:
+                raise StorageError(f"kvlog_sync rc={rc}")
         if self._lib.kvlog_wal_bytes(self._h) > self._compact_bytes:
             self.compact()
 

@@ -77,7 +77,7 @@ class InProcessCluster:
             from tpubft.statetransfer.manager import StConfig
             rep.set_state_transfer(StateTransferManager(
                 r, bc, StConfig(retry_timeout_s=0.3),
-                reserved_pages=rep.res_pages))
+                reserved_pages=rep.res_pages, aggregator=agg))
         from tpubft.reconfiguration.dispatcher import standard_dispatcher
         rep.set_reconfiguration(standard_dispatcher(blockchain=bc))
         self.replicas[r] = rep

@@ -107,6 +107,13 @@ class ReplicaConfig:
     # may lose the newest suffix. Profiling: True costs ~7 fsyncs (~8ms)
     # per consensus op per replica.
     db_sync_writes: bool = False
+    # even with db_sync_writes=False, batches touching the CONSENSUS
+    # METADATA families (view/prepared/checkpoint descriptors) still
+    # fsync: losing a prepare this replica already voted on is a safety
+    # hazard under correlated power loss, while block data is always
+    # re-derivable from the quorum via state transfer. False = nothing
+    # syncs (benchmarking escape hatch).
+    db_sync_metadata: bool = True
     replica_sig_scheme: str = "ed25519"  # per-message replica signatures
     client_sig_scheme: str = "ed25519"
     threshold_scheme: str = "multisig-ed25519"  # or "threshold-bls"
@@ -128,9 +135,17 @@ class ReplicaConfig:
     retransmissions_enabled: bool = True
     retransmission_timer_ms: int = 50
 
-    # state transfer
-    max_block_chunk_bytes: int = 1 << 20
+    # state transfer fetch pipeline (StConfig wiring — kvbc/replica.py):
+    # ranges of `state_transfer_batch_blocks` blocks, up to
+    # `st_window_ranges` ranges in flight striped across live sources,
+    # blocks chunked at `max_block_chunk_bytes` on the wire (must clear
+    # the transport datagram limit), completed windows of >=
+    # `st_device_digest_threshold` blocks digest-verified as one device
+    # batch
+    max_block_chunk_bytes: int = 24 * 1024
     state_transfer_batch_blocks: int = 64
+    st_window_ranges: int = 4
+    st_device_digest_threshold: int = 16
 
     # key exchange
     key_exchange_on_start: bool = False

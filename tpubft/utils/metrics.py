@@ -12,7 +12,44 @@ import json
 import socket
 import threading
 import time
+from collections import deque
 from typing import Dict, Optional
+
+
+class Meter:
+    """Trailing-window rate estimator behind throughput gauges
+    (st_blocks_per_sec / st_bytes_per_sec): mark(n) on the hot path,
+    rate() -> events per second over the last `window_s` seconds. Marked
+    from the dispatcher thread, read by metric scrapers — locked like
+    Counter."""
+
+    __slots__ = ("_window", "_events", "_lock")
+
+    def __init__(self, window_s: float = 5.0) -> None:
+        self._window = window_s
+        self._events: deque = deque()        # (monotonic ts, n)
+        self._lock = threading.Lock()
+
+    def _trim(self, now: float) -> None:
+        horizon = now - self._window
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def mark(self, n: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, n))
+            self._trim(now)
+
+    def rate(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            self._trim(now)
+            if not self._events:
+                return 0.0
+            total = sum(n for _, n in self._events)
+            span = max(now - self._events[0][0], 0.05)
+            return total / span
 
 
 class Counter:

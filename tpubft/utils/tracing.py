@@ -80,13 +80,17 @@ class Tracer:
 
     def start_span(self, name: str,
                    parent: Optional[SpanContext] = None,
-                   trace_id: Optional[str] = None) -> Span:
+                   trace_id: Optional[str] = None,
+                   tags: Optional[Dict[str, object]] = None) -> Span:
         tid = (parent.trace_id if parent
                else trace_id if trace_id else self._next_id())
         ctx = SpanContext(trace_id=tid, span_id=self._next_id())
-        return Span(name=name, context=ctx,
+        span = Span(name=name, context=ctx,
                     parent_span_id=parent.span_id if parent else None,
                     _tracer=self)
+        for k, v in (tags or {}).items():
+            span.set_tag(k, v)
+        return span
 
     def add_exporter(self, fn: Callable[[Span], None]) -> None:
         self._exporters.append(fn)
