@@ -62,7 +62,7 @@ def _handler_factory(_r=None):
 def _drive(make_kv, config: int, backend: str, secs: float,
            clients: int, mode: str = None,
            warmup_timeout_ms: int = 20000,
-           client_batch: int = 1) -> dict:
+           client_batch: int = 1, op_timeout_ms: int = 8000) -> dict:
     """Shared workload driver: `make_kv(idx)` returns a SkvbcClient
     bound to client `idx`; one stats pipeline serves both harness
     modes (so BASELINE numbers can never drift between them).
@@ -83,7 +83,7 @@ def _drive(make_kv, config: int, backend: str, secs: float,
                     ws = [[(b"bench-%d-%d" % (idx, (i + j) % 64),
                             b"v%d" % (i + j))]
                           for j in range(client_batch)]
-                    rs = kv.write_batch(ws, timeout_ms=8000)
+                    rs = kv.write_batch(ws, timeout_ms=op_timeout_ms)
                     dt = time.monotonic() - t0
                     ok = sum(1 for r in rs if r.success)
                     if ok:
@@ -92,7 +92,7 @@ def _drive(make_kv, config: int, backend: str, secs: float,
                     i += client_batch
                     continue
                 r = kv.write([(b"bench-%d-%d" % (idx, i % 64),
-                               b"v%d" % i)], timeout_ms=8000)
+                               b"v%d" % i)], timeout_ms=op_timeout_ms)
             except Exception:  # noqa: BLE001 — lossy transports time out
                 i += client_batch if client_batch > 1 else 1
                 continue
@@ -137,7 +137,9 @@ def _drive(make_kv, config: int, backend: str, secs: float,
 
 
 def run_config(config: int, backend: str, secs: float,
-               clients: int, client_batch: int = 1) -> dict:
+               clients: int, client_batch: int = 1,
+               extra_overrides: dict = None,
+               op_timeout_ms: int = 8000) -> dict:
     cfg = CONFIGS[config]
     if cfg.get("transport") or cfg.get("storm_period_s"):
         # TLS transport and the VC storm only exist on real processes; an
@@ -151,13 +153,18 @@ def run_config(config: int, backend: str, secs: float,
                  if k not in ("f", "transport", "storm_period_s")}
     overrides.setdefault("client_sig_scheme", "ed25519")
     overrides["crypto_backend"] = backend
+    overrides.update(extra_overrides or {})
     with InProcessCluster(f=cfg["f"], num_clients=clients,
                           handler_factory=_handler_factory,
                           cfg_overrides=overrides) as cluster:
-        return _drive(lambda i: skvbc.SkvbcClient(cluster.client(i)),
-                      config, backend, secs, clients,
-                      warmup_timeout_ms=60000 if cfg["f"] > 2 else 20000,
-                      client_batch=client_batch)
+        row = _drive(lambda i: skvbc.SkvbcClient(cluster.client(i)),
+                     config, backend, secs, clients,
+                     warmup_timeout_ms=60000 if cfg["f"] > 2 else 20000,
+                     client_batch=client_batch,
+                     op_timeout_ms=op_timeout_ms)
+        if extra_overrides:
+            row["overrides"] = dict(extra_overrides)
+        return row
 
 
 def _storm(net, stop_evt, period_s: float) -> None:
@@ -180,7 +187,9 @@ def _storm(net, stop_evt, period_s: float) -> None:
 
 
 def run_config_processes(config: int, backend: str, secs: float,
-                         clients: int, client_batch: int = 1) -> dict:
+                         clients: int, client_batch: int = 1,
+                         extra_overrides: dict = None,
+                         op_timeout_ms: int = 8000) -> dict:
     """REAL replica OS processes (BftTestNetwork) — no shared-GIL
     inflation; this is the deployment-shaped number."""
     import tempfile
@@ -194,6 +203,7 @@ def run_config_processes(config: int, backend: str, secs: float,
     flagged = ("f", "transport", "storm_period_s", "threshold_scheme",
                "client_sig_scheme", "view_change_timer_ms")
     overrides = {k: v for k, v in cfg.items() if k not in flagged}
+    overrides.update(extra_overrides or {})
     with tempfile.TemporaryDirectory() as tmp, \
             BftTestNetwork(f=cfg["f"], num_clients=max(4, clients),
                            db_dir=tmp, crypto_backend=backend,
@@ -217,14 +227,36 @@ def run_config_processes(config: int, backend: str, secs: float,
             row = _drive(net.skvbc_client, config, backend, secs, clients,
                          mode="processes",
                          warmup_timeout_ms=60000 if cfg["f"] > 2
-                         else 20000, client_batch=client_batch)
+                         else 20000, client_batch=client_batch,
+                         op_timeout_ms=op_timeout_ms)
         finally:
             if storm_stop is not None:
                 storm_stop.set()
                 storm_thread.join(timeout=10)
         if cfg.get("storm_period_s"):
             row["storm_period_s"] = cfg["storm_period_s"]
+        if extra_overrides:
+            row["overrides"] = dict(extra_overrides)
         return row
+
+
+def smoke(secs: float = 2.0, clients: int = 2) -> dict:
+    """Tier-1 shape (mirrors bench_st --smoke): order real traffic
+    through config 1 with the execution lane ON and OFF, so the ordering
+    path — including the dispatcher↔executor handoff — has a
+    collection-time + runtime guard in CI. Run it under
+    TPUBFT_THREADCHECK=1 to arm the lock-order checker across the
+    handoff (tests/test_bench_e2e_smoke.py does)."""
+    from tpubft.utils.racecheck import get_watchdog
+    out = {}
+    for label, lane in (("lane", True), ("inline", False)):
+        row = run_config(1, "cpu", secs, clients,
+                         extra_overrides={"execution_lane": lane})
+        out[label] = {"ok": row["ops"] > 0,
+                      "ops": row["ops"],
+                      "ops_per_sec": row["ops_per_sec"]}
+    out["stall_reports"] = get_watchdog().stall_reports
+    return out
 
 
 def main() -> None:
@@ -241,12 +273,30 @@ def main() -> None:
     ap.add_argument("--processes", action="store_true",
                     help="real replica OS processes instead of the "
                          "in-process cluster")
+    ap.add_argument("--override", action="append", default=[],
+                    metavar="FIELD=VALUE",
+                    help="extra ReplicaConfig override applied to every "
+                         "replica (repeatable) — e.g. execution_lane="
+                         "False or execution_max_accumulation=1 for the "
+                         "lane A/B rows")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fixed shape for CI (lane on vs off)")
+    ap.add_argument("--timeout-ms", type=int, default=8000,
+                    help="per-op client timeout; raise for saturated "
+                         "deep-batch shapes so a slow config degrades "
+                         "gracefully instead of timing out")
     args = ap.parse_args()
+    if args.smoke:
+        print(json.dumps(smoke()), flush=True)
+        return
+    from tpubft.utils.config import parse_config_overrides
+    extra = parse_config_overrides(args.override)
     for config in [int(x) for x in args.configs.split(",")]:
         for backend in args.backends.split(","):
             fn = run_config_processes if args.processes else run_config
             row = fn(config, backend, args.secs, args.clients,
-                     args.client_batch)
+                     args.client_batch, extra_overrides=extra,
+                     op_timeout_ms=args.timeout_ms)
             print(json.dumps(row), flush=True)
 
 

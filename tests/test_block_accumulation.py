@@ -1,0 +1,177 @@
+"""Block accumulation + bulk add_blocks + the level-synchronous
+multi-block sparse-merkle walk: every batched path must be byte-identical
+to the sequential per-block path (roots, archive rows, block rows, full
+DB state) — checkpoint digests depend on it."""
+import pytest
+
+from tpubft.kvbc import (BLOCK_MERKLE, IMMUTABLE, VERSIONED_KV,
+                         BlockUpdates, KeyValueBlockchain)
+from tpubft.kvbc.blockchain import BlockchainError
+from tpubft.kvbc.sparse_merkle import SparseMerkleTree
+from tpubft.storage.memorydb import MemoryDB
+
+
+def _dump(db: MemoryDB):
+    return sorted(db.scan_all())
+
+
+def _mixed_updates(n):
+    """n blocks touching merkle + versioned + immutable categories with
+    overlapping keys (cross-block dependencies in the tree walk)."""
+    out = []
+    for i in range(n):
+        bu = BlockUpdates()
+        bu.put("mk", b"shared", b"v%d" % i, cat_type=BLOCK_MERKLE)
+        bu.put("mk", b"k%d" % i, b"x%d" % i, cat_type=BLOCK_MERKLE)
+        if i % 2:
+            bu.delete("mk", b"k%d" % (i - 1), cat_type=BLOCK_MERKLE)
+        bu.put("kv", b"a", b"%d" % i, cat_type=VERSIONED_KV)
+        bu.put("imm", b"once%d" % i, b"w", cat_type=IMMUTABLE,
+               tags=["t%d" % (i % 2)])
+        out.append(bu)
+    return out
+
+
+# ---------------- sparse merkle: update_batches ----------------
+
+def test_update_batches_matches_sequential():
+    import hashlib
+    seq_db, bat_db = MemoryDB(), MemoryDB()
+    seq_tree = SparseMerkleTree(seq_db, use_device=False)
+    bat_tree = SparseMerkleTree(bat_db, use_device=False)
+    blocks = []
+    for i in range(5):
+        ups = {b"shared": hashlib.sha256(b"v%d" % i).digest(),
+               b"k%d" % i: hashlib.sha256(b"x").digest()}
+        if i == 3:
+            ups[b"k1"] = None          # delete a key a prior block wrote
+        if i == 4:
+            ups = {}                   # empty block mid-batch
+        blocks.append(ups)
+    seq_roots = [seq_tree.update_batch(dict(u), version=10 + i)
+                 for i, u in enumerate(blocks)]
+    bat_roots = bat_tree.update_batches(blocks, first_version=10)
+    assert seq_roots == bat_roots
+    assert _dump(seq_db) == _dump(bat_db)
+    # historical proofs built from the archive rows agree too
+    for ver in (10, 12, 14):
+        assert seq_tree.root_at(ver) == bat_tree.root_at(ver)
+        p = bat_tree.prove_at(b"shared", ver)
+        vh = bat_tree.get_value_hash_at(b"shared", ver)
+        assert SparseMerkleTree.verify(bat_tree.root_at(ver), b"shared",
+                                       vh, p)
+
+
+def test_update_batches_empty_and_single():
+    db = MemoryDB()
+    t = SparseMerkleTree(db, use_device=False)
+    assert t.update_batches([]) == []
+    r = t.update_batches([{}, {}], first_version=1)
+    assert r == [t.root(), t.root()]
+    import hashlib
+    one = t.update_batches([{b"k": hashlib.sha256(b"v").digest()}],
+                           first_version=3)
+    assert one == [t.root()]
+
+
+# ---------------- add_blocks ----------------
+
+def test_add_blocks_matches_sequential_add_block():
+    ups = _mixed_updates(6)
+    seq_db, bat_db = MemoryDB(), MemoryDB()
+    seq_bc = KeyValueBlockchain(seq_db, use_device_hashing=False)
+    bat_bc = KeyValueBlockchain(bat_db, use_device_hashing=False)
+    for u in ups:
+        seq_bc.add_block(u)
+    assert bat_bc.add_blocks(ups) == 6
+    assert bat_bc.last_block_id == seq_bc.last_block_id == 6
+    assert _dump(seq_db) == _dump(bat_db)
+    assert seq_bc.state_digest() == bat_bc.state_digest()
+    for b in range(1, 7):
+        assert seq_bc.block_digest(b) == bat_bc.block_digest(b)
+
+
+def test_add_blocks_notifies_listeners_in_order():
+    bc = KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+    seen = []
+    bc.add_listener(lambda bid, bu: seen.append(bid))
+    bc.add_blocks(_mixed_updates(3))
+    assert seen == [1, 2, 3]
+
+
+def test_add_blocks_immutable_rewrite_across_batch_rejected():
+    bc = KeyValueBlockchain(MemoryDB(), use_device_hashing=False)
+    a = BlockUpdates()
+    a.put("imm", b"k", b"v1", cat_type=IMMUTABLE)
+    b = BlockUpdates()
+    b.put("imm", b"k", b"v2", cat_type=IMMUTABLE)
+    with pytest.raises(Exception):
+        bc.add_blocks([a, b])
+    # atomic: nothing from the failed batch landed
+    assert bc.last_block_id == 0
+    assert bc.get_latest("imm", b"k", cat_type=IMMUTABLE) is None
+
+
+# ---------------- accumulation brackets ----------------
+
+def test_accumulation_one_commit_and_read_your_writes():
+    db = MemoryDB()
+    bc = KeyValueBlockchain(db, use_device_hashing=False)
+    writes = []
+    orig = db.write
+    db.write = lambda wb: (writes.append(len(wb.ops)), orig(wb))[1]
+    bc.begin_accumulation()
+    for i in range(4):
+        bu = BlockUpdates()
+        bu.put("kv", b"k", b"v%d" % i, cat_type=VERSIONED_KV)
+        bc.add_block(bu)
+        # read-your-writes during the run: the handler's conflict check
+        # must see the staged block
+        assert bc.get_latest("kv", b"k") == (i + 1, b"v%d" % i)
+    assert not writes, "accumulation must not touch the DB before end"
+    assert bc.end_accumulation() == 4
+    assert len(writes) == 1, "one WriteBatch per run"
+    assert bc.get_latest("kv", b"k") == (4, b"v3")
+    # identical to the sequential path
+    seq_db = MemoryDB()
+    seq = KeyValueBlockchain(seq_db, use_device_hashing=False)
+    for i in range(4):
+        bu = BlockUpdates()
+        bu.put("kv", b"k", b"v%d" % i, cat_type=VERSIONED_KV)
+        seq.add_block(bu)
+    assert seq.state_digest() == bc.state_digest()
+    assert _dump(seq_db) == _dump(db)
+
+
+def test_accumulation_abort_rolls_back():
+    db = MemoryDB()
+    bc = KeyValueBlockchain(db, use_device_hashing=False)
+    bu0 = BlockUpdates()
+    bu0.put("kv", b"base", b"b", cat_type=VERSIONED_KV)
+    bc.add_block(bu0)
+    before = _dump(db)
+    bc.begin_accumulation()
+    bu = BlockUpdates()
+    bu.put("kv", b"k", b"v", cat_type=VERSIONED_KV)
+    bc.add_block(bu)
+    bc.abort_accumulation()
+    assert bc.last_block_id == 1
+    assert _dump(db) == before
+    # and the bracket is reusable after an abort
+    bc.begin_accumulation()
+    bc.add_block(bu)
+    assert bc.end_accumulation() == 2
+
+
+def test_accumulation_extra_ops_ride_the_same_batch():
+    from tpubft.storage.interfaces import WriteBatch
+    db = MemoryDB()
+    bc = KeyValueBlockchain(db, use_device_hashing=False)
+    bc.begin_accumulation()
+    bu = BlockUpdates()
+    bu.put("kv", b"k", b"v", cat_type=VERSIONED_KV)
+    bc.add_block(bu)
+    extra = WriteBatch()
+    extra.put(b"reply", b"bytes", b"respages")
+    bc.end_accumulation(extra=extra)
+    assert db.get(b"reply", b"respages") == b"bytes"

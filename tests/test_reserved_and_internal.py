@@ -191,15 +191,29 @@ def test_time_service_through_consensus():
 
 @pytest.mark.slow
 def test_client_reply_cache_in_reserved_pages():
+    """The reply RING is the single canonical persisted reply location:
+    the ring slot holds the canonical form, and the legacy per-client
+    "clients" page is NOT written for normal replies anymore (it was
+    fully shadowed by the ring; it now carries only the oversize-reply
+    at-most-once marker)."""
     with InProcessCluster(f=1) as cluster:
         client = cluster.client(0)
         client.start()
         from tpubft.apps.counter import encode_add
+        from tpubft.consensus.clients_manager import REPLY_CACHE_PER_CLIENT
         client.send_write(encode_add(7))
         time.sleep(0.2)
         rep0 = cluster.replicas[0]
-        page = rep0.res_pages.load("clients", client.cfg.client_id)
-        assert page is not None and page[:1] == b"\x00"
-        reply = m.unpack(page[1:])
+        cid = client.cfg.client_id
+        # req_seq of the first write is client-assigned; find the ring
+        # slot that holds a canonical reply
+        slots = [rep0.res_pages.load("clientreplies",
+                                     cid * REPLY_CACHE_PER_CLIENT + s)
+                 for s in range(REPLY_CACHE_PER_CLIENT)]
+        pages = [p for p in slots if p is not None]
+        assert pages, "reply ring empty after an executed write"
+        reply = m.unpack(pages[-1][1:])
         assert isinstance(reply, m.ClientReplyMsg)
         assert counter.decode_reply(reply.reply) == 7
+        # dedup: the legacy newest-reply page stays unwritten
+        assert rep0.res_pages.load("clients", cid) is None

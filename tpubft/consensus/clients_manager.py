@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 from tpubft.consensus.messages import ClientBatchRequestMsg, ClientReplyMsg
+from tpubft.utils.racecheck import make_lock
 
 # replies kept per client for retransmission recovery. Must cover a full
 # client batch PLUS interleaved single writes: every element of an
@@ -60,9 +61,15 @@ class _ClientInfo:
 
 
 class ClientsManager:
+    """Admission runs on the dispatcher thread; execution results arrive
+    from the execution lane's thread — the compound read-modify-write
+    paths (admission check vs. reply-cache eviction) are guarded by one
+    small lock (instrumented under TPUBFT_THREADCHECK)."""
+
     def __init__(self, client_ids) -> None:
         self._clients: Dict[int, _ClientInfo] = {c: _ClientInfo()
                                                  for c in client_ids}
+        self._mu = make_lock("clients_manager")
 
     def is_valid_client(self, client_id: int) -> bool:
         return client_id in self._clients
@@ -72,13 +79,14 @@ class ClientsManager:
         info = self._clients.get(client_id)
         if info is None:
             return False
-        if self._executed(info, req_seq):
-            return False                       # already executed (dup)
-        if req_seq in info.pending:
-            return False                       # already in flight
-        if len(info.pending) >= MAX_PENDING_PER_CLIENT:
-            return False                       # per-client flood bound
-        return True
+        with self._mu:
+            if self._executed(info, req_seq):
+                return False                   # already executed (dup)
+            if req_seq in info.pending:
+                return False                   # already in flight
+            if len(info.pending) >= MAX_PENDING_PER_CLIENT:
+                return False                   # per-client flood bound
+            return True
 
     @staticmethod
     def _executed(info: _ClientInfo, req_seq: int) -> bool:
@@ -90,10 +98,14 @@ class ClientsManager:
         executed). A lower seq than the newest execution is NOT evidence
         of a dup — requests complete out of order."""
         info = self._clients.get(client_id)
-        return self._executed(info, req_seq) if info else False
+        if info is None:
+            return False
+        with self._mu:
+            return self._executed(info, req_seq)
 
     def add_pending(self, client_id: int, req_seq: int, cid: str = "") -> None:
-        self._clients[client_id].pending[req_seq] = cid
+        with self._mu:
+            self._clients[client_id].pending[req_seq] = cid
 
     def has_pending(self, client_id: int) -> bool:
         return bool(self._clients[client_id].pending)
@@ -104,14 +116,15 @@ class ClientsManager:
         info = self._clients.get(client_id)
         if info is None:
             return
-        if req_seq > info.last_executed_req:
-            info.last_executed_req = req_seq
-        info.replies[req_seq] = reply
-        while len(info.replies) > REPLY_CACHE_PER_CLIENT:
-            seq, _ = info.replies.popitem(last=False)   # evict oldest
-            if seq > info.evicted_high:
-                info.evicted_high = seq
-        info.pending.pop(req_seq, None)
+        with self._mu:
+            if req_seq > info.last_executed_req:
+                info.last_executed_req = req_seq
+            info.replies[req_seq] = reply
+            while len(info.replies) > REPLY_CACHE_PER_CLIENT:
+                seq, _ = info.replies.popitem(last=False)  # evict oldest
+                if seq > info.evicted_high:
+                    info.evicted_high = seq
+            info.pending.pop(req_seq, None)
 
     def note_executed(self, client_id: int, req_seq: int) -> None:
         """Record execution without a cached reply (oversize reply marker
@@ -127,7 +140,10 @@ class ClientsManager:
         stays regenerable, not just the newest request). None for both
         never-executed and oversize-reply entries."""
         info = self._clients.get(client_id)
-        return info.replies.get(req_seq) if info else None
+        if info is None:
+            return None
+        with self._mu:
+            return info.replies.get(req_seq)
 
     def seal_restore(self, client_id: int) -> None:
         """Call after seeding this client from reserved pages (restart or
@@ -143,5 +159,6 @@ class ClientsManager:
     def clear_pending(self) -> None:
         """View change: in-flight requests are abandoned; clients will
         retransmit and the new primary re-admits them."""
-        for info in self._clients.values():
-            info.pending.clear()
+        with self._mu:
+            for info in self._clients.values():
+                info.pending.clear()

@@ -1,0 +1,347 @@
+"""Execution lane — committed-slot execution off the dispatcher thread.
+
+The reference separates ordering from post-execution (concord-bft's
+post-execution separation + block accumulation: PostExecJob queues and
+the accumulated-block commit in kv_blockchain): the dispatcher thread
+marks slots committed and hands them over; a single executor thread
+drains *runs* of consecutive committed slots in seqnum order and applies
+each run as ONE coalesced commit:
+
+  * one ledger commit per run — the handler's add_block calls stage into
+    a shared WriteBatch via KeyValueBlockchain.begin/end_accumulation
+    (read-your-writes overlay, PR 2's _StagedReadView), so N blocks cost
+    one DB write instead of N;
+  * one reserved-pages batch per run for the reply ring / at-most-once
+    markers (folded into the ledger batch when pages share its DB —
+    apply is then atomic across ledger and reply state);
+  * replies are handed back to the dispatcher, whose send loop already
+    rides the transport batcher.
+
+Safety rules enforced here and in the replica wiring:
+
+  * `last_executed` advances on the DISPATCHER, only after the run's
+    durable apply (the completed-run handoff) — a crash between commit
+    and apply replays the committed suffix, deduplicated by the
+    reserved-pages at-most-once state;
+  * runs never cross a checkpoint-window boundary, and the boundary
+    run's state/pages digests are snapshotted HERE, before the next run
+    can mutate state — checkpoint certificates stay comparable
+    cluster-wide;
+  * batches carrying INTERNAL/RECONFIG requests never reach the lane:
+    the dispatcher drains it and executes them inline (they mutate
+    dispatcher-owned subsystems: key exchange, cron, wedge control);
+  * view change, wedge announcement, and state-transfer completion all
+    drain the lane first (Replica._drain_exec_lane).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from tpubft.storage.interfaces import WriteBatch
+from tpubft.utils.logging import get_logger, mdc_scope
+from tpubft.utils.racecheck import get_watchdog, make_lock
+
+log = get_logger("execlane")
+
+
+@dataclass
+class CompletedRun:
+    """A durably-applied run, ready for the dispatcher to integrate."""
+    first: int
+    last: int
+    n_requests: int                       # executed (non-dedup) requests
+    replies: List[Tuple[int, bytes]] = field(default_factory=list)
+    reply_keys: List[Tuple[int, int]] = field(default_factory=list)
+    # (seq, state_digest, pages_digest) when `last` is a checkpoint
+    # boundary — snapshotted at the boundary, before the next run ran
+    checkpoint: Optional[Tuple[int, bytes, bytes]] = None
+
+
+class ExecutionLane:
+    """Single executor thread + the dispatcher↔executor handoff.
+
+    Dispatcher-side API: submit / drain / pop_completed / depth.
+    All protocol state stays dispatcher-owned; the lane touches only
+    thread-safe surfaces (handler execution, ClientsManager, reserved
+    pages, the blockchain's accumulation bracket)."""
+
+    RETRY_DELAY_S = 0.5                   # backoff after a failed run
+
+    def __init__(self, replica, max_accumulation: int,
+                 checkpoint_window: int) -> None:
+        self._r = replica
+        self._max_acc = max(1, max_accumulation)
+        self._ckpt_window = checkpoint_window
+        self._mu = make_lock("exec_lane")
+        self._cond = threading.Condition(self._mu)
+        self._pending: "deque[Tuple[int, object]]" = deque()
+        self._completed: "deque[CompletedRun]" = deque()
+        self._busy = False
+        self._held = False                # test hook: freeze execution
+        self._retry_at = 0.0
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._name = f"exec-{replica.id}"
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self._name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop WITHOUT draining: pending slots are committed state that
+        recovery replays — stop is crash-equivalent by design."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        get_watchdog().unregister(self._name)
+
+    # ------------------------------------------------------------------
+    # dispatcher-side API
+    # ------------------------------------------------------------------
+    def submit(self, seq: int, pre_prepare) -> None:
+        """Hand a committed slot to the lane. The dispatcher submits in
+        strictly increasing consecutive seq order."""
+        with self._cond:
+            if self._pending and seq != self._pending[-1][0] + 1:
+                raise RuntimeError(
+                    f"non-consecutive lane submit: {seq} after "
+                    f"{self._pending[-1][0]}")
+            self._pending.append((seq, pre_prepare))
+            self._cond.notify_all()
+        self._r.m_exec_lane_depth.set(self.depth)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted slot has been applied (pending
+        empty AND no run in flight). Returns False on timeout — the
+        caller decides whether proceeding is safe. The executor never
+        waits on the dispatcher, so this cannot deadlock."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.2))
+        return True
+
+    def pop_completed(self) -> List[CompletedRun]:
+        out = []
+        with self._cond:
+            while self._completed:
+                out.append(self._completed.popleft())
+        return out
+
+    @property
+    def depth(self) -> int:
+        return len(self._pending)
+
+    def idle(self) -> bool:
+        with self._cond:
+            return not self._pending and not self._busy
+
+    # test hooks: freeze/unfreeze the lane so crash-window tests can
+    # create "committed persisted, not yet applied" states determinately
+    def hold(self) -> None:
+        with self._cond:
+            self._held = True
+
+    def release(self) -> None:
+        with self._cond:
+            self._held = False
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # executor thread
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        watchdog = get_watchdog()
+        with mdc_scope(r=self._r.id):
+            while True:
+                watchdog.beat(self._name)
+                with self._cond:
+                    while self._running and (
+                            not self._pending or self._held
+                            or time.monotonic() < self._retry_at):
+                        self._cond.wait(0.2)
+                        watchdog.beat(self._name)
+                    if not self._running:
+                        return
+                    run = self._take_run_locked()
+                    self._busy = True
+                try:
+                    self._execute_run(run)
+                except Exception:  # noqa: BLE001 — retry, as inline did
+                    log.exception("run [%d..%d] failed; will retry",
+                                  run[0][0], run[-1][0])
+                    with self._cond:
+                        self._pending.extendleft(reversed(run))
+                        self._retry_at = (time.monotonic()
+                                          + self.RETRY_DELAY_S)
+                finally:
+                    with self._cond:
+                        self._busy = False
+                        self._cond.notify_all()
+                self._r.m_exec_lane_depth.set(self.depth)
+
+    def _take_run_locked(self) -> List[Tuple[int, object]]:
+        """Pop the next run: consecutive pending slots, capped at
+        execution_max_accumulation, always breaking AFTER a checkpoint
+        boundary so digests are computed at cluster-agreed points."""
+        run: List[Tuple[int, object]] = []
+        while self._pending and len(run) < self._max_acc:
+            seq, pp = self._pending[0]
+            if run and seq != run[-1][0] + 1:
+                break                      # defensive: never skip a gap
+            run.append(self._pending.popleft())
+            if seq % self._ckpt_window == 0:
+                break
+        return run
+
+    def _execute_run(self, run: List[Tuple[int, object]]) -> None:
+        r = self._r
+        from tpubft.utils.tracing import get_tracer
+        blockchain = getattr(r.handler, "blockchain", None)
+        can_accumulate = (blockchain is not None
+                          and hasattr(blockchain, "begin_accumulation"))
+        pages_wb = WriteBatch()
+        result = CompletedRun(first=run[0][0], last=run[-1][0],
+                              n_requests=0)
+        # ClientsManager updates deferred to AFTER the durable commit:
+        # an aborted run retries, and the at-most-once state must not
+        # claim requests whose staged effects were discarded. _run_seen
+        # is the run-local dedup (a byzantine primary re-batching one
+        # request into two of the run's slots).
+        executed_now: List[Tuple[int, int, object]] = []
+        self._run_seen = set()
+        span = get_tracer().start_span("execute")
+        span.set_tag("r", r.id).set_tag("first", result.first) \
+            .set_tag("run_len", len(run))
+        acc = False
+        if can_accumulate:
+            blockchain.begin_accumulation()
+            acc = True
+        try:
+            for seq, pp in run:
+                self._execute_slot(seq, pp, pages_wb, result,
+                                   executed_now)
+        except BaseException:
+            if acc:
+                blockchain.abort_accumulation()
+            span.set_tag("error", True)
+            span.finish()
+            raise
+        # ---- coalesced durable apply: ONE ledger commit + ONE pages
+        # batch per run (a single atomic batch when they share a DB).
+        # Everything up to and including the LEDGER write is retriable
+        # (end_accumulation rolls the head back on failure); everything
+        # AFTER it is the point of no return — a post-commit exception
+        # must never requeue the run, or the retry would re-execute
+        # requests whose blocks are already durable (duplicate blocks,
+        # permanent state divergence). ----
+        t0 = time.perf_counter()
+        folded = False
+        if acc:
+            folded = (pages_wb.ops
+                      and r.res_pages.shares_db(
+                          getattr(blockchain, "_base_db", None)))
+            blockchain.end_accumulation(extra=pages_wb if folded else None)
+        try:
+            if not folded:
+                # without accumulation the handler's effects applied
+                # irreversibly during execution, and with it the ledger
+                # just committed — either way a pages failure here is
+                # logged, never retried (in-memory at-most-once still
+                # dedups; the at-risk window is a crash before the next
+                # run persists the ring)
+                try:
+                    r.res_pages.write_batch(pages_wb)
+                except Exception:  # noqa: BLE001
+                    log.exception("run [%d..%d]: reply-pages batch "
+                                  "failed post point-of-no-return",
+                                  result.first, result.last)
+            commit_ms = (time.perf_counter() - t0) * 1e3
+            # the run is durable: NOW the at-most-once/reply-cache
+            # records become visible (crash before this point replays
+            # the suffix; the persisted ring deduplicates it)
+            for client, req_seq, reply in executed_now:
+                r.clients.on_request_executed(client, req_seq, reply)
+            # checkpoint-boundary snapshot: digests taken now, before
+            # the next run mutates state
+            if result.last % self._ckpt_window == 0:
+                try:
+                    state_digest = r.handler.state_digest()
+                    if r.state_transfer is not None:
+                        r.state_transfer.on_checkpoint_created(
+                            result.last, state_digest)
+                    result.checkpoint = (result.last, state_digest,
+                                         r.res_pages.digest())
+                except Exception:  # noqa: BLE001 — skip OUR checkpoint
+                    # vote for this boundary; peers' quorum can still
+                    # certify it, and re-executing the run would be
+                    # strictly worse (duplicate blocks)
+                    log.exception("checkpoint snapshot failed at %d",
+                                  result.last)
+            span.set_tag("commit_ms", round(commit_ms, 3))
+            span.finish()
+            r.record_exec_run(len(run), commit_ms)
+        except Exception:  # noqa: BLE001 — the run is durable: a
+            # post-commit bookkeeping failure must be SWALLOWED, never
+            # reach _loop's requeue path (re-executing a committed run
+            # appends duplicate blocks — permanent divergence)
+            log.exception("post-commit bookkeeping failed for run "
+                          "[%d..%d] (run still completes)",
+                          result.first, result.last)
+        finally:
+            # the run IS completed (durably applied) no matter what the
+            # post-commit bookkeeping did — hand it to the dispatcher
+            with self._cond:
+                self._completed.append(result)
+            r.incoming.push_internal_once("exec_done")
+
+    def _execute_slot(self, seq: int, pp, pages_wb: WriteBatch,
+                      result: CompletedRun,
+                      executed_now: List[Tuple[int, int, object]]) -> None:
+        """One slot's requests, in order. Only plain / pre-processed
+        client requests reach the lane (barrier batches run inline on
+        the dispatcher)."""
+        r = self._r
+        seen = self._run_seen
+        for req in pp.client_requests():
+            client = req.sender_id
+            key = (client, req.req_seq_num)
+            if key in seen or r.clients.was_executed(client,
+                                                     req.req_seq_num):
+                cached = r.clients.cached_reply(client, req.req_seq_num)
+                if cached is not None:
+                    result.replies.append((client, cached.pack()))
+                continue
+            if r._slowdown.enabled:
+                from tpubft.testing.slowdown import PHASE_EXECUTE
+                r._slowdown.delay(PHASE_EXECUTE)
+            payload = r._execute_request(req, seq)
+            result.n_requests += 1
+            reply, wire = r._build_reply(client, req.req_seq_num,
+                                         payload, pages_wb)
+            executed_now.append((client, req.req_seq_num, reply))
+            seen.add(key)
+            result.reply_keys.append(key)
+            if wire is not None:
+                result.replies.append((client, wire))
+        if r.cfg.time_service_enabled and pp.time:
+            # agreed-time page writes must stay seq-ordered with the
+            # reply pages for checkpoint digest determinism
+            r.time_service.on_executed(pp.time)

@@ -44,6 +44,11 @@ class IncomingMsgsStorage:
         self._external: "queue.Queue[ExternalMsg]" = queue.Queue(max_external)
         self._internal: "queue.Queue[InternalMsg]" = queue.Queue()
         self._dropped_external = 0
+        # level-triggered wakeup kinds currently enqueued (see
+        # push_internal_once): guarded by its own lock — producers are
+        # worker/executor threads, the consumer is the dispatcher
+        self._once_pending: set = set()
+        self._once_mu = threading.Lock()
 
     def push_external(self, sender: int, raw: bytes) -> bool:
         try:
@@ -56,13 +61,30 @@ class IncomingMsgsStorage:
     def push_internal(self, kind: str, payload: Any = None) -> None:
         self._internal.put(InternalMsg(kind, payload))
 
+    def push_internal_once(self, kind: str) -> None:
+        """Level-triggered wakeup: enqueue `kind` (payload None) unless an
+        identical wakeup is already pending. Background producers whose
+        results live in their own handoff structure (e.g. the execution
+        lane's completed-run queue) signal with this so a fast producer
+        can't flood the internal queue with redundant wakeups."""
+        with self._once_mu:
+            if kind in self._once_pending:
+                return
+            self._once_pending.add(kind)
+        self._internal.put(InternalMsg(kind, None))
+
     def pop(self, timeout: float):
         """Internal msgs first (they unblock consensus progress), then
         external; returns ExternalMsg | InternalMsg | None on timeout."""
         try:
-            return self._internal.get_nowait()
+            item = self._internal.get_nowait()
         except queue.Empty:
-            pass
+            item = None
+        if item is not None:
+            if self._once_pending:
+                with self._once_mu:
+                    self._once_pending.discard(item.kind)
+            return item
         try:
             return self._external.get(timeout=timeout)
         except queue.Empty:

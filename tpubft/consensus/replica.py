@@ -52,6 +52,11 @@ from tpubft.consensus.view_change import (CERT_COMMIT, CERT_FAST_OPT,
                                           unpack_restriction,
                                           validate_certificate)
 from tpubft.crypto.digest import digest as sha256
+# hot-loop imports hoisted to module scope: the execution path used to
+# re-run these per request per slot (function-level `import` still pays
+# a sys.modules lookup + binding on every execution)
+from tpubft.diagnostics import TimeRecorder
+from tpubft.testing.slowdown import PHASE_EXECUTE
 from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.logging import get_logger, mdc_scope
 from tpubft.utils.metrics import Aggregator, Component
@@ -309,6 +314,13 @@ class Replica(IReceiver):
         self.m_epoch = self.metrics.register_gauge("epoch")
         self.m_epoch_dropped = self.metrics.register_counter(
             "epoch_mismatch_dropped")
+        # execution-lane observability: queue depth (committed slots not
+        # yet applied), runs completed, and slots coalesced into runs
+        self.m_exec_lane_depth = self.metrics.register_gauge(
+            "exec_lane_depth")
+        self.m_exec_runs = self.metrics.register_counter("exec_runs")
+        self.m_exec_run_slots = self.metrics.register_counter(
+            "exec_run_slots")
         # a recovered replica must REPORT its recovered position — these
         # gauges otherwise read 0 until the next execution, making an
         # idle-after-restart replica look like it lost its state
@@ -373,6 +385,12 @@ class Replica(IReceiver):
         self._diag = get_registrar()
         self._h_execute = self._diag.histogram(f"replica{self.id}.execute")
         self._h_verify = self._diag.histogram(f"replica{self.id}.verify")
+        # run-shape histograms: slots per execution run and the coalesced
+        # commit's duration (ms → recorded in µs like the others)
+        self._h_exec_run_len = self._diag.histogram(
+            f"replica{self.id}.exec_run_len")
+        self._h_exec_commit_ms = self._diag.histogram(
+            f"replica{self.id}.exec_commit_ms")
         self._diag.register_status(
             f"replica{self.id}",
             lambda: (f"view={self.view} last_executed={self.last_executed} "
@@ -381,6 +399,20 @@ class Replica(IReceiver):
                      f"{self.control.status()}"))
         from tpubft.testing.slowdown import get_slowdown_manager
         self._slowdown = get_slowdown_manager()
+
+        # --- execution lane (post-commit pipelining off the dispatcher;
+        # reference: post-execution separation + block accumulation) ---
+        self.exec_lane = None
+        # highest seq handed to the lane (or executed inline via the
+        # lane's barrier path); dispatcher-thread only
+        self._exec_enqueued = self.last_executed
+        if cfg.execution_lane:
+            from tpubft.consensus.execution import ExecutionLane
+            self.exec_lane = ExecutionLane(
+                self, cfg.execution_max_accumulation,
+                cfg.checkpoint_window_size)
+            self.dispatcher.register_internal("exec_done",
+                                              self._apply_exec_runs)
 
         # assigned BEFORE the restore replay: _restore_window can reach
         # _execute_committed, whose pipeline retrigger reads _running
@@ -469,6 +501,16 @@ class Replica(IReceiver):
     def _on_transfer_complete(self, seq: int, state_digest: bytes) -> None:
         """onTransferringComplete (IStateTransfer.hpp:113): jump forward to
         the transferred checkpoint and resume normal operation."""
+        # apply (not discard) any in-flight execution first: those slots
+        # are committed and their effects are part of the state the
+        # transferred checkpoint extends — and the page reload below must
+        # not race the lane's page writes. A lane that cannot drain means
+        # adopting now would race it: skip; the stall checker re-triggers
+        # a transfer while the certified checkpoints stay ahead.
+        if not self._drain_exec_lane():
+            log.error("transfer-complete deferred: execution lane did "
+                      "not drain")
+            return
         if seq <= self.last_executed:
             return
         self.last_executed = seq
@@ -489,6 +531,10 @@ class Replica(IReceiver):
         # the era gate while we keep stamping a dead epoch
         self.m_epoch.set(self.epoch_mgr.boot_adopt(seq))
         self._last_progress = time.monotonic()
+        # adoption done: re-arm execution for any slots committed beyond
+        # the transferred checkpoint (the pre-adoption drain deliberately
+        # did not re-pump)
+        self._execute_committed()
 
     def set_reconfiguration(self, dispatcher) -> None:
         """Attach the reconfiguration handler chain (kvbc wiring)."""
@@ -513,6 +559,8 @@ class Replica(IReceiver):
             self.incoming.push_internal("repropose", None)
         self.dispatcher.register_internal("repropose",
                                           lambda _: self._repropose())
+        if self.exec_lane is not None:
+            self.exec_lane.start()
         self.dispatcher.start()
         with mdc_scope(r=self.id):       # start() runs on the caller thread
             log.info("replica up: n=%d f=%d c=%d view=%d primary=%d "
@@ -528,6 +576,10 @@ class Replica(IReceiver):
         with mdc_scope(r=self.id):
             log.info("replica stopping: last_executed=%d last_stable=%d",
                      self.last_executed, self.last_stable)
+        if self.exec_lane is not None:
+            # no drain: pending slots are committed state that recovery
+            # replays — stop is crash-equivalent for the lane
+            self.exec_lane.stop()
         self.dispatcher.stop()
         self.collector_pool.shutdown()
         self.cert_batcher.stop()
@@ -1486,9 +1538,20 @@ class Replica(IReceiver):
         self._send_prepare_partial(info)
 
     # ------------------------------------------------------------------
-    # execution (ReplicaImp.cpp:5720,5364)
+    # execution (ReplicaImp.cpp:5720,5364 + the execution lane)
     # ------------------------------------------------------------------
     def _execute_committed(self) -> None:
+        """Committed slots became executable. With the execution lane the
+        dispatcher only ENQUEUES them (execution + the coalesced commit
+        happen on the lane thread); the legacy inline path runs when the
+        lane is off — and during __init__'s restore replay, which happens
+        before any thread besides the caller exists."""
+        if self.exec_lane is not None and self._running:
+            self._pump_execution_lane()
+        else:
+            self._execute_committed_inline()
+
+    def _execute_committed_inline(self) -> None:
         while True:
             nxt = self.last_executed + 1
             if not self.window.in_window(nxt):
@@ -1501,62 +1564,191 @@ class Replica(IReceiver):
             info = self.window.peek(nxt)
             if info is None or not info.committed or info.executed:
                 return
-            for req in info.pre_prepare.client_requests():
-                # at-most-once: a request already executed for this client
-                # must not re-execute (replay inside a later batch). This
-                # is a membership test — requests execute out of seq order,
-                # so a lower seqnum is not evidence of a replay.
-                if self.clients.was_executed(req.sender_id, req.req_seq_num):
-                    cached = self.clients.cached_reply(req.sender_id,
-                                                       req.req_seq_num)
-                    if cached is not None:
-                        self.comm.send(req.sender_id, cached.pack())
+            self._execute_one_slot(nxt, info)
+
+    def _execute_one_slot(self, nxt: int, info: SeqNumInfo) -> None:
+        """Inline per-slot execution + apply (the pre-lane path, kept for
+        execution_lane=off, restore replay, and lane barrier batches —
+        INTERNAL/RECONFIG requests mutate dispatcher-owned subsystems)."""
+        for req in info.pre_prepare.client_requests():
+            # at-most-once: a request already executed for this client
+            # must not re-execute (replay inside a later batch). This
+            # is a membership test — requests execute out of seq order,
+            # so a lower seqnum is not evidence of a replay.
+            if self.clients.was_executed(req.sender_id, req.req_seq_num):
+                cached = self.clients.cached_reply(req.sender_id,
+                                                   req.req_seq_num)
+                if cached is not None:
+                    self.comm.send(req.sender_id, cached.pack())
+                continue
+            if self._slowdown.enabled:
+                self._slowdown.delay(PHASE_EXECUTE)
+            reply = self._execute_request(req, nxt)
+            self.m_executed.inc()
+            self._send_reply(req.sender_id, req.req_seq_num, reply)
+        if self.cfg.time_service_enabled and info.pre_prepare.time:
+            self.time_service.on_executed(info.pre_prepare.time)
+        info.executed = True
+        info.exec_submitted = False
+        if getattr(info, "span", None) is not None:
+            info.span.set_tag("committed_path", info.commit_path)
+            info.span.finish()
+            info.span = None
+        self.last_executed = nxt
+        self._exec_enqueued = max(self._exec_enqueued, nxt)
+        self.m_last_executed.set(nxt)
+        self._last_progress = time.monotonic()
+        with self._tran() as st:
+            st.last_executed_seq = nxt
+        if nxt % self.cfg.checkpoint_window_size == 0:
+            self._send_checkpoint(nxt)
+        # a slot just left the pipeline: the primary proposes the
+        # batch that accumulated behind the concurrency gate NOW
+        # rather than waiting for the next flush-timer tick
+        self._try_send_pre_prepare()
+
+    def _execute_request(self, req: m.ClientRequestMsg, seq: int) -> bytes:
+        """One ordered request against the state machine. Runs on the
+        dispatcher (inline path, barrier batches) or the execution lane
+        (plain + pre-processed requests — the handler is the only state
+        those branches touch)."""
+        if req.flags & m.RequestFlag.INTERNAL:
+            return self._execute_internal_request(req, seq)
+        if req.flags & m.RequestFlag.RECONFIG:
+            return (self.reconfig.execute(self, req, seq)
+                    if self.reconfig is not None else b"")
+        if req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
+            from tpubft.preprocessor.preprocessor import unpack_preprocessed
+            try:
+                orig, result = unpack_preprocessed(req.request)
+            except Exception:  # noqa: BLE001 — malformed wrapper
+                return b""
+            return self.handler.apply_pre_executed(
+                orig.sender_id, orig.req_seq_num, orig.flags,
+                orig.request, result)
+        with TimeRecorder(self._h_execute):
+            return self.handler.execute(req.sender_id, req.req_seq_num,
+                                        req.flags, req.request)
+
+    # ---- execution lane plumbing (dispatcher side) ----
+    @staticmethod
+    def _batch_needs_dispatcher(pp: m.PrePrepareMsg) -> bool:
+        """Barrier batches: INTERNAL (key exchange, cron) and RECONFIG
+        (wedge, prune, epoch) requests mutate dispatcher-owned subsystems
+        and must execute inline — the lane drains first so seq order is
+        preserved around them."""
+        try:
+            reqs = pp.client_requests()
+        except m.MsgError:           # parsed at acceptance; defensive
+            return True
+        return any(r.flags & (m.RequestFlag.INTERNAL
+                              | m.RequestFlag.RECONFIG) for r in reqs)
+
+    def _pump_execution_lane(self) -> None:
+        """Hand every next consecutive committed slot to the lane (or
+        execute barrier batches inline after draining it)."""
+        while True:
+            nxt = max(self._exec_enqueued, self.last_executed) + 1
+            if not self.window.in_window(nxt):
+                return
+            if self.control.blocks_ordering(nxt):
+                # wedged: the announcement fires once the lane's applied
+                # runs bring last_executed to the stop point (the applier
+                # re-checks); calling here covers the already-drained case
+                self._maybe_announce_restart_ready()
+                return
+            info = self.window.peek(nxt)
+            if info is None or not info.committed or info.executed \
+                    or info.exec_submitted:
+                return
+            if self._batch_needs_dispatcher(info.pre_prepare):
+                if not self._drain_exec_lane():
+                    return              # lane stuck; retried on next event
+                if self.last_executed != nxt - 1:
+                    return              # world moved during the drain
+                self._execute_one_slot(nxt, info)
+                continue
+            info.exec_submitted = True
+            try:
+                self.exec_lane.submit(nxt, info.pre_prepare)
+            except BaseException:
+                # a failed handoff must not strand the slot as
+                # "submitted": clear the guard so the next commit event
+                # (or timer) retries it
+                info.exec_submitted = False
+                raise
+            self._exec_enqueued = nxt
+
+    def _drain_exec_lane(self, timeout: float = 30.0) -> bool:
+        """Dispatcher-side barrier: wait until the lane applied every
+        submitted slot, then integrate the completed runs NOW (the
+        level-triggered wakeup may still be queued behind us). Used
+        before view-change send, view entry, state-transfer adoption,
+        wedge/barrier execution."""
+        if self.exec_lane is None:
+            return True
+        ok = self.exec_lane.drain(timeout)
+        if not ok:
+            log.warning("execution lane failed to drain in %.0fs "
+                        "(depth=%d)", timeout, self.exec_lane.depth)
+        # apply WITHOUT the trailing re-pump: refilling the lane here
+        # would defeat the barrier (the caller is about to wipe the
+        # window / adopt transferred state); newly-unblocked slots are
+        # picked up by the next commit/apply event
+        self._apply_exec_runs(repump=False)
+        return ok and self.exec_lane.idle()
+
+    def record_exec_run(self, run_len: int, commit_ms: float) -> None:
+        """Lane-thread metrics hook (Counter/Gauge/histograms are
+        thread-safe): one completed run of `run_len` slots whose
+        coalesced durable apply took `commit_ms`."""
+        self.m_exec_runs.inc()
+        self.m_exec_run_slots.inc(run_len)
+        self._h_exec_run_len.record(run_len)
+        self._h_exec_commit_ms.record(commit_ms)
+
+    def _apply_exec_runs(self, _payload=None, repump: bool = True) -> None:
+        """Integrate durably-applied runs (dispatcher thread): advance
+        last_executed (only now — after the durable apply), persist the
+        watermark, send the run's replies (riding the transport batcher
+        via the dispatcher post-hook), finish spans, fire checkpoints
+        computed at the run boundary, and re-arm the proposal pipeline."""
+        if self.exec_lane is None:
+            return
+        runs = self.exec_lane.pop_completed()
+        if not runs:
+            return
+        for run in runs:
+            for seq in range(run.first, run.last + 1):
+                info = self.window.peek(seq)
+                if info is None:
                     continue
-                from tpubft.diagnostics import TimeRecorder
-                from tpubft.testing.slowdown import PHASE_EXECUTE
-                if self._slowdown.enabled:
-                    self._slowdown.delay(PHASE_EXECUTE)
-                if req.flags & m.RequestFlag.INTERNAL:
-                    reply = self._execute_internal_request(req, nxt)
-                elif req.flags & m.RequestFlag.RECONFIG:
-                    reply = (self.reconfig.execute(self, req, nxt)
-                             if self.reconfig is not None else b"")
-                elif req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
-                    from tpubft.preprocessor.preprocessor import (
-                        unpack_preprocessed)
-                    try:
-                        orig, result = unpack_preprocessed(req.request)
-                    except Exception:
-                        reply = b""
-                    else:
-                        reply = self.handler.apply_pre_executed(
-                            orig.sender_id, orig.req_seq_num, orig.flags,
-                            orig.request, result)
-                else:
-                    with TimeRecorder(self._h_execute):
-                        reply = self.handler.execute(req.sender_id,
-                                                     req.req_seq_num,
-                                                     req.flags, req.request)
-                self.m_executed.inc()
-                self._send_reply(req.sender_id, req.req_seq_num, reply)
-            if self.cfg.time_service_enabled and info.pre_prepare.time:
-                self.time_service.on_executed(info.pre_prepare.time)
-            info.executed = True
-            if getattr(info, "span", None) is not None:
-                info.span.set_tag("committed_path", info.commit_path)
-                info.span.finish()
-                info.span = None
-            self.last_executed = nxt
-            self.m_last_executed.set(nxt)
-            self._last_progress = time.monotonic()
+                info.executed = True
+                info.exec_submitted = False
+                if getattr(info, "span", None) is not None:
+                    info.span.set_tag("committed_path", info.commit_path)
+                    info.span.finish()
+                    info.span = None
+            for key in run.reply_keys:
+                self._forwarded.pop(key, None)
+            for client, raw in run.replies:
+                self.comm.send(client, raw)
+            self.m_executed.inc(run.n_requests)
+            if run.last > self.last_executed:
+                self.last_executed = run.last
+                self.m_last_executed.set(run.last)
             with self._tran() as st:
-                st.last_executed_seq = nxt
-            if nxt % self.cfg.checkpoint_window_size == 0:
-                self._send_checkpoint(nxt)
-            # a slot just left the pipeline: the primary proposes the
-            # batch that accumulated behind the concurrency gate NOW
-            # rather than waiting for the next flush-timer tick
-            self._try_send_pre_prepare()
+                st.last_executed_seq = self.last_executed
+            self._last_progress = time.monotonic()
+            if run.checkpoint is not None:
+                seq, state_digest, pages_digest = run.checkpoint
+                self._send_checkpoint(seq, state_digest=state_digest,
+                                      pages_digest=pages_digest)
+        self._maybe_announce_restart_ready()
+        self._try_send_pre_prepare()
+        if repump:
+            # a barrier batch may have been waiting behind these runs
+            self._pump_execution_lane()
 
     def _execute_internal_request(self, req: m.ClientRequestMsg,
                                   seq: int = 0) -> bytes:
@@ -1578,12 +1770,31 @@ class Replica(IReceiver):
             return b"ok"
         return b""
 
-    def _send_reply(self, client: int, req_seq: int, payload: bytes) -> None:
+    def _build_reply(self, client: int, req_seq: int, payload: bytes,
+                     pages_batch=None):
+        """Build an executed request's reply + stage its persisted
+        canonical form. Returns (reply_msg, wire_bytes_or_None) — the
+        caller records it in the ClientsManager (immediately on the
+        inline path; AFTER the durable commit on the execution lane, so
+        an aborted run can retry without the at-most-once state claiming
+        its requests already executed). `pages_batch` stages the page
+        write into a caller-owned WriteBatch (the lane's
+        one-batch-per-run path) instead of a direct put.
+
+        The reply RING is the single canonical persisted location — one
+        slot per req_seq mod window, so every element of a recently
+        executed batch stays regenerable across crash/ST, and the
+        restore watermark is the ring's newest seq. (An earlier layout
+        ALSO wrote each reply to the per-client "clients" page; that
+        write was fully shadowed by the ring — same canonical bytes,
+        newest-seq watermark derivable from the ring — so it is gone:
+        one page write per request instead of two, digest-deterministic
+        across replicas because every replica runs the same rule.) The
+        "clients" page now carries only the oversize-reply marker, the
+        one record the bounded ring cannot hold."""
         reply = m.ClientReplyMsg(sender_id=self.id, req_seq_num=req_seq,
                                  current_primary=self.primary, reply=payload,
                                  replica_specific_info=b"")
-        self.clients.on_request_executed(client, req_seq, reply)
-        self._forwarded.pop((client, req_seq), None)
         # at-most-once state rides reserved pages so it survives crashes
         # AND state transfer (reference keeps client replies in res pages).
         # Persist a CANONICAL form — per-replica fields (sender, primary)
@@ -1593,26 +1804,36 @@ class Replica(IReceiver):
             sender_id=0, req_seq_num=req_seq, current_primary=0,
             reply=payload, replica_specific_info=b"").pack()
         from tpubft.consensus.reserved_pages import PAGE_SIZE
+
+        def save(category: str, index: int, data: bytes) -> None:
+            if pages_batch is not None:
+                self.res_pages.stage_save(pages_batch, category, index,
+                                          data)
+            else:
+                self.res_pages.save(category, index, data)
+
         if len(canonical) > PAGE_SIZE:
-            # reply too big for its page: keep the at-most-once marker so a
-            # crash/ST never re-executes, even though the cached reply is
-            # lost (the client re-reads; reference paginates large replies)
-            canonical = b"\x01" + req_seq.to_bytes(8, "big")
+            # reply too big for its page: keep the at-most-once marker so
+            # a crash/ST never re-executes, even though the cached reply
+            # is lost (the client re-reads; reference paginates large
+            # replies)
+            save("clients", client, b"\x01" + req_seq.to_bytes(8, "big"))
         else:
-            # reply RING: a slot per req_seq mod window, so every element
-            # of a recently-executed batch stays regenerable across
-            # crash/ST — not just the newest reply (the in-memory cache's
-            # persistence mirror; reference keeps per-request reply slots
-            # in reserved pages). Slot math is deterministic, so pages
-            # stay digest-identical across replicas.
             from tpubft.consensus.clients_manager import \
                 REPLY_CACHE_PER_CLIENT as _RING
-            self.res_pages.save("clientreplies",
-                                client * _RING + req_seq % _RING,
-                                canonical)
-        self.res_pages.save("clients", client, canonical)
-        if not self.info.is_internal_client(client):
-            self.comm.send(client, reply.pack())
+            save("clientreplies", client * _RING + req_seq % _RING,
+                 canonical)
+        if self.info.is_internal_client(client):
+            return reply, None
+        return reply, reply.pack()
+
+    def _send_reply(self, client: int, req_seq: int, payload: bytes) -> None:
+        """Inline-path reply (dispatcher thread): record + send now."""
+        reply, wire = self._build_reply(client, req_seq, payload)
+        self.clients.on_request_executed(client, req_seq, reply)
+        self._forwarded.pop((client, req_seq), None)
+        if wire is not None:
+            self.comm.send(client, wire)
 
     # ------------------------------------------------------------------
     # status beacons + gap retransmission (reference ReplicaStatusMsg +
@@ -1800,15 +2021,25 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     # checkpointing (ReplicaImp.cpp:2280,3274,3439)
     # ------------------------------------------------------------------
-    def _send_checkpoint(self, seq: int) -> None:
-        state_digest = self.handler.state_digest()
-        if self.state_transfer is not None:
-            # snapshot NOW — this is the state the certificate will bind
-            self.state_transfer.on_checkpoint_created(seq, state_digest)
+    def _send_checkpoint(self, seq: int,
+                         state_digest: Optional[bytes] = None,
+                         pages_digest: Optional[bytes] = None) -> None:
+        """Broadcast our checkpoint for `seq`. The digests may be passed
+        in by the execution lane, which snapshots them AT the run
+        boundary (before the next run mutates state) — computing them
+        here would race the executor. The inline path computes them now
+        (nothing executes concurrently there)."""
+        if state_digest is None:
+            state_digest = self.handler.state_digest()
+            if self.state_transfer is not None:
+                # snapshot NOW — this is the state the cert will bind
+                self.state_transfer.on_checkpoint_created(seq, state_digest)
+        if pages_digest is None:
+            pages_digest = self.res_pages.digest()
         ck = m.CheckpointMsg(sender_id=self.id, seq_num=seq,
                              state_digest=state_digest,
                              is_stable=False, epoch=self.epoch,
-                             res_pages_digest=self.res_pages.digest(),
+                             res_pages_digest=pages_digest,
                              signature=b"")
         ck.signature = self.sig.sign(ck.signed_payload())
         self._broadcast(ck)
@@ -2066,6 +2297,15 @@ class Replica(IReceiver):
         if self.in_view_change and self.pending_view is not None \
                 and target <= self.pending_view:
             return
+        # the execution lane drains BEFORE the view-change message is
+        # built: last_executed must reflect every applied run, and the
+        # window must not be harvested/wiped under a run in flight. A
+        # stuck lane defers our participation — the view-change timer's
+        # escalation path re-attempts (peers can proceed without us)
+        if not self._drain_exec_lane():
+            log.error("view change to %d deferred: execution lane did "
+                      "not drain", target)
+            return
         self.in_view_change = True
         self.pending_view = target
         self._pending_entry = None      # a parked entry for a lower view
@@ -2259,6 +2499,15 @@ class Replica(IReceiver):
         """tryToEnterView: adopt the new view, wipe in-flight state, apply
         re-proposal restrictions; the new primary re-proposes."""
         if new_view <= self.view:
+            return
+        # a backup can enter a view it never complained about (NewViewMsg
+        # arriving with the quorum's ViewChangeMsgs): the lane must be
+        # empty before the window wipe below drops slots it references.
+        # A stuck lane defers entry — peers' NewView/status retransmits
+        # re-trigger it
+        if not self._drain_exec_lane():
+            log.error("entry into view %d deferred: execution lane did "
+                      "not drain", new_view)
             return
         # evidence was harvested by _resolve_and_enter in this same view
         # change (ordering msgs are frozen, so the window cannot have

@@ -40,6 +40,26 @@ class ReservedPages:
     def delete(self, category: str, index: int) -> None:
         self._db.delete(self._key(category, index), _FAMILY)
 
+    # ---- batched staging (execution-lane run coalescing) ----
+    def stage_save(self, wb: WriteBatch, category: str, index: int,
+                   data: bytes) -> None:
+        """Stage a save into a caller-owned WriteBatch: the execution
+        lane coalesces a whole run's reply/marker pages into ONE batch
+        (committed via write_batch, or riding the ledger's run batch
+        when pages share its DB) instead of one put per page."""
+        if len(data) > PAGE_SIZE:
+            raise ValueError(f"page exceeds {PAGE_SIZE} bytes")
+        wb.put(self._key(category, index), data, _FAMILY)
+
+    def write_batch(self, wb: WriteBatch) -> None:
+        if wb.ops:
+            self._db.write(wb)
+
+    def shares_db(self, other_db) -> bool:
+        """True when this page store writes to `other_db` — the lane uses
+        this to fold the pages batch into the ledger commit atomically."""
+        return self._db is other_db
+
     def all_pages(self) -> List[Tuple[bytes, bytes]]:
         return list(self._db.range_iter(_FAMILY))
 

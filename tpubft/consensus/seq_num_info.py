@@ -35,6 +35,10 @@ class SeqNumInfo:
     prepared: bool = False
     committed: bool = False
     executed: bool = False
+    # slot handed to the execution lane (run in flight or queued): the
+    # dispatcher's guard against double-submitting a slot whose
+    # committed certificate is re-accepted while the lane still owns it
+    exec_submitted: bool = False
     received_at: float = 0.0                   # monotonic, for path timeout
     # shares that arrived before our PrePrepare did (reference keeps them
     # in the collectors keyed by digest; we buffer until digest is known)

@@ -19,6 +19,7 @@ from tpubft.kvbc import categories as cat
 from tpubft.kvbc.sparse_merkle import SparseMerkleTree
 from tpubft.storage.interfaces import IDBClient, WriteBatch, fkey
 from tpubft.utils import serialize as ser
+from tpubft.utils.racecheck import make_lock
 
 _BLOCKS = b"blk.blocks"
 _MISC = b"blk.misc"
@@ -103,6 +104,16 @@ class _StagedReadView(IDBClient):
         pass
 
 
+@dataclass
+class _Accumulation:
+    """In-flight execution-run accumulation: the shared mirrored batch
+    plus what end/abort need to finish or roll back."""
+    master: "_MirroredBatch"
+    base_last: int
+    notifications: List[Tuple[int, "cat.BlockUpdates"]] = field(
+        default_factory=list)
+
+
 class BlockStoreMixin:
     """Shared block-store + ST-staging + pruning plumbing for both ledger
     engines (categorized and v4 — they differ only in keyspace names and
@@ -129,6 +140,13 @@ class BlockStoreMixin:
         self._genesis = int.from_bytes(gen, "big") if gen else 0
         self._listeners: List[Callable[[int, "cat.BlockUpdates"],
                                        None]] = []
+        # serializes the two users of the staged-read redirect — the
+        # execution lane's block accumulation (executor thread) and
+        # state-transfer linking (dispatcher thread). Held across
+        # begin_accumulation..end/abort and for each link_st_chain
+        # segment loop.
+        self._staging_mu = make_lock("kvbc.staging")
+        self._accum: Optional[_Accumulation] = None
 
     # ---- properties ----
     @property
@@ -154,6 +172,18 @@ class BlockStoreMixin:
 
     # ---- write path ----
     def add_block(self, updates: "cat.BlockUpdates") -> int:
+        acc = self._accum
+        if acc is not None:
+            # accumulation mode (execution lane): stage into the shared
+            # master batch; reads during staging go through the
+            # read-your-writes overlay, so block N+1 sees block N's
+            # pending rows. Nothing touches the DB until
+            # end_accumulation commits the whole run atomically.
+            block_id = self._last + 1
+            self._stage_block(acc.master, block_id, updates)
+            self._last = block_id
+            acc.notifications.append((block_id, updates))
+            return block_id
         block_id = self._last + 1
         wb = WriteBatch()
         self._stage_block(wb, block_id, updates)
@@ -163,6 +193,91 @@ class BlockStoreMixin:
             self._genesis = 1
         self._notify(block_id, updates)
         return block_id
+
+    # ---- block accumulation (execution-lane run commit) ----
+    def begin_accumulation(self) -> None:
+        """Enter accumulation mode: subsequent add_block calls stage into
+        ONE shared WriteBatch (committed by end_accumulation) instead of
+        one DB write per block. Reads issued while accumulating — the
+        handler's read-your-writes during execution, read-only queries —
+        observe the staged blocks through the overlay view. Takes the
+        staging lock; the caller MUST reach end/abort_accumulation."""
+        self._staging_mu.acquire()
+        try:
+            if self._accum is not None:
+                raise BlockchainError("accumulation already active")
+            overlay: Dict[bytes, Optional[bytes]] = {}
+            view = _StagedReadView(self._db, overlay)
+            self._accum = _Accumulation(master=_MirroredBatch(overlay),
+                                        base_last=self._last)
+            self._begin_staged_reads(view)
+        except BaseException:
+            self._staging_mu.release()
+            raise
+
+    def end_accumulation(self,
+                         extra: Optional[WriteBatch] = None) -> int:
+        """Commit the accumulated run in one atomic WriteBatch. `extra`
+        ops (e.g. the run's reserved-pages/reply rows when they live in
+        the same DB) ride the same batch, making apply atomic across
+        ledger and reply state. Returns the new head.
+
+        The batch is written to the BASE db while the staged-read view
+        is still installed: unsynchronized readers (read-only queries on
+        the dispatcher) see the staged values through the overlay right
+        up to the moment the same values are durably in the base — no
+        torn window where a key's new value momentarily vanishes. A
+        failed write rolls the head back (abort semantics) so a retry
+        re-stages from the pre-run state instead of double-appending."""
+        acc = self._accum
+        if acc is None:
+            raise BlockchainError("no accumulation active")
+        try:
+            if extra is not None:
+                acc.master.ops.extend(extra.ops)
+            if acc.master.ops:
+                self._base_db.write(acc.master)
+        except BaseException:
+            self._accum = None
+            self._end_staged_reads()
+            self._last = acc.base_last
+            self._staging_mu.release()
+            raise
+        self._accum = None
+        self._end_staged_reads()
+        if self._last and self._genesis == 0:
+            self._genesis = 1
+        self._staging_mu.release()
+        for block_id, updates in acc.notifications:
+            self._notify(block_id, updates)
+        return self._last
+
+    def abort_accumulation(self) -> None:
+        """Drop the staged run (run execution failed): the head rolls
+        back to where begin_accumulation found it, nothing was written."""
+        acc = self._accum
+        if acc is None:
+            return
+        try:
+            self._accum = None
+            self._end_staged_reads()
+            self._last = acc.base_last
+        finally:
+            self._staging_mu.release()
+
+    def add_blocks(self, updates_list: List["cat.BlockUpdates"]) -> int:
+        """Append N blocks in ONE atomic WriteBatch (the bulk form of
+        add_block — engines may override with batched hashing)."""
+        if not updates_list:
+            return self._last
+        self.begin_accumulation()
+        try:
+            for bu in updates_list:
+                self.add_block(bu)
+        except BaseException:
+            self.abort_accumulation()
+            raise
+        return self.end_accumulation()
 
     def _put_block_row(self, wb: WriteBatch, block_id: int,
                        block: "Block") -> None:
@@ -257,9 +372,8 @@ class BlockStoreMixin:
         still commits, the bad row is dropped (so retries can re-fetch
         from another source instead of wedging on the same bytes), and
         the error propagates. Returns the new head."""
-        base_db = self._db
-        nxt = self._last + 1
-        prev_digest = self.block_digest(self._last) if self._last else b""
+        nxt: Optional[int] = None
+        prev_digest = b""
         bad: Optional[int] = None
         error: Optional[BaseException] = None
 
@@ -277,6 +391,17 @@ class BlockStoreMixin:
                     self._notify(block_id, updates)
 
         while error is None:
+            # one segment at a time under the staging lock: the
+            # execution lane's accumulation shares the staged-read
+            # redirect and must never interleave with linking. The head
+            # snapshot happens under the lock too — an accumulation in
+            # another thread moves self._db and self._last.
+            self._staging_mu.acquire()
+            base_db = self._db
+            if nxt is None:
+                nxt = self._last + 1
+                prev_digest = (self.block_digest(self._last)
+                               if self._last else b"")
             overlay: Dict[bytes, Optional[bytes]] = {}
             view = _StagedReadView(base_db, overlay)
             master = WriteBatch()
@@ -311,8 +436,13 @@ class BlockStoreMixin:
                     prev_digest = blk.digest()
                     nxt += 1
             finally:
-                self._end_staged_reads()
-            commit(master, adopted)
+                try:
+                    self._end_staged_reads()
+                    commit(master, adopted)   # still under the lock: the
+                    # segment's adoption (head + db write) must land
+                    # before an accumulation can slot blocks after it
+                finally:
+                    self._staging_mu.release()
             if len(adopted) < self.LINK_SEGMENT_BLOCKS:
                 break               # ran out of staged blocks (or hit bad)
         if error is not None:
@@ -366,6 +496,79 @@ class KeyValueBlockchain(BlockStoreMixin):
                       updates_blob=cat.encode_block_updates(updates))
         self._put_block_row(wb, block_id, block)
         return block
+
+    def add_blocks(self, updates_list: List[cat.BlockUpdates]) -> int:
+        """Bulk append with cross-block merkle batching: N blocks land in
+        ONE WriteBatch, and every block_merkle category's node rehashing
+        for the whole run happens level-wise — one `ops/sha256` call per
+        tree level spanning ALL blocks' changed nodes
+        (SparseMerkleTree.update_batches) — instead of N independent
+        per-block host walks. Per-block roots, archive rows, and the
+        block rows themselves are byte-identical to N add_block calls."""
+        if not updates_list:
+            return self._last
+        if len(updates_list) == 1:
+            return self.add_block(updates_list[0])
+        with self._staging_mu:
+            if self._accum is not None:
+                raise BlockchainError("add_blocks inside accumulation")
+            first = self._last + 1
+            overlay: Dict[bytes, Optional[bytes]] = {}
+            view = _StagedReadView(self._db, overlay)
+            master = _MirroredBatch(overlay)
+            self._begin_staged_reads(view)
+            try:
+                # phase 1: all merkle categories, level-synchronous
+                # across the whole run
+                merkle: Dict[str, List[Dict[bytes, Optional[bytes]]]] = {}
+                for i, bu in enumerate(updates_list):
+                    for name, (ct, cu) in bu.categories.items():
+                        if ct != cat.BLOCK_MERKLE:
+                            continue
+                        per_block = merkle.setdefault(
+                            name, [{} for _ in updates_list])
+                        per_block[i] = {
+                            k: (hashlib.sha256(v).digest()
+                                if v is not None else None)
+                            for k, v in cu.kv.items()}
+                roots: Dict[str, List[bytes]] = {}
+                for name, per_block in merkle.items():
+                    master.put(name.encode(), b"", cat.SMT_REGISTRY_FAMILY)
+                    roots[name] = self._tree(name).update_batches(
+                        per_block, batch=master, first_version=first)
+                # phase 2: per-block data rows + chained block rows
+                prev = (self.block_digest(self._last)
+                        if self._last else b"")
+                last_notified: List[Tuple[int, cat.BlockUpdates]] = []
+                for i, bu in enumerate(updates_list):
+                    bid = first + i
+                    digests: Dict[str, bytes] = {}
+                    for name in sorted(bu.categories):
+                        ct, cu = bu.categories[name]
+                        if ct == cat.BLOCK_MERKLE:
+                            digests[name] = roots[name][i]
+                            cat.stage_merkle_data(master, name, cu, bid)
+                        else:
+                            digests[name] = cat.stage_category(
+                                self._db, master, name, ct, cu, bid,
+                                self._tree)
+                    block = Block(block_id=bid, parent_digest=prev,
+                                  category_digests=digests,
+                                  updates_blob=cat.encode_block_updates(bu))
+                    self._put_block_row(master, bid, block)
+                    prev = block.digest()
+                    last_notified.append((bid, bu))
+                # write to the BASE while the view is still installed —
+                # same no-torn-window rule as end_accumulation
+                self._base_db.write(master)
+            finally:
+                self._end_staged_reads()
+            self._last = first + len(updates_list) - 1
+            if self._genesis == 0:
+                self._genesis = 1
+        for bid, bu in last_notified:
+            self._notify(bid, bu)
+        return self._last
 
     # ---- categorized reads ----
     def get_latest(self, category: str, key: bytes,

@@ -91,6 +91,19 @@ class CategoryError(Exception):
     pass
 
 
+def stage_merkle_data(wb: WriteBatch, category: str,
+                      updates: CategoryUpdates, block_id: int) -> None:
+    """Stage a block_merkle category's raw data rows (the non-tree half
+    of its staging — split out so bulk paths that batch the tree work
+    across blocks stage the data rows identically)."""
+    for k, v in updates.kv.items():
+        if v is None:
+            wb.delete(k, _fam(category, "data"))
+        else:
+            wb.put(k, block_id.to_bytes(8, "big") + v,
+                   _fam(category, "data"))
+
+
 def stage_category(db: IDBClient, wb: WriteBatch, category: str,
                    cat_type: str, updates: CategoryUpdates, block_id: int,
                    merkle_trees) -> bytes:
@@ -106,12 +119,7 @@ def stage_category(db: IDBClient, wb: WriteBatch, category: str,
         leaf = {k: (hashlib.sha256(v).digest() if v is not None else None)
                 for k, v in updates.kv.items()}
         root = tree.update_batch(leaf, batch=wb, version=block_id)
-        for k, v in updates.kv.items():
-            if v is None:
-                wb.delete(k, _fam(category, "data"))
-            else:
-                wb.put(k, block_id.to_bytes(8, "big") + v,
-                       _fam(category, "data"))
+        stage_merkle_data(wb, category, updates, block_id)
         return root
 
     if cat_type == VERSIONED_KV:

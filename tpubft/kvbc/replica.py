@@ -74,6 +74,13 @@ class KvbcReplica:
             handler_factory = SkvbcHandler
         self.handler: IRequestsHandler = handler_factory(self.blockchain)
         from tpubft.consensus.reserved_pages import ReservedPages
+        # pages share the LEDGER's DB on purpose: the execution lane
+        # folds each run's reply-ring/marker pages into the ledger's
+        # accumulated WriteBatch (ReservedPages.shares_db), so a run's
+        # durable apply is atomic across blocks and at-most-once state —
+        # a crash can never see blocks without their reply markers or
+        # vice versa. Splitting pages into their own DB silently
+        # downgrades that to two ordered batches.
         pages = ReservedPages(self.db)
         self.replica = Replica(cfg, keys, comm, self.handler,
                                storage=DBPersistentStorage(self.db),

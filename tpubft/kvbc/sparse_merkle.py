@@ -149,6 +149,135 @@ class SparseMerkleTree:
             self._db.write(wb)
         return changed[0]
 
+    # ---- multi-block batch update ----
+    def update_batches(self, updates_list: Sequence[Dict[bytes,
+                                                         Optional[bytes]]],
+                       batch: Optional[WriteBatch] = None,
+                       first_version: int = 0) -> List[bytes]:
+        """Apply N consecutive blocks' updates in one level-synchronous
+        walk: block i gets version `first_version + i` (0 = unversioned,
+        like update_batch). Returns the root AFTER each block, exactly as
+        N sequential update_batch calls would, and stages byte-identical
+        rows (final node/leaf values + one archive row per changed node
+        per version).
+
+        The win over per-block calls is hash batching: at every level,
+        the changed nodes of ALL blocks hash in ONE _hash_level call (one
+        ops/sha256 device dispatch per level once wide enough) instead of
+        one host loop per block per level. Cross-block dependencies are
+        handled by tracking, per node, the ordered list of
+        (block index, hash) versions: block i's parent hash reads the
+        newest child value at or below i, falling back to the DB for
+        nodes untouched by the whole batch."""
+        if not updates_list:
+            return []
+        nblocks = len(updates_list)
+        if not any(updates_list):
+            return [self.root()] * nblocks
+        if nblocks == 1:
+            # degenerate: the sequential path is the batched path
+            return [self.update_batch(dict(updates_list[0]), batch=batch,
+                                      version=first_version)]
+        own_batch = batch is None
+        wb = WriteBatch() if own_batch else batch
+        vers = [(first_version + i).to_bytes(8, "big")
+                if first_version > 0 else None for i in range(nblocks)]
+
+        # leaf level: per path, ordered (block, hash) versions
+        changed: Dict[int, List[Tuple[int, bytes]]] = {}
+        final_leaf: Dict[bytes, Optional[bytes]] = {}
+        for i, updates in enumerate(updates_list):
+            for key, vh in updates.items():
+                path = hashlib.sha256(key).digest()
+                bits = int.from_bytes(path, "big")
+                h = _EMPTY if vh is None else _leaf_hash(path, vh)
+                changed.setdefault(bits, []).append((i, h))
+                final_leaf[path] = vh
+                if vers[i] is not None:
+                    wb.put(path + vers[i],
+                           vh if vh is not None else b"",
+                           self._leaf_arch_family)
+        for path, vh in final_leaf.items():
+            if vh is None:
+                wb.delete(path, self._leaf_family)
+            else:
+                wb.put(path, vh, self._leaf_family)
+        # pre-batch values of this level's changed nodes, captured BEFORE
+        # staging them: `wb` may be a read-your-writes mirrored batch (the
+        # bulk add_blocks path), where a post-staging read of a node whose
+        # first change is at a LATER block would see that final value
+        # instead of the pre-batch one — corrupting earlier blocks' roots
+        pre: Dict[int, bytes] = {b: self._node(DEPTH, b) for b in changed}
+        self._stage_level_multi(wb, DEPTH, changed, vers)
+
+        for depth in range(DEPTH, 0, -1):
+            def value_at(bits: int, i: int) -> bytes:
+                """Newest value of (depth, bits) at or below block i:
+                the node's newest in-batch version ≤ i, its pre-batch
+                value if its first change is later, or the DB (which the
+                batch never touched for this node)."""
+                versions = changed.get(bits)
+                if versions is None:
+                    return self._node(depth, bits)
+                best = None
+                for j, h in versions:          # ascending block order
+                    if j > i:
+                        break
+                    best = h
+                return best if best is not None else pre[bits]
+
+            # (parent_bits, block) pairs needing a hash, in stable order
+            pairs: List[Tuple[int, int]] = []
+            seen = set()
+            for bits, versions in changed.items():
+                pb = bits >> 1
+                for i, _ in versions:
+                    if (pb, i) not in seen:
+                        seen.add((pb, i))
+                        pairs.append((pb, i))
+            pairs.sort()
+            msgs = [b"\x01" + value_at(pb << 1, i)
+                    + value_at((pb << 1) | 1, i)
+                    for pb, i in pairs]
+            hashes = _hash_level(msgs, self._use_device)
+            parents: Dict[int, List[Tuple[int, bytes]]] = {}
+            for (pb, i), h in zip(pairs, hashes):
+                parents.setdefault(pb, []).append((i, h))
+            changed = parents                  # pairs sorted → ascending i
+            pre = {b: self._node(depth - 1, b) for b in changed}
+            self._stage_level_multi(wb, depth - 1, changed, vers)
+
+        if own_batch:
+            self._db.write(wb)
+        root_versions = changed[0]
+        roots, cur = [], pre[0]               # pre-batch root
+        it = iter(root_versions)
+        nxt = next(it, None)
+        for i in range(nblocks):
+            while nxt is not None and nxt[0] <= i:
+                cur = nxt[1]
+                nxt = next(it, None)
+            roots.append(cur)
+        return roots
+
+    def _stage_level_multi(self, wb: WriteBatch, depth: int,
+                           nodes: Dict[int, List[Tuple[int, bytes]]],
+                           vers: List[Optional[bytes]]) -> None:
+        """Stage a level's multi-version nodes: final value to the live
+        family, one archive row per (node, block) change."""
+        default = _DEFAULTS[depth]
+        for bits, versions in nodes.items():
+            k = _node_key(depth, bits)
+            final = versions[-1][1]
+            if final == default:
+                wb.delete(k, self._family)
+            else:
+                wb.put(k, final, self._family)
+            for i, h in versions:
+                if vers[i] is not None:
+                    wb.put(k + vers[i], b"" if h == default else h,
+                           self._arch_family)
+
     def _stage_level(self, wb: WriteBatch, depth: int,
                      nodes: Dict[int, bytes],
                      ver: Optional[bytes] = None) -> None:

@@ -83,6 +83,18 @@ class NativeDB(IDBClient):
         self._compact_bytes = compact_bytes
         self._sync_prefixes: Tuple[bytes, ...] = () if sync_writes else \
             tuple(bytes([len(f)]) + f for f in sync_families)
+        # ctypes releases the GIL around C calls, and the execution lane
+        # writes ledger/pages batches concurrently with the dispatcher's
+        # metadata batches on the SAME handle. The C engine is not
+        # audited for lock-free concurrent access, so EVERY handle
+        # operation — reads and scans included — serializes here. This
+        # is a deliberate latency trade: a dispatcher point read can
+        # block behind the lane's run commit (one buffered batch apply;
+        # fsync only for sync-family batches, which originate on the
+        # dispatcher itself). Relaxing reads requires a C-side
+        # concurrency audit first.
+        import threading
+        self._write_mu = threading.Lock()
 
     def _handle(self):
         if not self._h:
@@ -95,29 +107,36 @@ class NativeDB(IDBClient):
         k = fkey(family, key)
         val = _U8P()
         vlen = ctypes.c_uint32()
-        rc = self._lib.kvlog_get(self._h, k, len(k), ctypes.byref(val),
-                                 ctypes.byref(vlen))
-        if rc == 1:
-            return None
-        if rc != 0:
-            raise StorageError(f"kvlog_get rc={rc}")
-        try:
-            return ctypes.string_at(val, vlen.value)
-        finally:
-            self._lib.kvlog_free(val)
+        with self._write_mu:
+            rc = self._lib.kvlog_get(self._handle(), k, len(k),
+                                     ctypes.byref(val),
+                                     ctypes.byref(vlen))
+            if rc == 1:
+                return None
+            if rc != 0:
+                raise StorageError(f"kvlog_get rc={rc}")
+            try:
+                return ctypes.string_at(val, vlen.value)
+            finally:
+                self._lib.kvlog_free(val)
 
     def write(self, batch: WriteBatch) -> None:
         self._handle()
         payload = batch.encode()
-        rc = self._lib.kvlog_apply(self._h, payload, len(payload))
-        if rc != 0:
-            raise StorageError(f"kvlog_apply rc={rc}")
-        if self._sync_prefixes and any(
-                k.startswith(self._sync_prefixes) for k, _ in batch.ops):
-            rc = self._lib.kvlog_sync(self._h)
+        with self._write_mu:
+            rc = self._lib.kvlog_apply(self._handle(), payload,
+                                       len(payload))
             if rc != 0:
-                raise StorageError(f"kvlog_sync rc={rc}")
-        if self._lib.kvlog_wal_bytes(self._h) > self._compact_bytes:
+                raise StorageError(f"kvlog_apply rc={rc}")
+            if self._sync_prefixes and any(
+                    k.startswith(self._sync_prefixes)
+                    for k, _ in batch.ops):
+                rc = self._lib.kvlog_sync(self._h)
+                if rc != 0:
+                    raise StorageError(f"kvlog_sync rc={rc}")
+            need_compact = (self._lib.kvlog_wal_bytes(self._h)
+                            > self._compact_bytes)
+        if need_compact:
             self.compact()
 
     def range_iter(self, family: bytes = DEFAULT_FAMILY,
@@ -129,16 +148,18 @@ class NativeDB(IDBClient):
         hi = fkey(family, end) if end is not None else family_upper_bound(family)
         out = _U8P()
         outlen = ctypes.c_uint32()
-        rc = self._lib.kvlog_scan(
-            self._h, lo, len(lo), hi if hi is not None else b"",
-            0xFFFFFFFF if hi is None else len(hi),
-            ctypes.byref(out), ctypes.byref(outlen))
-        if rc != 0:
-            raise StorageError(f"kvlog_scan rc={rc}")
-        try:
-            buf = ctypes.string_at(out, outlen.value)
-        finally:
-            self._lib.kvlog_free(out)
+        with self._write_mu:
+            rc = self._lib.kvlog_scan(
+                self._handle(), lo, len(lo),
+                hi if hi is not None else b"",
+                0xFFFFFFFF if hi is None else len(hi),
+                ctypes.byref(out), ctypes.byref(outlen))
+            if rc != 0:
+                raise StorageError(f"kvlog_scan rc={rc}")
+            try:
+                buf = ctypes.string_at(out, outlen.value)
+            finally:
+                self._lib.kvlog_free(out)
         prefix = 1 + len(family)
         for k, v in _decode_scan(buf):
             yield k[prefix:], v
@@ -148,35 +169,44 @@ class NativeDB(IDBClient):
         self._handle()
         out = _U8P()
         outlen = ctypes.c_uint32()
-        rc = self._lib.kvlog_scan(self._h, b"", 0, b"", 0xFFFFFFFF,
-                                  ctypes.byref(out), ctypes.byref(outlen))
-        if rc != 0:
-            raise StorageError(f"kvlog_scan rc={rc}")
-        try:
-            buf = ctypes.string_at(out, outlen.value)
-        finally:
-            self._lib.kvlog_free(out)
+        with self._write_mu:
+            rc = self._lib.kvlog_scan(self._handle(), b"", 0, b"",
+                                      0xFFFFFFFF, ctypes.byref(out),
+                                      ctypes.byref(outlen))
+            if rc != 0:
+                raise StorageError(f"kvlog_scan rc={rc}")
+            try:
+                buf = ctypes.string_at(out, outlen.value)
+            finally:
+                self._lib.kvlog_free(out)
         for k, v in _decode_scan(buf):
             fam, key = split_fkey(k)
             yield fam, key, v
 
     def compact(self) -> None:
-        rc = self._lib.kvlog_compact(self._handle())
-        if rc != 0:
-            raise StorageError(f"kvlog_compact rc={rc}")
+        with self._write_mu:
+            rc = self._lib.kvlog_compact(self._handle())
+            if rc != 0:
+                raise StorageError(f"kvlog_compact rc={rc}")
 
     def checkpoint_to(self, path: str) -> None:
         """Consistent snapshot for operator backups (reference:
         DbCheckpointManager RocksDB checkpoints). The snapshot file is a
         valid kvlog — openable with NativeDB directly."""
-        rc = self._lib.kvlog_checkpoint(self._handle(), path.encode())
-        if rc != 0:
-            raise StorageError(f"kvlog_checkpoint rc={rc}")
+        with self._write_mu:
+            rc = self._lib.kvlog_checkpoint(self._handle(), path.encode())
+            if rc != 0:
+                raise StorageError(f"kvlog_checkpoint rc={rc}")
 
     def count(self) -> int:
-        return self._lib.kvlog_count(self._handle())
+        with self._write_mu:
+            return self._lib.kvlog_count(self._handle())
 
     def close(self) -> None:
-        if self._h:
-            self._lib.kvlog_close(self._h)
-            self._h = None
+        # under the handle lock: a lane thread that outlived its join
+        # timeout could still be inside a C call on this handle — close
+        # must never free it mid-operation
+        with self._write_mu:
+            if self._h:
+                self._lib.kvlog_close(self._h)
+                self._h = None

@@ -1,4 +1,16 @@
-"""In-process test harnesses (reference fake_comm.h + Apollo's BftTestNetwork)."""
-from tpubft.testing.cluster import InProcessCluster
+"""In-process test harnesses (reference fake_comm.h + Apollo's BftTestNetwork).
+
+InProcessCluster is exported lazily (PEP 562): submodules like
+`tpubft.testing.slowdown` are imported by the consensus engine at module
+scope, and an eager cluster import here would close a circular import
+back into tpubft.consensus.replica.
+"""
 
 __all__ = ["InProcessCluster"]
+
+
+def __getattr__(name):
+    if name == "InProcessCluster":
+        from tpubft.testing.cluster import InProcessCluster
+        return InProcessCluster
+    raise AttributeError(name)

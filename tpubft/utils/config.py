@@ -131,6 +131,20 @@ class ReplicaConfig:
     # CombinedSigVerificationJob); False = verify inline (debug only)
     async_verification: bool = True
 
+    # execution pipelining (reference: post-execution separation +
+    # block accumulation). True = committed slots are executed by a
+    # dedicated in-order executor thread that accumulates runs of
+    # consecutive slots into ONE ledger commit + ONE reserved-pages
+    # batch per run, keeping the dispatcher free to order the next
+    # slots; False = the legacy inline path (execution on the
+    # dispatcher, one commit per slot).
+    execution_lane: bool = True
+    # max committed slots coalesced into one execution run / ledger
+    # commit. Runs always break at checkpoint-window boundaries so
+    # state digests stay comparable cluster-wide. 1 degenerates to
+    # per-slot commits (still off the dispatcher).
+    execution_max_accumulation: int = 16
+
     # retransmissions
     retransmissions_enabled: bool = True
     retransmission_timer_ms: int = 50
@@ -185,6 +199,8 @@ class ReplicaConfig:
             raise ValueError("f_val must be >= 1")
         if self.work_window_size % self.checkpoint_window_size != 0:
             raise ValueError("work window must be a multiple of checkpoint window")
+        if self.execution_max_accumulation < 1:
+            raise ValueError("execution_max_accumulation must be >= 1")
 
     # ---- serialization ----
     def to_json(self) -> str:
