@@ -24,33 +24,58 @@ import sys
 import time
 
 
-def _device_probe_once(timeout_s: float = 90.0) -> bool:
+def _device_probe_once(timeout_s: float = 90.0):
     """Probe default-platform device init in a subprocess (init can hang
-    forever when the TPU tunnel is down)."""
+    forever when the TPU tunnel is down). Returns (ok, error_detail) —
+    the detail is what a degraded artifact surfaces as `probe_error`, so
+    'no hardware' is diagnosable instead of a silent CPU fallback. The
+    probe reports the backend it initialized: jax falls back to CPU
+    *successfully* when the accelerator plugin is absent or its init
+    fails, so 'the array op ran' alone cannot distinguish a live device
+    from the very fallback this probe exists to catch — backend 'cpu'
+    counts as unavailable, with jax's init warning as the detail."""
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax, jax.numpy as jnp;"
-             "print('ok' if float(jnp.ones((8,128)).sum()) else '')"],
+             "assert float(jnp.ones((8,128)).sum());"
+             "print('backend=' + jax.default_backend())"],
             capture_output=True, timeout=timeout_s)
-        return b"ok" in r.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+        out = r.stdout.decode("utf-8", "replace")
+        err = r.stderr.decode("utf-8", "replace").strip()
+        if "backend=" in out:
+            backend = out.rsplit("backend=", 1)[1].strip()
+            if backend and backend != "cpu":
+                return True, None
+            return False, ("default backend is cpu (accelerator plugin "
+                           "absent or failed to init): %s"
+                           % (err[-800:] or "<no stderr>"))
+        return False, ("probe exited rc=%d: %s" % (r.returncode,
+                                                   err[-800:] or "<no stderr>"))
+    except subprocess.TimeoutExpired:
+        return False, "probe timed out after %.0fs (device init hang)" \
+            % timeout_s
+    except OSError as e:
+        return False, "probe failed to launch: %r" % (e,)
 
 
-def _device_available() -> bool:
+def _device_available():
     """Retry-wait for the device: a round's only driver-captured perf
-    artifact shouldn't be forfeited to a transient tunnel outage."""
+    artifact shouldn't be forfeited to a transient tunnel outage.
+    Returns (ok, last_probe_error)."""
     deadline = time.monotonic() + float(
         os.environ.get("TPUBFT_BENCH_DEVICE_WAIT_S", "900"))
+    last_err = None
     while True:
-        if _device_probe_once():
-            return True
+        ok, err = _device_probe_once()
+        if ok:
+            return True, None
+        last_err = err or last_err
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            return False
-        print("bench: device init unavailable; retrying (%.0fs left)"
-              % remaining, file=sys.stderr)
+            return False, last_err
+        print("bench: device init unavailable; retrying (%.0fs left): %s"
+              % (remaining, err), file=sys.stderr)
         time.sleep(min(30.0, remaining))
 
 
@@ -117,7 +142,7 @@ def _secondary_metrics(platform: str) -> dict:
 
 
 def main() -> None:
-    use_default_platform = _device_available()
+    use_default_platform, probe_error = _device_available()
 
     import jax
     if not use_default_platform:
@@ -233,6 +258,10 @@ def main() -> None:
               file=sys.stderr)
     if platform == "cpu":
         record["degraded"] = True  # no accelerator at capture time
+        if probe_error:
+            # WHY the probe failed (captured stderr / timeout / launch
+            # error) — a degraded:true artifact must be diagnosable
+            record["probe_error"] = probe_error
         # surface the most recent archived hardware capture (written by
         # tools/tpu_capture.sh during a device window) so a transient
         # tunnel outage at driver time doesn't erase the round's number

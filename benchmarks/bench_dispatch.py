@@ -1,0 +1,237 @@
+"""Dispatcher throughput under synthetic verified-traffic flood:
+admission plane ON vs OFF.
+
+The measured pipeline is one BACKUP replica's full ingest path — the
+transport upcall (`on_new_message`) through parse, client-signature
+verification, and the dispatcher handler that arms the dead-primary
+liveness clock — with a null transport (sends dropped), so the number
+is the replica's message-processing rate, not the network's.
+
+Two flood shapes per mode, back-to-back A/B pairs:
+
+  * distinct   — M individually-signed, never-repeated ClientRequests:
+    every message pays a real signature verification. Admission ON
+    coalesces them into per-drain `verify_batch` calls on the worker
+    pool; OFF runs the legacy dispatcher-unpack + req_batcher path.
+  * storm      — K distinct requests replayed to M total (the
+    retransmit-flood shape): admission's header peek + within-drain
+    duplicate collapse + the SigManager memo shed the repeats before
+    the dispatcher pays a full unpack for each.
+
+Completion is observed on the CONSUMER side (admission `processed`
+marker / dispatcher `handled_external`, empty queues, no in-flight
+verifies), so elapsed time covers the whole pipeline drain.
+
+Usage: python -m benchmarks.bench_dispatch [--msgs 1200] [--distinct 64]
+       [--samples 2] [--workers 2] [--smoke]
+Prints one JSON line per (shape, mode, sample) plus a summary line with
+the per-shape median speedups. --smoke runs a tiny fixed shape for
+tier-1 (tests/test_bench_dispatch_smoke.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+from typing import List, Optional
+
+from tpubft.comm.interfaces import (ConnectionStatus, ICommunication,
+                                    IReceiver, NodeNum)
+from tpubft.consensus import messages as m
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.consensus.replica import Replica
+from tpubft.utils.config import ReplicaConfig
+
+F = 1
+CLIENTS = 2
+SEED = b"bench-dispatch"
+
+
+class NullComm(ICommunication):
+    """Counts sends, delivers nothing: the replica under flood must not
+    spend the measurement window on real sockets."""
+
+    def __init__(self) -> None:
+        self.sent = 0
+        self._running = False
+
+    def start(self, receiver: IReceiver) -> None:
+        self._running = True
+
+    def stop(self) -> None:
+        self._running = False
+
+    def is_running(self) -> bool:
+        return self._running
+
+    def send(self, dest: NodeNum, data: bytes) -> None:
+        self.sent += 1
+
+    def get_connection_status(self, node: NodeNum) -> ConnectionStatus:
+        return ConnectionStatus.CONNECTED
+
+
+def _make_replica(workers: int):
+    """One backup replica (id 1 of n=4, view 0) with a null transport.
+    The view-change timer is parked: a flood bench must not complain its
+    way into a view change mid-measurement."""
+    from tpubft.apps.counter import CounterHandler
+    cfg = ReplicaConfig(replica_id=1, f_val=F,
+                        num_of_client_proxies=CLIENTS,
+                        admission_workers=workers,
+                        view_change_timer_ms=3_600_000)
+    keys = ClusterKeys.generate(cfg, CLIENTS, seed=SEED)
+    rep = Replica(cfg, keys.for_node(1), NullComm(), CounterHandler())
+    rep.start()
+    return rep, keys, cfg.n_val + cfg.num_ro_replicas
+
+
+def _signed_requests(keys, first_client: int, count: int,
+                     base_seq: int) -> List[tuple]:
+    """`count` distinct signed requests round-robined over the client
+    principals; returns [(client_id, packed bytes)]."""
+    signers = {c: keys.for_node(c).my_signer()
+               for c in range(first_client, first_client + CLIENTS)}
+    out = []
+    for i in range(count):
+        cid = first_client + i % CLIENTS
+        req = m.ClientRequestMsg(sender_id=cid,
+                                 req_seq_num=base_seq + i // CLIENTS,
+                                 flags=0, request=b"flood-%d" % i,
+                                 cid="", signature=b"")
+        req.signature = signers[cid].sign(req.signed_payload())
+        out.append((cid, req.pack()))
+    return out
+
+
+def _drain_done(rep, injected: int, distinct: int) -> bool:
+    if rep.admission is not None:
+        ingested = rep.admission.processed >= injected
+    else:
+        ingested = rep.dispatcher.handled_external >= injected
+    return (ingested
+            and rep.incoming._external.qsize() == 0
+            and rep.incoming._internal.qsize() == 0
+            and not rep._req_verifying
+            and len(rep._forwarded) >= distinct)
+
+
+def _run_flood(rep, flood: List[tuple], distinct: int,
+               timeout_s: float = 300.0) -> Optional[float]:
+    t0 = time.perf_counter()
+    for cid, raw in flood:
+        rep.on_new_message(cid, raw)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if _drain_done(rep, len(flood), distinct):
+            return time.perf_counter() - t0
+        time.sleep(0.002)
+    return None
+
+
+def run_pair(shape: str, msgs: int, distinct: int, workers: int,
+             sample: int) -> List[dict]:
+    """One back-to-back A/B pair (fresh replica per mode, same flood
+    content) — the host-noise-pairing convention of RESULTS.md."""
+    rows = []
+    for mode, w in (("admission", workers), ("inline", 0)):
+        rep, keys, first_client = _make_replica(w)
+        try:
+            base_seq = int(time.time() * 1e6)
+            uniq = _signed_requests(keys, first_client,
+                                    distinct if shape == "storm" else msgs,
+                                    base_seq)
+            flood = (uniq * (msgs // len(uniq) + 1))[:msgs] \
+                if shape == "storm" else uniq
+            dt = _run_flood(rep, flood, min(distinct, msgs)
+                            if shape == "storm" else msgs)
+            row = {
+                "bench": "dispatch_flood", "shape": shape, "mode": mode,
+                "sample": sample, "msgs": msgs,
+                "distinct": len(uniq), "admission_workers": w,
+                "secs": round(dt, 3) if dt else None,
+                "msgs_per_sec": round(msgs / dt, 1) if dt else None,
+            }
+            if rep.admission is not None:
+                c = rep.admission.metrics.counters
+                row["adm"] = {k: v.value for k, v in c.items()}
+            sm = rep.sig.metrics.counters
+            row["sig"] = {k: sm[k].value for k in
+                          ("memo_hits", "batched_verifies",
+                           "scalar_fallbacks")}
+            rows.append(row)
+        finally:
+            rep.stop()
+    return rows
+
+
+def run(msgs: int, distinct: int, samples: int, workers: int,
+        shapes=("distinct", "storm")) -> List[dict]:
+    rows = []
+    for shape in shapes:
+        for s in range(samples):
+            pair = run_pair(shape, msgs, distinct, workers, s)
+            rows.extend(pair)
+            for r in pair:
+                print(json.dumps(r), flush=True)
+    # summary: per-shape median speedup over the recorded pairs
+    summary = {"bench": "dispatch_flood_summary", "msgs": msgs,
+               "workers": workers}
+    for shape in shapes:
+        ons = [r["msgs_per_sec"] for r in rows
+               if r["shape"] == shape and r["mode"] == "admission"
+               and r["msgs_per_sec"]]
+        offs = [r["msgs_per_sec"] for r in rows
+                if r["shape"] == shape and r["mode"] == "inline"
+                and r["msgs_per_sec"]]
+        if ons and offs and len(ons) == len(offs):
+            ratios = [a / b for a, b in zip(ons, offs)]
+            summary[f"{shape}_speedup_median"] = round(
+                statistics.median(ratios), 2)
+            summary[f"{shape}_speedups"] = [round(x, 2) for x in ratios]
+    print(json.dumps(summary), flush=True)
+    rows.append(summary)
+    return rows
+
+
+def smoke() -> dict:
+    """Tier-1 shape: tiny flood through both modes; asserts both drain
+    and that the admission plane actually shed the storm repeats before
+    the dispatcher (the structural property, not a perf number —
+    wall-clock ratios are not asserted in CI)."""
+    rows = run(msgs=300, distinct=16, samples=1, workers=1,
+               shapes=("storm",))
+    on = next(r for r in rows if r.get("mode") == "admission")
+    off = next(r for r in rows if r.get("mode") == "inline")
+    adm = on["adm"]
+    return {
+        "ok": bool(on["secs"] and off["secs"]),
+        "admission_drained": on["secs"] is not None,
+        "inline_drained": off["secs"] is not None,
+        # the dispatcher saw only the admitted survivors, not the flood
+        "shed": adm["adm_drops_pre_parse"] > 0,
+        "adm": adm,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--msgs", type=int, default=1200,
+                    help="flood size per sample")
+    ap.add_argument("--distinct", type=int, default=64,
+                    help="distinct signed requests in the storm shape")
+    ap.add_argument("--samples", type=int, default=2,
+                    help="back-to-back A/B pairs per shape")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="admission_workers for the ON mode")
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args()
+    if args.smoke:
+        print(json.dumps(smoke()), flush=True)
+        return
+    run(args.msgs, args.distinct, args.samples, args.workers)
+
+
+if __name__ == "__main__":
+    main()

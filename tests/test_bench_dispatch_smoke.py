@@ -1,0 +1,35 @@
+"""Tier-1 wiring for benchmarks/bench_dispatch.py (--smoke shape):
+the admission-plane flood path — transport upcall → admission workers
+(peek/parse/coalesced verify) → dispatcher verdict consumption — gets a
+collection-time guard (the bench module must import) and a runtime
+guard (both admission and the legacy inline mode must fully drain a
+retransmit-storm flood, with the plane demonstrably shedding repeats
+before the dispatcher). Runs under TPUBFT_THREADCHECK=1 so the
+admission-worker ⇄ dispatcher lock orders ride the global checker."""
+import pytest
+
+
+@pytest.fixture
+def threadcheck(monkeypatch):
+    monkeypatch.setenv("TPUBFT_THREADCHECK", "1")
+    from tpubft.utils import racecheck
+    assert racecheck.enabled()
+    yield
+
+
+def test_bench_dispatch_smoke(threadcheck):
+    from tpubft.utils.racecheck import get_watchdog
+    before = get_watchdog().stall_reports
+    from benchmarks.bench_dispatch import smoke
+    out = smoke()
+    assert out["ok"], out
+    assert out["admission_drained"] and out["inline_drained"], out
+    # the structural point of the plane: the storm's repeats were shed
+    # before the dispatcher (header-peek/dup-collapse), and the verify
+    # plane coalesced the remainder
+    assert out["shed"], out
+    assert out["adm"]["adm_verify_fail"] == 0, out
+    assert out["adm"]["adm_admitted"] > 0, out
+    # no dispatcher/admission stall during the run (lock-order
+    # inversions raise inside the run itself)
+    assert get_watchdog().stall_reports == before, out

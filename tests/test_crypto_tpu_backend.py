@@ -146,9 +146,14 @@ def test_ordering_continues_while_batch_in_flight():
     PrePrepare's client-sig batch is stuck on a worker, later seqnums
     keep ordering and committing on that replica (VERDICT r2 item #1's
     'done' criterion). Backend-independent — the plane is the same for
-    cpu and tpu."""
+    cpu and tpu. Runs with admission_workers=0: this targets the LEGACY
+    per-seq async verify path (collector-pool _bg_verify_pp), which
+    admitted traffic no longer takes — the admission plane's
+    non-serialization equivalent lives in
+    test_admission_plane.test_stuck_admission_drain_does_not_serialize_seqnums."""
     import threading
-    with InProcessCluster(f=1) as cluster:
+    with InProcessCluster(f=1, cfg_overrides={"admission_workers": 0}) \
+            as cluster:
         backup = cluster.replicas[1]          # never the collector (primary)
         gate = threading.Event()
         blocked = threading.Event()

@@ -27,14 +27,17 @@ SEED = "proc-test-seed"
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn(replica_id: int, base_port: int, db_dir: str) -> subprocess.Popen:
+def _spawn(replica_id: int, base_port: int, db_dir: str,
+           overrides=()) -> subprocess.Popen:
     env = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "tpubft.apps.skvbc_replica",
+           "--replica", str(replica_id), "--f", str(F),
+           "--clients", str(CLIENTS), "--base-port", str(base_port),
+           "--db-dir", db_dir, "--seed", SEED]
+    for ov in overrides:
+        cmd += ["--config-override", ov]
     return subprocess.Popen(
-        [sys.executable, "-m", "tpubft.apps.skvbc_replica",
-         "--replica", str(replica_id), "--f", str(F),
-         "--clients", str(CLIENTS), "--base-port", str(base_port),
-         "--db-dir", db_dir, "--seed", SEED],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
 def _client(base_port: int, idx: int = 0) -> SkvbcClient:
@@ -90,3 +93,46 @@ def test_four_process_cluster_write_read_restart(tmp_path):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.mark.slow
+def test_admission_on_off_state_equivalence_processes(tmp_path):
+    """The process-scenario state-equivalence gate for the admission
+    plane: the SAME workload ordered by a real 4-process cluster with
+    admission ON (default) and with `admission_workers=0` (legacy
+    inline path) must produce the SAME state-machine result — every
+    written key readable with identical values, on both clusters."""
+    writes = [(b"eq-k%d" % i, b"v%d" % (i * 7)) for i in range(12)]
+    results = {}
+    for label, overrides in (("on", ()),
+                             ("off", ("admission_workers=0",))):
+        base_port = random.randint(20000, 40000)
+        db_dir = tmp_path / label
+        db_dir.mkdir()
+        procs = {r: _spawn(r, base_port, str(db_dir), overrides)
+                 for r in range(N)}
+        try:
+            time.sleep(3.0)
+            kv = _client(base_port)
+            deadline = time.monotonic() + 30
+            first = None
+            while time.monotonic() < deadline:
+                try:
+                    first = kv.write([writes[0]], timeout_ms=4000)
+                    break
+                except Exception:
+                    time.sleep(0.5)
+            assert first is not None and first.success, label
+            for kvpair in writes[1:]:
+                assert kv.write([kvpair], timeout_ms=8000).success, label
+            results[label] = kv.read([k for k, _ in writes])
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs.values():
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    assert results["on"] == results["off"] == dict(writes)

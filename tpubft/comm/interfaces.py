@@ -32,6 +32,15 @@ class IReceiver(abc.ABC):
     @abc.abstractmethod
     def on_new_message(self, sender: NodeNum, data: bytes) -> None: ...
 
+    def on_new_messages(self, msgs: "Iterable[Tuple[NodeNum, bytes]]") \
+            -> None:
+        """Burst upcall: a batch-receiving transport (udp recvmmsg)
+        hands one drain's worth of datagrams in a single call, so a
+        receiver with its own admission queue can enqueue the burst
+        without per-message overhead. Default: per-message delivery."""
+        for sender, data in msgs:
+            self.on_new_message(sender, data)
+
     def on_connection_status_changed(self, node: NodeNum,
                                      status: ConnectionStatus) -> None:
         pass

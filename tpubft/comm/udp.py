@@ -28,6 +28,10 @@ def _load_netio():
         lib.net_sendmmsg.restype = ctypes.c_int
         lib.net_sendmmsg.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                      ctypes.c_uint32, ctypes.c_int]
+        lib.net_recvmmsg.restype = ctypes.c_int
+        lib.net_recvmmsg.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_uint32, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_uint32)]
         return lib
     except Exception:  # noqa: BLE001 — transport must work without g++
         return None
@@ -148,8 +152,19 @@ class PlainUdpCommunication(ICommunication):
         return (ConnectionStatus.CONNECTED if node in self._cfg.endpoints
                 else ConnectionStatus.UNKNOWN)
 
+    # datagrams drained per recvmmsg call (mirrors netio.cpp kMaxBatch)
+    RECV_BATCH = 64
+
     def _recv_loop(self) -> None:
         assert self._sock is not None
+        if self._netio is not None:
+            self._recv_loop_batched()
+        else:
+            # fallback path when _netio.so is unavailable (no g++ on the
+            # host): one recvfrom syscall per datagram, as the reference
+            self._recv_loop_scalar()
+
+    def _recv_loop_scalar(self) -> None:
         while self._running:
             try:
                 pkt, _ = self._sock.recvfrom(self._cfg.max_message_size + _HDR)
@@ -157,10 +172,68 @@ class PlainUdpCommunication(ICommunication):
                 continue
             except OSError:
                 return
-            if len(pkt) < _HDR:
+            msg = self._accept(pkt)
+            if msg is not None and self._receiver is not None:
+                self._receiver.on_new_message(*msg)
+
+    def _recv_loop_batched(self) -> None:
+        """recvmmsg plane: ONE syscall drains a whole burst, and the
+        receiver gets it as one on_new_messages upcall (the admission
+        pipeline enqueues the burst in one go). Readiness via
+        selectors (epoll on Linux) — select(2) would silently fail for
+        fds >= FD_SETSIZE on a process with many open files."""
+        import selectors
+        slot = self._cfg.max_message_size + _HDR
+        buf = ctypes.create_string_buffer(slot * self.RECV_BATCH)
+        lens = (ctypes.c_uint32 * self.RECV_BATCH)()
+        sock0 = self._sock
+        sel = selectors.DefaultSelector()
+        try:
+            sel.register(sock0, selectors.EVENT_READ)
+        except (OSError, ValueError):
+            sel.close()
+            return
+        try:
+            self._recv_loop_batched_body(sel, buf, lens, slot)
+        finally:
+            sel.close()
+
+    def _recv_loop_batched_body(self, sel, buf, lens, slot) -> None:
+        while self._running:
+            sock = self._sock
+            if sock is None:
+                return
+            try:
+                ready = sel.select(0.2)
+            except (OSError, ValueError):
+                if self._running:
+                    from tpubft.utils.logging import get_logger
+                    get_logger("udp").exception(
+                        "receive poll failed; receive thread exiting")
+                return
+            if not ready:
                 continue
-            sender = int.from_bytes(pkt[:_HDR], "little")
-            if sender not in self._cfg.endpoints or sender == self._cfg.self_id:
-                continue  # unknown/spoofed sender id: drop
-            if self._receiver is not None:
-                self._receiver.on_new_message(sender, pkt[_HDR:])
+            try:
+                n = self._netio.net_recvmmsg(sock.fileno(), buf, slot,
+                                             self.RECV_BATCH, lens)
+            except Exception:  # noqa: BLE001 — treat like a socket error
+                n = -1
+            if n < 0:
+                return
+            burst = []
+            for i in range(n):
+                ln = min(lens[i], slot)
+                msg = self._accept(buf[i * slot:i * slot + ln])
+                if msg is not None:
+                    burst.append(msg)
+            if burst and self._receiver is not None:
+                self._receiver.on_new_messages(burst)
+
+    def _accept(self, pkt: bytes):
+        """Shared per-datagram shape check: (sender, payload) or None."""
+        if len(pkt) < _HDR:
+            return None
+        sender = int.from_bytes(pkt[:_HDR], "little")
+        if sender not in self._cfg.endpoints or sender == self._cfg.self_id:
+            return None  # unknown/spoofed sender id: drop
+        return sender, pkt[_HDR:]

@@ -51,8 +51,14 @@ class IncomingMsgsStorage:
         self._once_mu = threading.Lock()
 
     def push_external(self, sender: int, raw: bytes) -> bool:
+        return self.push_external_obj(ExternalMsg(sender, raw))
+
+    def push_external_obj(self, obj) -> bool:
+        """Bounded external-queue entry shared by the raw path and the
+        admission plane (already-parsed, already-verified AdmittedMsgs
+        ride the same queue and the same drop accounting)."""
         try:
-            self._external.put_nowait(ExternalMsg(sender, raw))
+            self._external.put_nowait(obj)
             return True
         except queue.Full:
             self._dropped_external += 1
@@ -103,6 +109,7 @@ class Dispatcher:
                  thread_mdc: Optional[Dict[str, Any]] = None):
         self._storage = storage
         self._external_handler: Optional[Callable[[int, bytes], None]] = None
+        self._admitted_handler: Optional[Callable[[Any], None]] = None
         self._internal_handlers: Dict[str, Callable[[Any], None]] = {}
         self._timers = []  # (period_s, callback, next_due)
         self._running = False
@@ -114,12 +121,21 @@ class Dispatcher:
         # runs at the end of every loop iteration (message + due timers):
         # the transport's batched-send flush point
         self._post_hook: Optional[Callable[[], None]] = None
+        # external-path items handled (raw + admitted), read by benches
+        # and tests as a drain marker — dispatcher-thread writes only
+        self.handled_external = 0
 
     def set_post_hook(self, fn: Callable[[], None]) -> None:
         self._post_hook = fn
 
     def set_external_handler(self, fn: Callable[[int, bytes], None]) -> None:
         self._external_handler = fn
+
+    def set_admitted_handler(self, fn: Callable[[Any], None]) -> None:
+        """Handler for AdmittedMsg objects (pre-parsed, pre-verified by
+        the admission plane); anything on the external queue that is not
+        a raw ExternalMsg routes here."""
+        self._admitted_handler = fn
 
     def register_internal(self, kind: str, fn: Callable[[Any], None]) -> None:
         self._internal_handlers[kind] = fn
@@ -176,12 +192,20 @@ class Dispatcher:
             if item is not None:
                 try:
                     if isinstance(item, ExternalMsg):
+                        self.handled_external += 1
                         if self._external_handler is not None:
                             self._external_handler(item.sender, item.raw)
-                    else:
+                    elif isinstance(item, InternalMsg):
                         fn = self._internal_handlers.get(item.kind)
                         if fn is not None:
                             fn(item.payload)
+                    else:
+                        # AdmittedMsg from the admission plane: already
+                        # parsed + verified, the handler only mutates
+                        # protocol state
+                        self.handled_external += 1
+                        if self._admitted_handler is not None:
+                            self._admitted_handler(item)
                 except Exception:  # noqa: BLE001 — a bad msg must not kill
                     log.exception("handler raised (msg dropped)")
             now = time.monotonic()

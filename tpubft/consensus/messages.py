@@ -254,12 +254,21 @@ class PrePrepareMsg(ConsensusMsg):
             raise MsgError("requests digest mismatch")
 
     def client_requests(self) -> List[ClientRequestMsg]:
+        # memoized: the batch is parsed once (by the admission plane when
+        # it is on, by the first handler otherwise) and every later
+        # consumer — structural checks, barrier classification, execution
+        # — reuses the same objects. Safe because `requests` is never
+        # mutated after construction/decode.
+        cached = getattr(self, "_reqs_cache", None)
+        if cached is not None:
+            return cached
         out = []
         for raw in self.requests:
             m = unpack(raw)
             if not isinstance(m, ClientRequestMsg):
                 raise MsgError("non-request in PrePrepare batch")
             out.append(m)
+        self._reqs_cache = out
         return out
 
 
@@ -698,3 +707,62 @@ class RestartProofMsg(ConsensusMsg):
     signatures: List[ReplicaDigest]
     SPEC = [("sender_id", "u32"), ("seq_num", "u64"),
             ("signatures", ("list", ("msg", ReplicaDigest)))]
+
+
+# Messages carrying their own end-to-end signature (replica sig or
+# threshold combined sig, verified in their handlers): relay-safe — the
+# transport sender may legitimately differ from sender_id (gap-resend +
+# ReqMissingData flows forward them on the original's behalf). Shared by
+# the dispatcher's anti-spoofing gate and the admission plane's
+# stateless pre-drop, so the two can never disagree.
+RELAY_SAFE = (PrePrepareMsg, PrepareFullMsg, CommitFullMsg,
+              FullCommitProofMsg, ViewChangeMsg, NewViewMsg, CheckpointMsg)
+
+
+def known_code(code: int) -> bool:
+    """True iff `code` is a registered wire discriminant (the admission
+    plane's cheapest pre-parse drop for garbage datagrams)."""
+    return code in _REGISTRY
+
+
+def client_request_admissible(req: ClientRequestMsg, info) -> bool:
+    """Topology-static flag gates for a wire client request: the
+    INTERNAL flag and internal-client principals must correspond
+    (external clients can't smuggle internal ops and vice versa),
+    ordered (non-READ_ONLY) RECONFIG commands only from the operator,
+    and HAS_PRE_PROCESSED may only be minted by the preprocessor (it
+    enters via _admit_request, never from the wire). Shared by the
+    dispatcher's client-request handler and the admission plane's
+    pre-verify drop so the two can never disagree — an admission-side
+    drop is final, so drift between copies would silently lose
+    messages only when admission is on."""
+    if bool(req.flags & RequestFlag.INTERNAL) \
+            != info.is_internal_client(req.sender_id):
+        return False
+    if req.flags & RequestFlag.RECONFIG \
+            and not req.flags & RequestFlag.READ_ONLY \
+            and req.sender_id != info.operator_id:
+        return False
+    if req.flags & RequestFlag.HAS_PRE_PROCESSED:
+        return False
+    return True
+
+
+def parse_batch_elements(batch: ClientBatchRequestMsg):
+    """Structural element checks for a client batch (reference
+    ClientBatchRequestMsg::checkElements): every element must decode to
+    a ClientRequestMsg from the SAME principal; a malformed element
+    rejects the whole batch. Returns the parsed elements, or None.
+    Shared by the admission plane and the dispatcher's legacy inline
+    path so the two can never disagree about batch structure."""
+    inners = []
+    for raw in batch.requests:
+        try:
+            inner = unpack(raw)
+        except MsgError:
+            return None
+        if not isinstance(inner, ClientRequestMsg) \
+                or inner.sender_id != batch.sender_id:
+            return None
+        inners.append(inner)
+    return inners

@@ -276,6 +276,27 @@ class Replica(IReceiver):
                 flush_us=cfg.verify_batch_flush_us)
             self.dispatcher.register_internal("req_verified",
                                               self._on_req_verified)
+        # admission plane (transport → dispatcher): workers parse and
+        # verify every external message off the dispatcher, coalescing
+        # the drain's signatures into one verify_batch; the dispatcher
+        # receives AdmittedMsg objects and its handlers consult the
+        # attached verdict instead of re-verifying (admission.py docs).
+        # 0 workers = legacy inline path (raw bytes to the dispatcher).
+        self.admission = None
+        if cfg.admission_workers > 0:
+            from tpubft.consensus.admission import AdmissionPipeline
+            self.admission = AdmissionPipeline(
+                sig=self.sig, info=self.info,
+                sink=self.incoming.push_external_obj,
+                epoch_fn=lambda: self.epoch_mgr.self_epoch,
+                view_fn=lambda: self.view,
+                stable_fn=lambda: self.last_stable,
+                workers=cfg.admission_workers,
+                drain_max=cfg.admission_drain_max,
+                aggregator=self.aggregator,
+                name=f"admission-{self.id}",
+                ckpt_window=cfg.checkpoint_window_size)
+            self.dispatcher.set_admitted_handler(self._on_admitted)
 
         # retransmissions (reference RetransmissionsManager +
         # sendRetransmittableMsgToReplica, ReplicaImp.cpp:2531)
@@ -321,6 +342,11 @@ class Replica(IReceiver):
         self.m_exec_runs = self.metrics.register_counter("exec_runs")
         self.m_exec_run_slots = self.metrics.register_counter(
             "exec_run_slots")
+        # external-queue backpressure drops (IncomingMsgsStorage bound),
+        # refreshed by the status timer — paired with the admission
+        # component's counters for the full ingest picture
+        self.m_dropped_external = self.metrics.register_gauge(
+            "dropped_external")
         # a recovered replica must REPORT its recovered position — these
         # gauges otherwise read 0 until the next execution, making an
         # idle-after-restart replica look like it lost its state
@@ -561,6 +587,8 @@ class Replica(IReceiver):
                                           lambda _: self._repropose())
         if self.exec_lane is not None:
             self.exec_lane.start()
+        if self.admission is not None:
+            self.admission.start()
         self.dispatcher.start()
         with mdc_scope(r=self.id):       # start() runs on the caller thread
             log.info("replica up: n=%d f=%d c=%d view=%d primary=%d "
@@ -580,6 +608,8 @@ class Replica(IReceiver):
             # no drain: pending slots are committed state that recovery
             # replays — stop is crash-equivalent for the lane
             self.exec_lane.stop()
+        if self.admission is not None:
+            self.admission.stop()
         self.dispatcher.stop()
         self.collector_pool.shutdown()
         self.cert_batcher.stop()
@@ -598,15 +628,29 @@ class Replica(IReceiver):
         return self.info.primary_of_view(self.view)
 
     # ------------------------------------------------------------------
-    # transport upcall (any thread) → queue
+    # transport upcall (any thread) → admission plane or queue
     # ------------------------------------------------------------------
     def on_new_message(self, sender: int, data: bytes) -> None:
-        self.incoming.push_external(sender, data)
+        if self.admission is not None:
+            self.admission.submit(sender, data)
+        else:
+            self.incoming.push_external(sender, data)
+
+    def on_new_messages(self, msgs) -> None:
+        """Burst upcall from batch-receiving transports (udp recvmmsg):
+        the whole drain enters the admission queue in one call."""
+        if self.admission is not None:
+            self.admission.submit_burst(msgs)
+        else:
+            for sender, data in msgs:
+                self.incoming.push_external(sender, data)
 
     # ------------------------------------------------------------------
     # dispatch (dispatcher thread)
     # ------------------------------------------------------------------
     def _on_external(self, sender: int, raw: bytes) -> None:
+        """Legacy/inline path (admission_workers=0, and direct
+        push_external callers): parse on the dispatcher, then dispatch."""
         try:
             msg = m.unpack(raw)
         except m.MsgError:
@@ -619,6 +663,14 @@ class Replica(IReceiver):
         with mdc_scope(v=self.view,
                        s=getattr(msg, "seq_num", None) or "-"):
             self._dispatch_external(sender, msg)
+
+    def _on_admitted(self, adm) -> None:
+        """Admission-plane path: the message arrives parsed with its
+        signature verdict attached — the dispatcher only runs the
+        stateful gates and mutates protocol state."""
+        with mdc_scope(v=self.view,
+                       s=getattr(adm.msg, "seq_num", None) or "-"):
+            self._dispatch_external(adm.sender, adm.msg)
 
     @property
     def epoch(self) -> int:
@@ -667,16 +719,14 @@ class Replica(IReceiver):
             # must not grow _batch_relayed or mint amplified relays
             if not self.clients.is_valid_client(msg.sender_id):
                 return
-            inners = []
-            for raw in msg.requests:
-                try:
-                    inner = m.unpack(raw)
-                except m.MsgError:
+            # admission attaches the surviving parsed elements (forged
+            # elements already dropped, each survivor pre-verified); the
+            # legacy path parses them here via the helper
+            inners = getattr(msg, "_adm_inners", None)
+            if inners is None:
+                inners = self._parse_batch_inners(msg)
+                if inners is None:
                     return          # malformed element: drop whole batch
-                if not isinstance(inner, m.ClientRequestMsg) \
-                        or inner.sender_id != msg.sender_id:
-                    return          # element from a different principal
-                inners.append(inner)
             # backup: relay the BATCH as one wire message (exploding it
             # into per-element forwards would defeat the transport
             # amortization); elements below run with relay suppressed
@@ -719,10 +769,9 @@ class Replica(IReceiver):
         # (replica sig or threshold combined sig, verified in their
         # handlers): those are relay-safe, and the gap-resend +
         # ReqMissingData flows forward them on the original's behalf.
-        relay_ok = (m.PrePrepareMsg, m.PrepareFullMsg, m.CommitFullMsg,
-                    m.FullCommitProofMsg, m.ViewChangeMsg, m.NewViewMsg,
-                    m.CheckpointMsg)
-        if not isinstance(msg, relay_ok) \
+        # (m.RELAY_SAFE is shared with the admission plane's pre-drop,
+        # so the two gates can never disagree.)
+        if not isinstance(msg, m.RELAY_SAFE) \
                 and getattr(msg, "sender_id", sender) != sender:
             return                              # sender spoofing: drop
         # view-change & checkpoint msgs flow even mid-view-change; normal
@@ -847,22 +896,14 @@ class Replica(IReceiver):
         client = req.sender_id
         if not self.clients.is_valid_client(client):
             return
-        # INTERNAL flag and internal-client principals must correspond —
-        # external clients can't smuggle internal ops and vice versa
-        if bool(req.flags & m.RequestFlag.INTERNAL) \
-                != self.info.is_internal_client(client):
-            return
-        # RECONFIG: ordered (mutating) commands only from the operator;
-        # the read-only path is open to any valid client (status polling —
-        # the dispatcher enforces per-command authorization)
-        if req.flags & m.RequestFlag.RECONFIG \
-                and not req.flags & m.RequestFlag.READ_ONLY \
-                and client != self.info.operator_id:
-            return
-        # HAS_PRE_PROCESSED may only be minted by the preprocessor (it
-        # enters via _admit_request); a client-signed one would poison
-        # every batch it lands in (backups reject the whole PrePrepare)
-        if req.flags & m.RequestFlag.HAS_PRE_PROCESSED:
+        # flag/topology gates — the ONE predicate shared with the
+        # admission plane's pre-verify drop (an admission-side drop is
+        # final, so the two must never disagree): INTERNAL/principal
+        # correspondence, ordered RECONFIG from the operator only
+        # (read-only RECONFIG is open to any valid client — per-command
+        # authorization happens at execution), no wire-minted
+        # HAS_PRE_PROCESSED
+        if not m.client_request_admissible(req, self.info):
             return
         if not req.flags & m.RequestFlag.READ_ONLY:
             if not self.is_primary or self.in_view_change:
@@ -891,6 +932,12 @@ class Replica(IReceiver):
                     if cached is not None:
                         self.comm.send(client, cached.pack())
                     return
+        if getattr(req, "_adm_verified", None) is True:
+            # admission plane already verified the client signature in a
+            # coalesced per-drain batch (failed verdicts never reach the
+            # dispatcher) — go straight to the stateful tail
+            self._post_admission(req)
+            return
         if self.req_batcher is not None:
             # async plane: the signature check leaves the dispatcher and
             # verifies in a cross-request batch; the verdict re-enters as
@@ -905,7 +952,7 @@ class Replica(IReceiver):
                 lambda ok, _req=req: self.incoming.push_internal(
                     "req_verified", (_req, ok)))
             return
-        if not self.sig.verify(client, req.signed_payload(), req.signature):
+        if not self._verify_client_sig(req):
             return
         self._post_admission(req)
 
@@ -1065,8 +1112,19 @@ class Replica(IReceiver):
             # a duplicate arriving during the async-verify window must not
             # repay the inline sig check + request validation below
             return
-        if not self.sig.verify(pp.sender_id, pp.signed_payload(), pp.signature,
-                               seq=pp.seq_num):
+        # admission verdict: True = the replica signature AND every
+        # embedded client signature verified in the plane's coalesced
+        # batch; False = that batch FAILED (the message was admitted
+        # only so _try_resolve_body could consume a digest-authenticated
+        # old-view body — as a live proposal it dies here); None =
+        # legacy path, verify inline/async below
+        adm_ok = getattr(pp, "_adm_verified", None)
+        if adm_ok is False:
+            log.warning("PrePrepare rejected by admission signature "
+                        "batch (sender=%d)", pp.sender_id)
+            return
+        if adm_ok is None and not self._verify_replica_msg(
+                pp, seq=pp.seq_num):
             log.warning("PrePrepare replica-signature check failed "
                         "(sender=%d)", pp.sender_id)
             return
@@ -1107,19 +1165,43 @@ class Replica(IReceiver):
             return
         # pre-executed wrappers carry their own proof set (original client
         # sig + f+1 replica result sigs) instead of a wrapper signature
-        items = [(r.sender_id, r.signed_payload(), r.signature)
-                 for r in reqs
-                 if not r.flags & m.RequestFlag.HAS_PRE_PROCESSED]
-        if items and self.cfg.async_verification:
-            info.pp_verifying = pp              # guarded at entry above
-            self.collector_pool.submit(lambda: self._bg_verify_pp(pp, items))
-            return
-        if items:
-            from tpubft.diagnostics import TimeRecorder
-            with TimeRecorder(self._h_verify):
-                if not all(self.sig.verify_batch(items, seq=pp.seq_num)):
-                    return
+        if adm_ok is None:
+            items = [(r.sender_id, r.signed_payload(), r.signature)
+                     for r in reqs
+                     if not r.flags & m.RequestFlag.HAS_PRE_PROCESSED]
+            if items and self.cfg.async_verification:
+                info.pp_verifying = pp          # guarded at entry above
+                self.collector_pool.submit(
+                    lambda: self._bg_verify_pp(pp, items))
+                return
+            if items and not self._verify_req_items(items, pp.seq_num):
+                return
         self._accept_pre_prepare(pp)
+
+    # ---- inline verification fallbacks (admission-off path) ----
+    # Kept OUT of the hot-path handlers on purpose: tools/check_hotpath.py
+    # forbids direct unpack/verify call sites inside the dispatcher's
+    # admitted-message handlers, so any new inline crypto must route
+    # through these seams (and stay skippable when a verdict is attached).
+    def _verify_replica_msg(self, msg, seq=None, view_scoped=False) -> bool:
+        """One replica-signed message, on the dispatcher (legacy path)."""
+        return self.sig.verify(msg.sender_id, msg.signed_payload(),
+                               msg.signature, seq=seq,
+                               view_scoped=view_scoped)
+
+    def _verify_client_sig(self, req: m.ClientRequestMsg) -> bool:
+        return self.sig.verify(req.sender_id, req.signed_payload(),
+                               req.signature)
+
+    def _verify_req_items(self, items, seq: int) -> bool:
+        """Inline embedded-request batch check (async_verification off)."""
+        with TimeRecorder(self._h_verify):
+            return all(self.sig.verify_batch(items, seq=seq))
+
+    def _parse_batch_inners(self, msg: m.ClientBatchRequestMsg):
+        """Legacy-path ClientBatch element parse (admission attaches
+        pre-parsed survivors as `_adm_inners`); None = malformed batch."""
+        return m.parse_batch_elements(msg)
 
     def _bg_verify_pp(self, pp: m.PrePrepareMsg, items) -> None:
         """Worker-thread body: one verify_batch call (one device dispatch
@@ -1379,7 +1461,7 @@ class Replica(IReceiver):
             return
         verifier, d = tools
         if not self.cfg.async_verification:
-            if verifier.verify(d, msg.sig):
+            if self._verify_cert_inline(verifier, d, msg.sig):
                 self._accept_cert(msg, kind)
             return
         if kind in info.cert_verifying:
@@ -1397,16 +1479,23 @@ class Replica(IReceiver):
             # batch across seqnums/kinds
             self.cert_batcher.submit(verifier, d, msg.sig, (msg, kind))
             return
+        self.collector_pool.submit(
+            lambda: self._bg_verify_cert(verifier, d, msg, kind))
 
-        def job():
-            try:
-                ok = verifier.verify(d, msg.sig)
-            except Exception:  # noqa: BLE001
-                log.exception("cert verify job raised (kind=%s seq=%d)",
-                              kind, msg.seq_num)
-                ok = False
-            self.incoming.push_internal("cert_verified", (msg, kind, ok))
-        self.collector_pool.submit(job)
+    def _bg_verify_cert(self, verifier, d: bytes, msg, kind: str) -> None:
+        """Worker-thread combined-cert check; verdict re-enters the
+        dispatcher as "cert_verified"."""
+        try:
+            ok = verifier.verify(d, msg.sig)
+        except Exception:  # noqa: BLE001
+            log.exception("cert verify job raised (kind=%s seq=%d)",
+                          kind, msg.seq_num)
+            ok = False
+        self.incoming.push_internal("cert_verified", (msg, kind, ok))
+
+    def _verify_cert_inline(self, verifier, d: bytes, sig: bytes) -> bool:
+        """Inline combined-cert check (async_verification=False debug)."""
+        return verifier.verify(d, sig)
 
     def _on_cert_verified(self, payload) -> None:
         """Async combined-cert verdict (dispatcher thread)."""
@@ -1842,6 +1931,9 @@ class Replica(IReceiver):
     def _send_status(self) -> None:
         if not self._running:
             return
+        self.m_dropped_external.set(self.incoming.dropped_external)
+        if self.admission is not None:
+            self.admission.adm_queue_depth.set(self.admission.depth)
         status = m.ReplicaStatusMsg(
             sender_id=self.id, view=self.view,
             last_stable_seq=self.last_stable,
@@ -1996,8 +2088,8 @@ class Replica(IReceiver):
             votes = self._restart_votes[msg.seq_num] = set()
         if msg.sender_id in votes:
             return
-        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature, seq=msg.seq_num):
+        if getattr(msg, "_adm_verified", None) is None \
+                and not self._verify_replica_msg(msg, seq=msg.seq_num):
             return
         votes.add(msg.sender_id)
         # super-stable n/n proof (the reference's AddRemoveWithWedge
@@ -2070,8 +2162,8 @@ class Replica(IReceiver):
                 or not self.info.is_replica(msg.sender_id) \
                 or msg.sender_id == self.id:
             return
-        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature):
+        if getattr(msg, "_adm_verified", None) is None \
+                and not self._verify_replica_msg(msg):
             return
         self.time_service.add_opinion(msg.sender_id, msg.t_ms)
 
@@ -2091,8 +2183,8 @@ class Replica(IReceiver):
         # far-future checkpoints (its state-transfer trigger)
         if ck.seq_num < self._ck_latest_seq.get(ck.sender_id, 0):
             return
-        if not self.sig.verify(ck.sender_id, ck.signed_payload(),
-                               ck.signature, seq=ck.seq_num):
+        if getattr(ck, "_adm_verified", None) is None \
+                and not self._verify_replica_msg(ck, seq=ck.seq_num):
             return
         self._store_checkpoint(ck)
 
@@ -2279,8 +2371,8 @@ class Replica(IReceiver):
     def _on_ask_to_leave_view(self, msg: m.ReplicaAsksToLeaveViewMsg) -> None:
         if not self.info.is_replica(msg.sender_id) or msg.view < self.view:
             return
-        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature, view_scoped=True):
+        if getattr(msg, "_adm_verified", None) is None \
+                and not self._verify_replica_msg(msg, view_scoped=True):
             return
         self.vc.add_complaint(msg)
         # adopt: quorum-minus-me complaints for a view I'm stuck in too
@@ -2349,8 +2441,8 @@ class Replica(IReceiver):
         if not self.info.is_replica(msg.sender_id) \
                 or msg.new_view <= self.view:
             return
-        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature, view_scoped=True):
+        if getattr(msg, "_adm_verified", None) is None \
+                and not self._verify_replica_msg(msg, view_scoped=True):
             return
         self.vc.add_view_change(msg)
         # f+1 replicas already moving to a higher view ⇒ join them
@@ -2488,8 +2580,8 @@ class Replica(IReceiver):
             return
         if msg.sender_id != self.info.primary_of_view(msg.new_view):
             return
-        if not self.sig.verify(msg.sender_id, msg.signed_payload(),
-                               msg.signature, view_scoped=True):
+        if getattr(msg, "_adm_verified", None) is None \
+                and not self._verify_replica_msg(msg, view_scoped=True):
             return
         self.vc.pending_new_view = msg
         self._try_complete_view_change(msg.new_view)

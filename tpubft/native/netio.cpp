@@ -1,5 +1,6 @@
-// Batched UDP transmit — one sendmmsg(2) syscall for a whole dispatcher
-// iteration's outbound datagrams.
+// Batched UDP transmit/receive — one sendmmsg(2)/recvmmsg(2) syscall
+// for a whole dispatcher iteration's outbound datagrams or a whole
+// inbound burst.
 //
 // Role in the rebuild: the reference's PlainUDPCommunication
 // (/root/reference/communication/src/PlainUDPCommunication.cpp:340) pays
@@ -20,6 +21,7 @@
 // Returns datagrams handed to the kernel (best-effort, like UDP), or -1
 // on a malformed buffer.
 #include <arpa/inet.h>
+#include <cerrno>
 #include <cstdint>
 #include <cstring>
 #include <netinet/in.h>
@@ -67,6 +69,42 @@ int net_sendmmsg(int fd, const uint8_t* buf, uint32_t buflen, int n) {
     n -= batch;
   }
   return sent_total;
+}
+
+// Batched receive: drain every immediately-available datagram in ONE
+// kernel entry (the admission plane's ingest side, mirroring the
+// sendmmsg plane above; reference role: PlainUDPCommunication's
+// per-recvfrom receive thread, one syscall per datagram).
+//
+// The caller selects()/polls for readability first, then calls this
+// with MSG_DONTWAIT semantics: datagram i lands at buf + i*slot_len,
+// its length in lens[i]. A datagram longer than slot_len is truncated
+// by the kernel (callers size slots at max_message_size + header, so
+// an over-long datagram is invalid traffic anyway; MSG_TRUNC in
+// msg_flags is reflected as len = slot_len and dropped in Python by
+// the sender-prefix/shape checks). Returns datagrams received, 0 when
+// nothing was pending (EAGAIN), -1 on a real socket error.
+int net_recvmmsg(int fd, uint8_t* buf, uint32_t slot_len, int max_n,
+                 uint32_t* lens) {
+  if (max_n <= 0 || slot_len == 0) return 0;
+  constexpr int kMaxBatch = 64;
+  if (max_n > kMaxBatch) max_n = kMaxBatch;
+  mmsghdr hdrs[kMaxBatch];
+  iovec iovs[kMaxBatch];
+  for (int i = 0; i < max_n; i++) {
+    iovs[i].iov_base = buf + static_cast<size_t>(i) * slot_len;
+    iovs[i].iov_len = slot_len;
+    memset(&hdrs[i], 0, sizeof(mmsghdr));
+    hdrs[i].msg_hdr.msg_iov = &iovs[i];
+    hdrs[i].msg_hdr.msg_iovlen = 1;
+  }
+  const int r = recvmmsg(fd, hdrs, max_n, MSG_DONTWAIT, nullptr);
+  if (r < 0) {
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    return -1;
+  }
+  for (int i = 0; i < r; i++) lens[i] = hdrs[i].msg_len;
+  return r;
 }
 
 }  // extern "C"

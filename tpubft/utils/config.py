@@ -131,6 +131,20 @@ class ReplicaConfig:
     # CombinedSigVerificationJob); False = verify inline (debug only)
     async_verification: bool = True
 
+    # admission pipeline (transport → dispatcher): >0 = a pool of that
+    # many admission workers does all stateless per-message work off
+    # the dispatcher — header peek (dead-view/stale-seq/garbage drops
+    # before full unpack), parse, and signature verification coalesced
+    # into ONE SigManager.verify_batch per drain cycle (one device
+    # dispatch on the TPU backend); the dispatcher's external queue
+    # then carries pre-parsed, pre-verified messages and its handlers
+    # only mutate state. 0 = legacy inline path (raw bytes to the
+    # dispatcher, parse/verify in the handlers).
+    admission_workers: int = 1
+    # max messages one admission drain cycle pulls from the ingest
+    # queue (bounds verify-batch size and admission latency)
+    admission_drain_max: int = 256
+
     # execution pipelining (reference: post-execution separation +
     # block accumulation). True = committed slots are executed by a
     # dedicated in-order executor thread that accumulates runs of
@@ -201,6 +215,10 @@ class ReplicaConfig:
             raise ValueError("work window must be a multiple of checkpoint window")
         if self.execution_max_accumulation < 1:
             raise ValueError("execution_max_accumulation must be >= 1")
+        if self.admission_workers < 0:
+            raise ValueError("admission_workers must be >= 0")
+        if self.admission_drain_max < 1:
+            raise ValueError("admission_drain_max must be >= 1")
 
     # ---- serialization ----
     def to_json(self) -> str:
