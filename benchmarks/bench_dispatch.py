@@ -72,7 +72,7 @@ class NullComm(ICommunication):
         return ConnectionStatus.CONNECTED
 
 
-def _make_replica(workers: int):
+def _make_replica(workers: int, **cfg_overrides):
     """One backup replica (id 1 of n=4, view 0) with a null transport.
     The view-change timer is parked: a flood bench must not complain its
     way into a view change mid-measurement."""
@@ -80,7 +80,8 @@ def _make_replica(workers: int):
     cfg = ReplicaConfig(replica_id=1, f_val=F,
                         num_of_client_proxies=CLIENTS,
                         admission_workers=workers,
-                        view_change_timer_ms=3_600_000)
+                        view_change_timer_ms=3_600_000,
+                        **cfg_overrides)
     keys = ClusterKeys.generate(cfg, CLIENTS, seed=SEED)
     rep = Replica(cfg, keys.for_node(1), NullComm(), CounterHandler())
     rep.start()
@@ -215,6 +216,122 @@ def smoke() -> dict:
     }
 
 
+def device_fault(msgs: int = 360, warmup: int = 64,
+                 drain_max: int = 16) -> dict:
+    """Kill-the-device scenario (degradation plane): the replica runs
+    the REAL device verify ride (crypto_backend=tpu on whatever jax
+    backend this host has — the breaker's reaction is what's measured,
+    not kernel speed). Mid-flood the ed25519 kernel is replaced with a
+    raiser ("the accelerator transport died"); recorded:
+
+      * time-to-degraded  — kill → breaker OPEN (consensus ingest keeps
+        draining on the scalar engines throughout);
+      * time-to-restored  — kernel restored → breaker CLOSED via the
+        half-open probe batch, device path hot again.
+    """
+    import os
+
+    from tpubft.ops import ed25519 as ops_ed
+    from tpubft.ops.dispatch import device_breaker
+
+    # persistent compile cache: the windowed verify kernel is a large
+    # XLA program; repeat bench runs should not re-pay the compile
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", ".jax_cache")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          2.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+    b = device_breaker()
+    rep, keys, first_client = _make_replica(
+        1, crypto_backend="tpu", device_min_verify_batch=1,
+        admission_drain_max=drain_max,
+        breaker_failure_threshold=3, breaker_cooldown_ms=500)
+    # bound probe-failure escalation so time-to-restored reflects the
+    # configured cooldown, not however long the kill window lasted
+    b.configure(max_cooldown_s=1.0)
+    b.reset()
+    row = {"bench": "dispatch_device_fault", "msgs": msgs,
+           "warmup": warmup, "drain_max": drain_max}
+    real_kernel = ops_ed.verify_kernel
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device loss")
+
+    try:
+        base_seq = int(time.time() * 1e6)
+        flood = _signed_requests(keys, first_client, warmup, base_seq)
+        dt = _run_flood(rep, flood, warmup, timeout_s=600.0)
+        row["warmup_secs"] = round(dt, 3) if dt else None
+        row["device_path_proven"] = \
+            rep.sig.sigs_device_dispatched.value > 0
+        injected = warmup
+
+        # ---- kill the device mid-run ----
+        ops_ed.verify_kernel = boom
+        t_kill = time.perf_counter()
+        t_open = None
+        sent = 0
+        while sent < msgs:
+            chunk = _signed_requests(keys, first_client, drain_max,
+                                     base_seq + 10_000 + sent)
+            for cid, raw in chunk:
+                rep.on_new_message(cid, raw)
+            sent += len(chunk)
+            injected += len(chunk)
+            deadline = time.monotonic() + 30
+            while rep.admission.processed < injected \
+                    and time.monotonic() < deadline:
+                if t_open is None and b.state == "open":
+                    t_open = time.perf_counter()
+                time.sleep(0.001)
+            if t_open is None and b.state == "open":
+                t_open = time.perf_counter()
+        row["time_to_degraded_ms"] = (
+            round((t_open - t_kill) * 1e3, 1) if t_open else None)
+        # goodput continued: everything injected after the kill fully
+        # drained through the scalar engines
+        row["drained_while_degraded"] = \
+            rep.admission.processed >= injected
+        row["degraded_verifies"] = rep.sig.degraded_verifies.value
+        row["scalar_fallbacks"] = rep.sig.scalar_fallbacks.value
+
+        # ---- restore: half-open probe re-admits the device ----
+        ops_ed.verify_kernel = real_kernel
+        t_restore = time.perf_counter()
+        t_closed = None
+        deadline = time.monotonic() + 60
+        probe_seq = base_seq + 50_000
+        while time.monotonic() < deadline:
+            # distinct seqs each tick: a duplicate would memo-hit and
+            # never reach the device, starving the half-open probe
+            probe_seq += 10
+            chunk = _signed_requests(keys, first_client, 4, probe_seq)
+            for cid, raw in chunk:
+                rep.on_new_message(cid, raw)
+            injected += len(chunk)
+            time.sleep(0.05)
+            if b.state == "closed":
+                t_closed = time.perf_counter()
+                break
+        row["time_to_restored_ms"] = (
+            round((t_closed - t_restore) * 1e3, 1) if t_closed else None)
+        row["breaker"] = b.snapshot()
+        row["health"] = rep.health.verdict()["verdict"]
+        row["ok"] = bool(row["device_path_proven"] and t_open
+                         and t_closed and row["drained_while_degraded"])
+        return row
+    finally:
+        ops_ed.verify_kernel = real_kernel
+        rep.stop()
+        b.configure(failure_threshold=3, cooldown_s=2.0,
+                    max_cooldown_s=32.0)
+        b.reset()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--msgs", type=int, default=1200,
@@ -226,9 +343,15 @@ def main() -> None:
     ap.add_argument("--workers", type=int, default=1,
                     help="admission_workers for the ON mode")
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--device-fault", action="store_true",
+                    help="kill-the-device scenario: time-to-degraded / "
+                         "time-to-restored through the breaker")
     args = ap.parse_args()
     if args.smoke:
         print(json.dumps(smoke()), flush=True)
+        return
+    if args.device_fault:
+        print(json.dumps(device_fault()), flush=True)
         return
     run(args.msgs, args.distinct, args.samples, args.workers)
 

@@ -1,0 +1,70 @@
+"""Tier-1 wiring for the device-seam lint (tools/check_device_seam.py):
+no module under tpubft/ may reference the raw `device_dispatch` gate
+outside tpubft/ops/dispatch.py — kernel call sites go through the
+breaker-guarded `device_section(kind)` seam so a device failure always
+classifies (trip → scalar fallback → half-open probe) instead of
+bypassing the degradation plane."""
+import importlib.util
+import os
+import textwrap
+
+_TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_device_seam.py")
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_device_seam",
+                                                  os.path.abspath(_TOOL))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_no_naked_device_dispatch_call_sites():
+    tool = _load_tool()
+    violations = tool.find_violations(_ROOT)
+    assert violations == [], (
+        "naked device_dispatch references found (kernel calls must go "
+        "through the breaker-guarded device_section seam):\n"
+        + "\n".join(f"{p}:{ln}: {msg}" for p, ln, msg in violations))
+
+
+def test_lint_catches_violations(tmp_path):
+    """Import, bare call, and attribute call forms are all detected —
+    and the allowed module (ops/dispatch.py itself) is exempt."""
+    tool = _load_tool()
+    mod_dir = tmp_path / "tpubft" / "ops"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "rogue.py").write_text(textwrap.dedent("""\
+        from tpubft.ops.dispatch import device_dispatch
+
+        def kernel_call():
+            with device_dispatch():
+                pass
+
+        def other():
+            import tpubft.ops.dispatch as d
+            with d.device_dispatch():
+                pass
+    """))
+    # the gate's own module is exempt
+    (mod_dir / "dispatch.py").write_text(
+        "def device_dispatch():\n    return None\n")
+    violations = tool.find_violations(str(tmp_path))
+    files = {p for p, _, _ in violations}
+    assert files == {os.path.join("tpubft", "ops", "rogue.py")}, violations
+    msgs = " ".join(m for _, _, m in violations)
+    assert "imports" in msgs and "references" in msgs
+    # all three reference forms flagged (import line, two call sites,
+    # one attribute form)
+    assert len(violations) >= 3, violations
+
+
+def test_lint_fails_when_nothing_scanned(tmp_path):
+    """A wrong root (or a package rename) must fail loudly, not report
+    a vacuous OK over zero scanned modules."""
+    tool = _load_tool()
+    violations = tool.find_violations(str(tmp_path / "nonexistent"))
+    assert len(violations) == 1
+    assert "no Python modules" in violations[0][2]

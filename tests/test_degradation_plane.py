@@ -1,0 +1,681 @@
+"""Degradation plane: device circuit breaker, stall watchdog, overload
+backpressure, and adaptive client backoff.
+
+The fault matrix the ISSUE demands: a device engine that raises, hangs
+(latency-SLO breach), or returns garbage mid-batch trips the breaker;
+consensus stays LIVE on the scalar engines while the breaker is OPEN;
+the half-open probe re-admits a recovered device; and a forged
+signature is rejected in BOTH breaker states. Plus: watermark shedding
+never drops protocol-critical traffic while client requests shed (each
+shed in exactly one counter), the health watchdog's verdict/stall-dump
+machinery, and the client's decorrelated backoff + reply-aware
+retransmission."""
+import json
+import threading
+import time
+
+import pytest
+
+from tpubft.consensus import messages as m
+from tpubft.consensus.admission import AdmissionPipeline
+from tpubft.consensus.health import (DEGRADED, HEALTHY, STALLED,
+                                     HealthMonitor)
+from tpubft.consensus.keys import ClusterKeys
+from tpubft.consensus.replicas_info import ReplicasInfo
+from tpubft.consensus.sig_manager import SigManager
+from tpubft.ops.dispatch import device_breaker
+from tpubft.utils.breaker import (CLOSED, HALF_OPEN, OPEN, BreakerOpen,
+                                  CircuitBreaker)
+from tpubft.utils.config import ReplicaConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_device_breaker():
+    """The breaker registry is process-wide: every test starts with the
+    device breaker CLOSED at the default budget, and every breaker the
+    test registered (incl. unit throwaways) is re-closed afterwards so
+    global health verdicts stay clean for the rest of the suite."""
+    from tpubft.utils.breaker import all_breakers
+
+    def clean():
+        b = device_breaker()
+        b.configure(failure_threshold=3, cooldown_s=2.0,
+                    latency_slo_s=0.0, max_cooldown_s=32.0)
+        for brk in all_breakers().values():
+            brk.reset()
+
+    clean()
+    yield
+    clean()
+
+
+# ---------------------------------------------------------------------
+# circuit breaker unit semantics (fake clock — no sleeps)
+# ---------------------------------------------------------------------
+def _breaker(**kw):
+    clk = [0.0]
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    b = CircuitBreaker("unit-test", clock=lambda: clk[0], **kw)
+    return b, clk
+
+
+def _fail(b, exc=RuntimeError):
+    with pytest.raises(exc):
+        with b.attempt("k"):
+            raise exc("boom")
+
+
+def test_breaker_trips_after_consecutive_failures_and_fast_fails():
+    b, clk = _breaker()
+    with b.attempt("k"):
+        pass                                   # success resets nothing
+    _fail(b)
+    _fail(b)
+    with b.attempt("k"):
+        pass                                   # success RESETS the budget
+    _fail(b)
+    _fail(b)
+    assert b.state == CLOSED                   # 2 < threshold again
+    _fail(b)
+    assert b.state == OPEN
+    assert b.trips == 1
+    # OPEN: fast-fail without running the body
+    ran = []
+    with pytest.raises(BreakerOpen):
+        with b.attempt("k"):
+            ran.append(1)
+    assert ran == [] and b.fast_fails == 1
+
+
+def test_breaker_half_open_probe_restores_and_escalates():
+    b, clk = _breaker(failure_threshold=1, cooldown_s=10.0)
+    _fail(b)
+    assert b.state == OPEN
+    clk[0] += 10.1                             # cooldown elapsed
+    assert b.state == HALF_OPEN
+    # failed probe re-opens with DOUBLED cooldown
+    _fail(b)
+    assert b.state == OPEN and b.snapshot()["cooldown_s"] == 20.0
+    clk[0] += 10.1
+    assert b.state == OPEN                     # escalated: 10s not enough
+    clk[0] += 10.1
+    # successful probe closes and resets the cooldown to base
+    with b.attempt("k"):
+        pass
+    assert b.state == CLOSED and b.recoveries == 1
+    assert b.snapshot()["cooldown_s"] == 10.0
+
+
+def test_breaker_half_open_admits_one_probe_at_a_time():
+    b, clk = _breaker(failure_threshold=1, cooldown_s=1.0)
+    _fail(b)
+    clk[0] += 1.1
+    release = threading.Event()
+    entered = threading.Event()
+
+    def probe():
+        with b.attempt("k"):
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    # the probe slot is taken: concurrent attempts fast-fail
+    with pytest.raises(BreakerOpen):
+        with b.attempt("k"):
+            pass
+    release.set()
+    t.join(5)
+    assert b.state == CLOSED
+
+
+def test_breaker_slo_breach_counts_as_failure_but_returns_result():
+    clk = [0.0]
+    b = CircuitBreaker("unit-slo", failure_threshold=2, cooldown_s=5.0,
+                       latency_slo_s=0.5, clock=lambda: clk[0])
+    out = []
+    for _ in range(2):
+        with b.attempt("k"):
+            clk[0] += 1.0                      # "the device took 1s"
+            out.append("result")
+    assert out == ["result", "result"]         # results kept — device SLOW,
+    assert b.state == OPEN                     # not wrong — but breaker trips
+    assert b.slo_breaches == 2
+
+
+def test_breaker_nested_attempts_record_once():
+    b, clk = _breaker(failure_threshold=1)
+    with pytest.raises(RuntimeError):
+        with b.attempt("outer"):
+            with b.attempt("inner"):
+                raise RuntimeError("boom")
+    assert b.failures == 1
+    assert b.failures_by_kind == {"outer": 1}
+
+
+def test_breaker_stale_success_cannot_close_half_open():
+    """A success from an attempt admitted back when the breaker was
+    CLOSED (a dispatch that wedged across the whole failure burst and
+    finally returned) must NOT close a HALF_OPEN breaker — only the
+    probe's verdict re-admits the device."""
+    b, clk = _breaker(failure_threshold=1, cooldown_s=10.0)
+    release = threading.Event()
+    entered = threading.Event()
+
+    def stale():
+        with b.attempt("k"):                   # admitted while CLOSED
+            entered.set()
+            release.wait(5)                    # ...and wedges
+
+    t = threading.Thread(target=stale, daemon=True)
+    t.start()
+    assert entered.wait(5)
+    _fail(b)                                   # trips OPEN mid-flight
+    clk[0] += 10.1                             # cooldown elapsed
+    assert b.state == HALF_OPEN
+    release.set()                              # stale success lands now
+    t.join(5)
+    assert b.state == HALF_OPEN and b.recoveries == 0
+    with b.attempt("k"):                       # the real probe closes it
+        pass
+    assert b.state == CLOSED and b.recoveries == 1
+
+
+def test_breaker_slo_excludes_host_gate_wait():
+    """Time spent queueing on the host-side device gate behind other
+    healthy threads is contention, not device slowness — exclude_wait
+    credits it back so peak load alone cannot trip the breaker."""
+    clk = [0.0]
+    b = CircuitBreaker("unit-slo-excl", failure_threshold=1,
+                       cooldown_s=5.0, latency_slo_s=1.0,
+                       clock=lambda: clk[0])
+    with b.attempt("k"):
+        clk[0] += 5.0                          # 5s wall...
+        b.exclude_wait(4.5)                    # ...4.5s of it gate wait
+    assert b.state == CLOSED and b.slo_breaches == 0
+    with b.attempt("k"):
+        clk[0] += 5.0
+        b.exclude_wait(2.0)                    # 3s of DEVICE time left
+    assert b.state == OPEN and b.slo_breaches == 1
+
+
+# ---------------------------------------------------------------------
+# SigManager device fault matrix
+# ---------------------------------------------------------------------
+def _sig_rig(mode):
+    """SigManager whose 'device' batch_fn is a controllable fake over
+    the host verifiers: mode['v'] ∈ ok | raise | slow | garbage.
+    memo off so every verify exercises the engine."""
+    cfg = ReplicaConfig(replica_id=1, f_val=1, num_of_client_proxies=2)
+    keys = ClusterKeys.generate(cfg, 2, seed=b"degradation-sig")
+    node = keys.for_node(1)
+    calls = []
+
+    def batch_fn(entries):
+        calls.append(len(entries))
+        if mode["v"] == "raise":
+            raise RuntimeError("device lost")
+        if mode["v"] == "slow":
+            time.sleep(0.05)
+        if mode["v"] == "garbage":
+            return [True] * (len(entries) - 1)   # short verdict vector
+        from tpubft.crypto.cpu import make_verifier
+        return [make_verifier(s, pk).verify(d, sig)
+                for s, pk, d, sig in entries]
+
+    sig = SigManager(node, batch_fn=batch_fn, device_min_batch=1,
+                     memo_capacity=0)
+    first_client = cfg.n_val + cfg.num_ro_replicas
+    return sig, keys, first_client, calls
+
+
+def _item(keys, principal, payload):
+    signer = keys.for_node(principal).my_signer()
+    return (principal, payload, signer.sign(payload))
+
+
+@pytest.mark.parametrize("fault", ["raise", "garbage"])
+def test_device_fault_trips_breaker_and_scalar_path_stays_correct(fault):
+    b = device_breaker()
+    b.configure(failure_threshold=2, cooldown_s=60.0)
+    mode = {"v": "ok"}
+    sig, keys, fc, calls = _sig_rig(mode)
+    good = _item(keys, fc, b"w1")
+    forged = (fc + 1, b"w2", b"\x01" * 64)
+    # healthy device: good verifies, forged rejected (breaker CLOSED)
+    assert sig.verify_batch([good, forged]) == [True, False]
+    assert b.state == CLOSED
+    mode["v"] = fault
+    # every batch fails on the "device" and reroutes to scalar: verdicts
+    # stay correct throughout, and the breaker trips at the threshold
+    n0 = len(calls)
+    assert sig.verify_batch([_item(keys, fc, b"a"), forged]) \
+        == [True, False]
+    assert sig.verify_batch([_item(keys, fc, b"b")]) == [True]
+    assert b.state == OPEN
+    assert len(calls) == n0 + 2
+    # OPEN: fast-fail — the engine is NOT called, scalar carries the load
+    assert sig.verify_batch([_item(keys, fc, b"c"), forged]) \
+        == [True, False]
+    assert len(calls) == n0 + 2
+    assert sig.degraded_verifies.value >= 3
+    assert sig.scalar_fallbacks.value >= 3
+
+
+def test_device_hang_trips_via_latency_slo():
+    b = device_breaker()
+    b.configure(failure_threshold=2, cooldown_s=60.0,
+                latency_slo_s=0.005)
+    mode = {"v": "slow"}
+    sig, keys, fc, calls = _sig_rig(mode)
+    # slow-but-correct dispatches: results are used (no reroute), but
+    # each over-SLO ride burns failure budget — the wedging transport
+    # stops receiving NEW work once the breaker trips
+    assert sig.verify_batch([_item(keys, fc, b"s1")]) == [True]
+    assert sig.verify_batch([_item(keys, fc, b"s2")]) == [True]
+    assert b.state == OPEN
+    assert b.slo_breaches == 2
+    n = len(calls)
+    assert sig.verify_batch([_item(keys, fc, b"s3")]) == [True]
+    assert len(calls) == n                       # fast-failed to scalar
+
+
+def test_half_open_probe_restores_device_path():
+    b = device_breaker()
+    b.configure(failure_threshold=1, cooldown_s=0.05)
+    mode = {"v": "raise"}
+    sig, keys, fc, calls = _sig_rig(mode)
+    forged = (fc + 1, b"x", b"\x02" * 64)
+    assert sig.verify_batch([_item(keys, fc, b"p1"), forged]) \
+        == [True, False]
+    assert b.state == OPEN
+    # forged signature still rejected while degraded (breaker OPEN)
+    assert sig.verify_batch([forged]) == [False]
+    mode["v"] = "ok"
+    time.sleep(0.06)                             # cooldown elapsed
+    n = len(calls)
+    # next batch IS the half-open probe: device succeeds, breaker closes
+    assert sig.verify_batch([_item(keys, fc, b"p2"), forged]) \
+        == [True, False]
+    assert len(calls) == n + 1
+    assert b.state == CLOSED
+    assert b.recoveries == 1
+    # and the device path is the hot path again
+    assert sig.verify_batch([_item(keys, fc, b"p3")]) == [True]
+    assert len(calls) == n + 2
+
+
+# ---------------------------------------------------------------------
+# health watchdog
+# ---------------------------------------------------------------------
+def test_health_verdicts_and_stall_dump():
+    clk = [100.0]
+    hm = HealthMonitor("t", poll_s=999.0, clock=lambda: clk[0])
+    busy = {"v": True}
+    hm.register_probe("lane", threshold_s=1.0, busy_fn=lambda: busy["v"],
+                      detail_fn=lambda: {"depth": 7})
+    hm.beat("lane")
+    assert hm.poll_once()["verdict"] == HEALTHY
+    # beats stop while busy -> stalled, ONE dump (re-armed on beat)
+    clk[0] += 2.0
+    v = hm.poll_once()
+    assert v["verdict"] == STALLED and v["stalled"] == ["lane"]
+    assert [p["detail"] for p in v["probes"]] == [{"depth": 7}]
+    assert hm.m_stall_dumps.value == 1
+    hm.poll_once()
+    assert hm.m_stall_dumps.value == 1           # throttled
+    hm.beat("lane")
+    assert hm.poll_once()["verdict"] == HEALTHY
+    clk[0] += 2.0
+    hm.poll_once()
+    assert hm.m_stall_dumps.value == 2           # re-armed after recovery
+    # idle probes (no pending work) never stall
+    busy["v"] = False
+    assert hm.poll_once()["verdict"] == HEALTHY
+
+
+def test_health_degraded_on_breaker_and_flags():
+    hm = HealthMonitor("t2", poll_s=999.0)
+    assert hm.verdict()["verdict"] == HEALTHY
+    b = device_breaker()
+    b.configure(failure_threshold=1, cooldown_s=60.0)
+    try:
+        with b.attempt("k"):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    v = hm.verdict()
+    assert v["verdict"] == DEGRADED
+    assert v["breakers"]["device"]["state"] == OPEN
+    b.reset()
+    shed = {"v": True}
+    hm.register_degraded_flag("admission_shedding", lambda: shed["v"])
+    assert hm.verdict()["verdict"] == DEGRADED
+    shed["v"] = False
+    assert hm.verdict()["verdict"] == HEALTHY
+    json.loads(hm.render())                      # status payload is JSON
+
+
+# ---------------------------------------------------------------------
+# overload backpressure (watermark shedding)
+# ---------------------------------------------------------------------
+def _overload_pipe(high, low, max_pending=10_000):
+    cfg = ReplicaConfig(replica_id=1, f_val=1, num_of_client_proxies=2)
+    keys = ClusterKeys.generate(cfg, 2, seed=b"degradation-adm")
+    info = ReplicasInfo.from_config(cfg)
+    sig = SigManager(keys.for_node(1))
+    admitted = []
+    pipe = AdmissionPipeline(
+        sig=sig, info=info, sink=lambda a: admitted.append(a) or True,
+        epoch_fn=lambda: 0, view_fn=lambda: 0, stable_fn=lambda: 0,
+        workers=1, max_pending=max_pending,
+        high_watermark=high, low_watermark=low)
+    return pipe, admitted, keys, cfg.n_val + cfg.num_ro_replicas
+
+
+def _signed_req(keys, client, seq):
+    req = m.ClientRequestMsg(sender_id=client, req_seq_num=seq, flags=0,
+                             request=b"w", cid="", signature=b"")
+    req.signature = keys.for_node(client).my_signer().sign(
+        req.signed_payload())
+    return req
+
+
+def _critical_msgs(keys, n_each=5):
+    """Validly-signed/structured protocol-critical messages: complaint
+    (VC family), checkpoint, state transfer."""
+    out = []
+    for i in range(n_each):
+        c = m.ReplicaAsksToLeaveViewMsg(sender_id=0, view=i + 1, reason=0,
+                                        signature=b"")
+        c.signature = keys.for_node(0).my_signer().sign(c.signed_payload())
+        ck = m.CheckpointMsg(sender_id=2, seq_num=150 * (i + 1),
+                             state_digest=b"d" * 32, is_stable=False,
+                             signature=b"")
+        ck.signature = keys.for_node(2).my_signer().sign(
+            ck.signed_payload())
+        st = m.StateTransferMsg(sender_id=3, payload=b"st-%d" % i)
+        out += [(0, c.pack()), (2, ck.pack()), (3, st.pack())]
+    return out
+
+
+def test_overload_sheds_clients_never_critical_and_accounts_every_shed():
+    pipe, admitted, keys, fc = _overload_pipe(high=40, low=5)
+    crit = _critical_msgs(keys, n_each=5)        # 15 critical messages
+    n_clients = 200
+    submitted = 0
+    # interleave: critical traffic arrives THROUGHOUT the client flood,
+    # including deep into shed mode
+    ci = iter(crit)
+    for i in range(n_clients):
+        pipe.submit(fc + i % 2, _signed_req(keys, fc + i % 2,
+                                            1000 + i).pack())
+        submitted += 1
+        if i % 14 == 0:
+            nxt = next(ci, None)
+            if nxt is not None:
+                pipe.submit(*nxt)
+                submitted += 1
+    for nxt in ci:                               # any remainder
+        pipe.submit(*nxt)
+        submitted += 1
+    assert pipe.shedding                         # watermark crossed
+    assert pipe.adm_shed_overload.value > 0
+    # critical traffic NEVER sheds: all of it is queued (priority lane)
+    assert len(pipe._crit) == len(crit)
+    # drain synchronously (workers not started): criticals come first
+    first_batch = pipe._next_batch()
+    assert [s for s, _ in first_batch[:len(crit)]] \
+        == [s for s, _ in crit]
+    pipe._drain(first_batch)
+    while pipe.depth:
+        pipe._drain(pipe._next_batch())
+    # every critical message reached the dispatcher sink
+    crit_codes = {int(m.MsgCode.ReplicaAsksToLeaveView),
+                  int(m.MsgCode.Checkpoint), int(m.MsgCode.StateTransfer)}
+    admitted_crit = [a for a in admitted
+                     if int(a.msg.CODE) in crit_codes]
+    assert len(admitted_crit) == len(crit)
+    # shed mode exits once depth falls below the low watermark
+    assert not pipe.shedding
+    assert pipe.adm_shedding.value == 0
+    # exact accounting: every submitted datagram is in EXACTLY one
+    # terminal counter
+    c = {k: v.value for k, v in pipe.metrics.counters.items()}
+    assert submitted == (c["adm_admitted"] + c["adm_drops_pre_parse"]
+                         + c["adm_drops_stateless"] + c["adm_verify_fail"]
+                         + c["adm_dropped_ingress"]
+                         + c["adm_shed_overload"]), c
+    # and nothing was double-counted: the sink saw exactly adm_admitted
+    assert len(admitted) == c["adm_admitted"]
+
+
+def test_critical_headroom_survives_hard_bound():
+    """Even at the main buffer's hard bound, critical traffic still
+    enters its own lane (the watermark gap is not the only protection)."""
+    pipe, admitted, keys, fc = _overload_pipe(high=30, low=5,
+                                              max_pending=50)
+    # non-client, non-critical traffic ('other': shares) fills the main
+    # buffer to its hard bound — watermark shedding doesn't apply to it
+    share = m.PreparePartialMsg(sender_id=0, view=0, seq_num=5,
+                                digest=b"d" * 32, sig=b"s" * 64).pack()
+    for _ in range(60):
+        pipe.submit(0, share)
+    assert pipe.adm_dropped_ingress.value == 10  # 50 buffered, 10 full
+    for sender, raw in _critical_msgs(keys, n_each=2):
+        assert pipe.submit(sender, raw)          # still admitted
+    assert len(pipe._crit) == 6
+
+
+def test_admission_beat_tracks_stalest_worker():
+    """With admission_workers > 1, the health beat must follow the
+    STALEST worker: a single worker wedged inside _drain (holding its
+    batch hostage) freezes the probe age even while its siblings keep
+    looping — a shared per-loop beat would mask the stall forever."""
+    cfg = ReplicaConfig(replica_id=1, f_val=1, num_of_client_proxies=2)
+    keys = ClusterKeys.generate(cfg, 2, seed=b"degradation-beat")
+    info = ReplicasInfo.from_config(cfg)
+    beats = []
+    pipe = AdmissionPipeline(
+        sig=SigManager(keys.for_node(1)), info=info,
+        sink=lambda a: True, epoch_fn=lambda: 0, view_fn=lambda: 0,
+        stable_fn=lambda: 0, workers=2,
+        beat_fn=lambda: beats.append(1))
+    pipe._worker_beats = [0.0, 0.0]
+    pipe._stamp_beat(0)                  # worker 0 was (tied) stalest
+    assert len(beats) == 1
+    pipe._stamp_beat(0)                  # worker 1 is stalest now:
+    pipe._stamp_beat(0)                  # 0's loops must NOT beat
+    assert len(beats) == 1
+    pipe._stamp_beat(1)                  # the stalest stamp advances
+    assert len(beats) == 2
+
+
+# ---------------------------------------------------------------------
+# consensus liveness across a device failure (cluster level)
+# ---------------------------------------------------------------------
+def test_cluster_stays_live_across_device_failure_and_recovery():
+    from tpubft.apps import counter
+    from tpubft.diagnostics import get_registrar
+    from tpubft.testing import InProcessCluster
+
+    b = device_breaker()
+    mode = {"v": "ok"}
+
+    def make_batch_fn(calls):
+        def batch_fn(entries):
+            calls.append(len(entries))
+            if mode["v"] == "raise":
+                raise RuntimeError("device lost mid-run")
+            from tpubft.crypto.cpu import make_verifier
+            return [make_verifier(s, pk).verify(d, sig)
+                    for s, pk, d, sig in entries]
+        return batch_fn
+
+    with InProcessCluster(
+            f=1, num_clients=2,
+            cfg_overrides={"breaker_failure_threshold": 2,
+                           "breaker_cooldown_ms": 200}) as cluster:
+        calls = []
+        for rep in cluster.replicas.values():
+            # emulate the TPU ride: the cross-principal batch plane is a
+            # controllable engine; min batch 1 so every verify rides it
+            rep.sig._batch_fn = make_batch_fn(calls)
+            rep.sig.device_min_batch = 1
+        cl = cluster.client(0)
+        assert cl.send_write(counter.encode_add(1),
+                             timeout_ms=15000) is not None
+        assert b.state == CLOSED and len(calls) > 0
+
+        # ---- device dies mid-run ----
+        mode["v"] = "raise"
+        for i in range(3):
+            assert cl.send_write(counter.encode_add(1),
+                                 timeout_ms=15000) is not None
+        # goodput continued on the scalar engines; the breaker tripped
+        # within the failure budget and is visible everywhere (with a
+        # 200ms cooldown it may already read HALF_OPEN — also degraded;
+        # the failing probes keep re-opening it)
+        assert b.state != CLOSED and b.trips >= 1
+        assert sum(cluster.metric(r, "counters", "degraded_verifies",
+                                  "signature_manager")
+                   for r in cluster.replicas) > 0
+        rep0 = cluster.replicas[0]
+        v = rep0.health.verdict()
+        assert v["verdict"] == DEGRADED
+        assert v["breakers"]["device"]["state"] in (OPEN, HALF_OPEN)
+        # ... including through `status get health`
+        payload = json.loads(get_registrar().get_status("replica0.health"))
+        assert payload["breakers"]["device"]["state"] in (OPEN, HALF_OPEN)
+
+        # ---- device recovers: half-open probe re-closes the breaker ----
+        mode["v"] = "ok"
+        time.sleep(0.25)                         # past the cooldown
+        deadline = time.time() + 20
+        while b.state != CLOSED and time.time() < deadline:
+            cl.send_write(counter.encode_add(1), timeout_ms=15000)
+        assert b.state == CLOSED
+        assert b.recoveries >= 1
+        assert rep0.health.verdict()["verdict"] == HEALTHY
+
+        # satellite: the drain barrier's budget comes from the config
+        seen = {}
+        orig = rep0.exec_lane.drain
+        rep0.exec_lane.drain = \
+            lambda timeout: seen.setdefault("t", timeout) or orig(timeout)
+        rep0._drain_exec_lane()
+        assert seen["t"] == pytest.approx(
+            rep0.cfg.execution_drain_timeout_ms / 1e3)
+        rep0.exec_lane.drain = orig
+
+
+# ---------------------------------------------------------------------
+# adaptive client backoff
+# ---------------------------------------------------------------------
+def test_decorrelated_backoff_bounds_and_growth():
+    import random
+
+    from tpubft.bftclient.client import decorrelated_backoff
+    rng = random.Random(7)
+    base, cap = 0.25, 2.0
+    prev = base
+    seen_above_base = False
+    for _ in range(50):
+        nxt = decorrelated_backoff(base, cap, prev, rng)
+        assert base <= nxt <= cap
+        seen_above_base |= nxt > base
+        prev = nxt
+    assert seen_above_base
+    # degenerate config (cap <= base) = the old fixed cadence
+    assert decorrelated_backoff(0.25, 0.1, 5.0, rng) == 0.25
+
+
+def test_retry_targeting_write_narrows_read_rebroadcasts():
+    from tpubft.bftclient import BftClient, ClientConfig
+    from tpubft.comm.interfaces import ICommunication
+
+    class RecComm(ICommunication):
+        def __init__(self):
+            self.sent = {}
+
+        def start(self, receiver):
+            pass
+
+        def stop(self):
+            pass
+
+        def is_running(self):
+            return True
+
+        def send(self, dest, data):
+            self.sent[dest] = self.sent.get(dest, 0) + 1
+
+        def get_connection_status(self, node):
+            from tpubft.comm.interfaces import ConnectionStatus
+            return ConnectionStatus.CONNECTED
+
+    cfg = ReplicaConfig(f_val=1, num_of_client_proxies=1)
+    keys = ClusterKeys.generate(cfg, 1, seed=b"backoff-test")
+    cid = cfg.n_val
+    comm = RecComm()
+    cl = BftClient(ClientConfig(client_id=cid, f_val=1,
+                                retry_timeout_ms=30, retry_max_ms=60),
+                   keys.for_node(cid), comm)
+    cl._started = True                           # skip comm.start
+    from tpubft.bftclient.client import Quorum
+
+    def new_req():
+        with cl._lock:
+            return cl._new_request_locked(b"p", 0, "", Quorum.LINEARIZABLE)
+
+    def reply_from(r, rs):
+        msg = m.ClientReplyMsg(sender_id=r, req_seq_num=rs,
+                               current_primary=0, reply=b"ok",
+                               replica_specific_info=b"")
+        cl.on_new_message(r, msg.pack())
+
+    # --- write path: retries narrow to the replicas still owing ---
+    req = new_req()
+    rs = req.req_seq_num
+    # replies from 0 and 1 land immediately; quorum needs 3
+    reply_from(0, rs)
+    reply_from(1, rs)
+    assert cl._retry_targets({rs}) == [2, 3]
+    done = {}
+
+    def drive(read_only):
+        done["pending"] = cl._drive_quorum(req.pack(), [rs],
+                                           read_only=read_only,
+                                           timeout_ms=2000)
+
+    t = threading.Thread(target=drive, args=(False,), daemon=True)
+    t.start()
+    time.sleep(0.25)                             # several retry ticks
+    reply_from(2, rs)                            # quorum completes
+    t.join(5)
+    assert done["pending"] == set()
+    # first write tick went to the primary hint alone; retries went
+    # ONLY to the replicas still owing a reply
+    assert comm.sent[2] > 1 and comm.sent[3] > 1
+    assert comm.sent[0] == 1 and comm.sent.get(1, 0) == 0
+    cl._forget([rs])
+
+    # --- read path: every tick re-broadcasts — a replica whose first
+    # answer was stale is computed fresh from local state on re-ask, so
+    # narrowing would strand an f+1 matching quorum forever ---
+    comm.sent.clear()
+    req = new_req()
+    rs = req.req_seq_num
+    reply_from(0, rs)
+    reply_from(1, rs)
+    t = threading.Thread(target=drive, args=(True,), daemon=True)
+    t.start()
+    time.sleep(0.25)
+    reply_from(2, rs)
+    t.join(5)
+    assert done["pending"] == set()
+    # already-replied replicas were re-asked on every read retry tick
+    assert comm.sent[0] > 1 and comm.sent[1] > 1
+    assert comm.sent[2] > 1 and comm.sent[3] > 1

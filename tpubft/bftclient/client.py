@@ -13,6 +13,7 @@ primary hint is a majority vote over each write's reply quorum.
 from __future__ import annotations
 
 import enum
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -35,8 +36,26 @@ class ClientConfig:
     client_id: int
     f_val: int = 1
     c_val: int = 0
+    # adaptive retransmission: the FIRST retry fires after
+    # retry_timeout_ms; subsequent retries back off with decorrelated
+    # jitter (AWS-style: next = uniform(base, prev * 3), capped at
+    # retry_max_ms), so an overloaded cluster sees a client's retry
+    # pressure FALL over a request's lifetime instead of compounding at
+    # a fixed cadence — and concurrent clients decorrelate instead of
+    # retransmitting in lockstep. retry_max_ms <= retry_timeout_ms
+    # degenerates to the old fixed cadence.
     retry_timeout_ms: int = 250
+    retry_max_ms: int = 2000
     request_timeout_ms: int = 10000
+
+
+def decorrelated_backoff(base_s: float, cap_s: float, prev_s: float,
+                         rng: Optional[random.Random] = None) -> float:
+    """Next retry delay: uniform(base, prev*3) capped — decorrelated
+    jitter (pure helper; the client threads each call it with their own
+    state, tests call it directly)."""
+    r = (rng or random).uniform(base_s, max(base_s, prev_s * 3))
+    return min(max(cap_s, base_s), r)
 
 
 class TimeoutError_(Exception):
@@ -226,6 +245,22 @@ class BftClient(IReceiver):
                                   req_seq_num=rs, flags=flags,
                                   request=payload, cid=cid, signature=b"")
 
+    def _retry_targets(self, pending: set) -> List[int]:
+        """Replicas still owing a reply for at least one pending seq —
+        the broadcast-amplification fix: a retransmission tick must not
+        re-send to replicas whose reply for every pending seq already
+        arrived; they would just re-serve their reply cache while the
+        cluster is presumably overloaded. Write-path only: a write reply
+        is the committed execution result (final once sent), whereas a
+        read-only reply is computed fresh from local state — a replica
+        whose first read answer was stale must be re-asked so its
+        converged state can complete the f+1 matching quorum."""
+        with self._lock:
+            owing = [r for r in self.info.replica_ids
+                     if any(r not in self._replies.get(rs, ())
+                            for rs in pending)]
+        return owing or list(self.info.replica_ids)
+
     def _drive_quorum(self, raw: bytes, seqs: List[int], read_only: bool,
                       timeout_ms: Optional[int]) -> set:
         """Send `raw` and wait for quorum on every seq in `seqs`;
@@ -237,21 +272,40 @@ class BftClient(IReceiver):
         moved; only worth it when the budget allows at least one
         broadcast retry after a wrong-hint miss. Read-only requests
         always broadcast: each replica answers from local state and the
-        client needs f+1 matching replies from DISTINCT replicas."""
+        client needs f+1 matching replies from DISTINCT replicas.
+
+        Retries back off exponentially with decorrelated jitter (see
+        ClientConfig.retry_timeout_ms/retry_max_ms); write retries
+        additionally target only the replicas that have not yet replied
+        for the still-pending seqs — under overload a client's pressure
+        on the cluster falls with every tick instead of compounding at
+        a fixed broadcast cadence."""
         deadline = time.monotonic() + (timeout_ms
                                        or self.cfg.request_timeout_ms) / 1e3
-        retry_s = self.cfg.retry_timeout_ms / 1e3
+        base_s = self.cfg.retry_timeout_ms / 1e3
+        cap_s = max(self.cfg.retry_max_ms / 1e3, base_s)
+        delay_s = base_s
         first = True
         pending = set(seqs)
         while time.monotonic() < deadline and pending:
             if (first and not read_only
-                    and deadline - time.monotonic() > 2 * retry_s):
-                self.comm.send(self._primary_hint, raw)
+                    and deadline - time.monotonic() > 2 * base_s):
+                targets = [self._primary_hint]
+            elif first or read_only:
+                # reads re-broadcast every tick: replies are computed
+                # from CURRENT local state, so a replica whose earlier
+                # answer was stale may hold the quorum-completing value
+                # now (see _retry_targets)
+                targets = list(self.info.replica_ids)
             else:
-                for r in self.info.replica_ids:
-                    self.comm.send(r, raw)
+                targets = self._retry_targets(pending)
+            for r in targets:
+                self.comm.send(r, raw)
+            if not first:
+                delay_s = decorrelated_backoff(base_s, cap_s, delay_s)
+            wait_until = min(deadline, time.monotonic()
+                             + (base_s if first else delay_s))
             first = False
-            wait_until = min(deadline, time.monotonic() + retry_s)
             for rs in sorted(pending):
                 if not self._done[rs].wait(
                         timeout=max(0.0, wait_until - time.monotonic())):

@@ -38,11 +38,25 @@ the failed verdict for live proposals.
 
 Gated by `ReplicaConfig.admission_workers` (0 = legacy inline path:
 raw bytes to the dispatcher, parse/verify in the handlers).
+
+Overload backpressure: ingest classifies each datagram by its 2-byte
+code peek. Protocol-critical traffic (view-change family, checkpoints,
+state transfer, restart votes — `_CRITICAL_CODES`) rides a dedicated
+priority queue with its own headroom that workers drain FIRST and that
+watermark shedding never touches. Everything else shares the main
+buffer: when its depth crosses `admission_high_watermark` the plane
+enters shed mode and drops fresh client datagrams at ingest (counted
+in `adm_shed_overload`, one counter per shed) until depth falls to
+`admission_low_watermark`. Blind tail-drop at the hard bound still
+exists (`adm_dropped_ingress`) but watermark shedding fires first, so
+an overloaded replica degrades by shedding client goodput — never its
+liveness machinery.
 """
 from __future__ import annotations
 
 import struct
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
@@ -100,6 +114,22 @@ _SEQ = struct.Struct("<Q")              # at offset 6
 _COMPLAINT_CODE = int(m.MsgCode.ReplicaAsksToLeaveView)
 _VC_CODES = frozenset((int(m.MsgCode.ViewChange), int(m.MsgCode.NewView)))
 
+# ---- overload backpressure classes (ingest-time, code peek only) ----
+# protocol-critical traffic rides a dedicated priority queue that
+# watermark shedding never touches and workers drain first: view-change
+# family (liveness), checkpoints (stability/GC), state transfer
+# (recovery), restart votes/proofs (operator control). An overloaded
+# replica sheds client goodput, never its ability to stay in the
+# protocol.
+_CRITICAL_CODES = frozenset(int(c) for c in (
+    m.MsgCode.ReplicaAsksToLeaveView, m.MsgCode.ViewChange,
+    m.MsgCode.NewView, m.MsgCode.Checkpoint, m.MsgCode.AskForCheckpoint,
+    m.MsgCode.StateTransfer, m.MsgCode.ReplicaRestartReady,
+    m.MsgCode.RestartProof))
+# fresh client load — the sheddable class under overload
+_CLIENT_CODES = frozenset((int(m.MsgCode.ClientRequest),
+                           int(m.MsgCode.ClientBatchRequest)))
+
 
 class AdmissionPipeline:
     """Bounded ingest queue + worker pool. Thread-safe producers
@@ -114,7 +144,9 @@ class AdmissionPipeline:
                  workers: int = 1, drain_max: int = 256,
                  max_pending: int = MAX_EXTERNAL_PENDING,
                  aggregator: Optional[Aggregator] = None,
-                 name: str = "admission", ckpt_window: int = 0):
+                 name: str = "admission", ckpt_window: int = 0,
+                 high_watermark: int = 0, low_watermark: int = 0,
+                 beat_fn: Optional[Callable[[], None]] = None):
         self._sig = sig
         self._info = info
         self._sink = sink
@@ -131,7 +163,27 @@ class AdmissionPipeline:
         # whole transport burst (the recvmmsg drain) enters under ONE
         # lock round (extend + one wake), not a lock cycle per datagram
         self._buf: "deque[Tuple[int, bytes]]" = deque()
+        # protocol-critical priority queue (see _CRITICAL_CODES): its
+        # own headroom up to max_pending — a client flood filling _buf
+        # can never push a view-change or checkpoint out
+        self._crit: "deque[Tuple[int, bytes]]" = deque()
         self._max_pending = max_pending
+        # overload watermarks (0 = shedding disabled): depth >= high
+        # enters shed mode (fresh client datagrams dropped at ingest,
+        # each counted in adm_shed_overload), depth <= low leaves it.
+        # Both clamp under max_pending so a small hard bound degrades
+        # the hysteresis gap instead of inverting it (low above high
+        # would flap shed mode on every other datagram).
+        self._high = min(high_watermark, max_pending) if high_watermark \
+            else 0
+        self._low = min(low_watermark, self._high - 1) if self._high \
+            else low_watermark
+        self._shedding = False
+        self._beat = beat_fn          # health-plane liveness hook
+        # per-worker liveness stamps (re-seeded in start()); the probe
+        # beat tracks the OLDEST stamp so one wedged worker is visible
+        self._worker_beats: List[float] = [time.monotonic()] \
+            * self._n_workers
         self._cv = threading.Condition()
         self._threads: List[threading.Thread] = []
         self._running = False
@@ -166,6 +218,13 @@ class AdmissionPipeline:
             "adm_verify_fail")
         self.adm_queue_depth = self.metrics.register_gauge(
             "adm_queue_depth")
+        # client datagrams shed at ingest while in overload shed mode —
+        # with adm_dropped_ingress (hard bound) these are the only two
+        # ingest-time dispositions besides admission to the buffer, so
+        # submitted == buffered + shed + dropped_ingress always holds
+        self.adm_shed_overload = self.metrics.register_counter(
+            "adm_shed_overload")
+        self.adm_shedding = self.metrics.register_gauge("adm_shedding")
         self.adm_drains = self.metrics.register_counter("adm_drains")
         # messages handed to the dispatcher queue; admitted + the four
         # drop counters above account for every ingested message, which
@@ -179,8 +238,10 @@ class AdmissionPipeline:
         if self._running:
             return
         self._running = True
+        now = time.monotonic()
+        self._worker_beats = [now] * self._n_workers
         for i in range(self._n_workers):
-            t = threading.Thread(target=self._run, daemon=True,
+            t = threading.Thread(target=self._run, args=(i,), daemon=True,
                                  name=f"{self._name}-{i}")
             self._threads.append(t)
             t.start()
@@ -194,39 +255,97 @@ class AdmissionPipeline:
     # ------------------------------------------------------------------
     # ingest (transport threads)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _class_of(raw: bytes) -> str:
+        """Ingest class from the 2-byte code peek: 'crit' (protected
+        priority queue), 'client' (sheddable under overload), 'other'
+        (consensus shares etc. — bounded but never watermark-shed)."""
+        if len(raw) >= 2:
+            (code,) = _CODE.unpack_from(raw)
+            if code in _CRITICAL_CODES:
+                return "crit"
+            if code in _CLIENT_CODES:
+                return "client"
+        return "other"
+
+    def _ingest_locked(self, sender: int, raw: bytes, cls: str) -> str:
+        """One datagram's ingest disposition under self._cv (`cls`
+        precomputed by the caller OUTSIDE the lock — classification is
+        stateless and must not extend the critical section):
+        'ok' (buffered), 'shed' (overload watermark), 'full' (hard
+        bound). Exactly one counter fires per disposition — the
+        accounting invariant tests and benches rely on."""
+        if cls == "crit":
+            if len(self._crit) >= self._max_pending:
+                return "full"
+            self._crit.append((sender, raw))
+            return "ok"
+        depth = len(self._buf) + len(self._crit)
+        if self._high:
+            if not self._shedding and depth >= self._high:
+                self._shedding = True
+                self.adm_shedding.set(1)
+            elif self._shedding and depth <= self._low:
+                self._shedding = False
+                self.adm_shedding.set(0)
+        if self._shedding and cls == "client":
+            return "shed"
+        if len(self._buf) >= self._max_pending:
+            return "full"
+        self._buf.append((sender, raw))
+        return "ok"
+
     def submit(self, sender: int, raw: bytes) -> bool:
+        cls = self._class_of(raw)
         with self._cv:
-            if len(self._buf) >= self._max_pending:
-                full = True
-            else:
-                self._buf.append((sender, raw))
-                full = False
+            d = self._ingest_locked(sender, raw, cls)
+            if d == "ok":
                 self._cv.notify()
-        if full:
+        if d == "full":
             self.adm_dropped_ingress.inc()
-        return not full
+        elif d == "shed":
+            self.adm_shed_overload.inc()
+        return d == "ok"
 
     def submit_burst(self, msgs: Iterable[Tuple[int, bytes]]) -> None:
-        """Whole-burst ingest: one Condition acquire, one extend, one
+        """Whole-burst ingest: one Condition acquire for the burst, one
         wake (all workers when the burst spans several drains) — the
         handoff half of the recvmmsg amortization."""
-        msgs = list(msgs)
+        # classify OUTSIDE the lock: the whole burst's unpack_from peeks
+        # happen before workers are blocked on _cv, preserving the
+        # one-lock-round handoff recvmmsg bought
+        classed = [(sender, raw, self._class_of(raw))
+                   for sender, raw in msgs]
+        taken = shed = full = 0
         with self._cv:
-            room = self._max_pending - len(self._buf)
-            take = msgs if room >= len(msgs) else msgs[:max(0, room)]
-            self._buf.extend(take)
-            if take:
-                if len(take) > self._drain_max:
+            for sender, raw, cls in classed:
+                d = self._ingest_locked(sender, raw, cls)
+                if d == "ok":
+                    taken += 1
+                elif d == "shed":
+                    shed += 1
+                else:
+                    full += 1
+            if taken:
+                if taken > self._drain_max:
                     self._cv.notify_all()
                 else:
                     self._cv.notify()
-        dropped = len(msgs) - len(take)
-        if dropped:
-            self.adm_dropped_ingress.inc(dropped)
+        if full:
+            self.adm_dropped_ingress.inc(full)
+        if shed:
+            self.adm_shed_overload.inc(shed)
 
     @property
     def depth(self) -> int:
-        return len(self._buf)       # racy read is fine for a gauge
+        # racy read is fine for a gauge
+        return len(self._buf) + len(self._crit)
+
+    @property
+    def shedding(self) -> bool:
+        """Overload shed mode (degraded-state input to the health
+        plane)."""
+        return self._shedding
 
     @property
     def processed(self) -> int:
@@ -240,13 +359,45 @@ class AdmissionPipeline:
     # ------------------------------------------------------------------
     def _next_batch(self) -> List[Tuple[int, bytes]]:
         with self._cv:
-            if not self._buf:
+            if not self._buf and not self._crit:
                 self._cv.wait(0.1)
-            n = min(len(self._buf), self._drain_max)
-            return [self._buf.popleft() for _ in range(n)]
+            out: List[Tuple[int, bytes]] = []
+            # protocol-critical first: under overload the liveness
+            # machinery is parsed/verified ahead of queued client load
+            while self._crit and len(out) < self._drain_max:
+                out.append(self._crit.popleft())
+            while self._buf and len(out) < self._drain_max:
+                out.append(self._buf.popleft())
+            if self._shedding \
+                    and len(self._buf) + len(self._crit) <= self._low:
+                self._shedding = False
+                self.adm_shedding.set(0)
+            return out
 
-    def _run(self) -> None:
+    def _stamp_beat(self, idx: int) -> None:
+        """Per-worker liveness stamp; the external health beat fires
+        only when the STALEST worker's stamp advances. One wedged
+        worker (and the drained batch it holds) therefore freezes the
+        probe age even while sibling workers keep looping — with a
+        shared beat, any surviving worker would mask the stall."""
+        if self._beat is None:
+            return
+        now = time.monotonic()
+        with self._cv:
+            beats = self._worker_beats
+            was_oldest = beats[idx] <= min(beats)
+            beats[idx] = now
+        if was_oldest:
+            try:
+                self._beat()
+            except Exception:  # noqa: BLE001 — the health hook must not
+                pass           # kill a worker
+
+    def _run(self, idx: int = 0) -> None:
         while self._running:
+            self._stamp_beat(idx)     # health probe: a worker wedged
+            # inside _drain stops stamping; once it is the stalest, the
+            # probe age grows while depth does — that IS the stall
             batch = self._next_batch()
             if not batch:
                 continue
@@ -564,7 +715,7 @@ class AdmissionPipeline:
                     self.adm_drops_stateless.inc(stateless_drops)
                 if verify_fails:
                     self.adm_verify_fail.inc(verify_fails)
-                self.adm_queue_depth.set(len(self._buf))
+                self.adm_queue_depth.set(self.depth)
             span.set_tag("msgs", len(batch)).set_tag("admitted", admitted) \
                 .set_tag("verifies", len(jobs)) \
                 .set_tag("pre_drops", pre_drops) \

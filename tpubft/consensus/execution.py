@@ -169,6 +169,13 @@ class ExecutionLane:
     # ------------------------------------------------------------------
     def _loop(self) -> None:
         watchdog = get_watchdog()
+        # health-probe semantics are PROGRESS, not thread liveness: the
+        # beat fires when the lane is idle (fresh age when work arrives)
+        # and after each durable apply — depth > 0 with no apply for
+        # execution_drain_timeout_ms reads as a stall (a wedged handler,
+        # a run stuck behind a dead DB, or a held lane), even while this
+        # thread is alive and waiting
+        health = getattr(self._r, "health", None)
         with mdc_scope(r=self._r.id):
             while True:
                 watchdog.beat(self._name)
@@ -176,6 +183,8 @@ class ExecutionLane:
                     while self._running and (
                             not self._pending or self._held
                             or time.monotonic() < self._retry_at):
+                        if health is not None and not self._pending:
+                            health.beat("exec_lane")
                         self._cond.wait(0.2)
                         watchdog.beat(self._name)
                     if not self._running:
@@ -184,6 +193,8 @@ class ExecutionLane:
                     self._busy = True
                 try:
                     self._execute_run(run)
+                    if health is not None:
+                        health.beat("exec_lane")      # durable apply
                 except Exception:  # noqa: BLE001 — retry, as inline did
                     log.exception("run [%d..%d] failed; will retry",
                                   run[0][0], run[-1][0])

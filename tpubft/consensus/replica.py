@@ -130,6 +130,27 @@ class Replica(IReceiver):
         # SigManager.cpp:197, IThresholdVerifier.h:23 — route to the
         # batched TPU kernels when crypto_backend == "tpu"; "auto"
         # probes for a real device safely and picks for you)
+        # --- degradation plane: device circuit breaker + health
+        # watchdog (utils/breaker.py + consensus/health.py). The breaker
+        # is process-wide (one accelerator per process); every replica
+        # pushes its config — last writer wins, and all replicas of one
+        # process share the verdicts, which matches sharing the device.
+        from tpubft.consensus.health import HealthMonitor
+        from tpubft.ops.dispatch import device_breaker
+        device_breaker().configure(
+            failure_threshold=cfg.breaker_failure_threshold,
+            cooldown_s=cfg.breaker_cooldown_ms / 1e3,
+            latency_slo_s=cfg.breaker_latency_slo_ms / 1e3,
+            max_cooldown_s=cfg.breaker_cooldown_ms / 1e3 * 16)
+        self.health = HealthMonitor(f"replica{cfg.replica_id}",
+                                    self.aggregator,
+                                    poll_s=cfg.health_poll_ms / 1e3)
+        self.health.register_probe(
+            "dispatcher", cfg.health_stall_ms / 1e3,
+            detail_fn=lambda: {
+                "external_q": self.incoming._external.qsize(),
+                "internal_q": self.incoming._internal.qsize()})
+
         from tpubft.crypto.backend import resolve_backend
         backend = self.crypto_backend = resolve_backend(cfg.crypto_backend)
         # write the RESOLVED backend back: every later consumer of the
@@ -251,6 +272,11 @@ class Replica(IReceiver):
                                   self._check_view_change_timer)
         self.dispatcher.add_timer(cfg.status_report_timer_ms / 1000.0,
                                   self._send_status)
+        # dispatcher liveness beat: fires every loop iteration it is due
+        # (messages AND idle timeouts both reach the timer pass), so the
+        # beat age is the consensus thread's tick age
+        self.dispatcher.add_timer(0.2,
+                                  lambda: self.health.beat("dispatcher"))
         self.collector_pool = CollectorPool(
             lambda res: self.incoming.push_internal("combine", res))
         # cross-seqnum combined-cert verification batcher: certs arriving
@@ -295,8 +321,18 @@ class Replica(IReceiver):
                 drain_max=cfg.admission_drain_max,
                 aggregator=self.aggregator,
                 name=f"admission-{self.id}",
-                ckpt_window=cfg.checkpoint_window_size)
+                ckpt_window=cfg.checkpoint_window_size,
+                high_watermark=cfg.admission_high_watermark,
+                low_watermark=cfg.admission_low_watermark,
+                beat_fn=lambda: self.health.beat("admission"))
             self.dispatcher.set_admitted_handler(self._on_admitted)
+            self.health.register_probe(
+                "admission", cfg.health_stall_ms / 1e3,
+                busy_fn=lambda: self.admission.depth > 0,
+                detail_fn=lambda: {"depth": self.admission.depth,
+                                   "shedding": self.admission.shedding})
+            self.health.register_degraded_flag(
+                "admission_shedding", lambda: self.admission.shedding)
 
         # retransmissions (reference RetransmissionsManager +
         # sendRetransmittableMsgToReplica, ReplicaImp.cpp:2531)
@@ -423,6 +459,13 @@ class Replica(IReceiver):
                      f"last_stable={self.last_stable} "
                      f"in_view_change={self.in_view_change} "
                      f"{self.control.status()}"))
+        # aggregate degradation verdict (`status get health`): probes +
+        # breaker snapshots + shed flags as JSON. The bare "health" key
+        # is the one-replica-per-process operator entry; in-process
+        # clusters also get the per-replica key.
+        self._diag.register_status(f"replica{self.id}.health",
+                                   self.health.render)
+        self._diag.register_status("health", self.health.render)
         from tpubft.testing.slowdown import get_slowdown_manager
         self._slowdown = get_slowdown_manager()
 
@@ -439,6 +482,13 @@ class Replica(IReceiver):
                 cfg.checkpoint_window_size)
             self.dispatcher.register_internal("exec_done",
                                               self._apply_exec_runs)
+            # stall threshold = the drain barrier's budget: a lane that
+            # would time out a view-change/ST drain is reported by the
+            # watchdog with stacks + depths, not discovered by a human
+            self.health.register_probe(
+                "exec_lane", cfg.execution_drain_timeout_ms / 1e3,
+                busy_fn=lambda: not self.exec_lane.idle(),
+                detail_fn=lambda: {"depth": self.exec_lane.depth})
 
         # assigned BEFORE the restore replay: _restore_window can reach
         # _execute_committed, whose pipeline retrigger reads _running
@@ -501,6 +551,15 @@ class Replica(IReceiver):
             replica_ids=list(self.info.replica_ids),
             f_val=self.cfg.f_val)
         self.dispatcher.add_timer(0.2, st.tick)
+        # fetch-plane progress pulse: busy only while fetching; the
+        # last-activity pulse (sends/receives) replaces thread beats —
+        # ST runs on the dispatcher, this watches its *progress*
+        self.health.register_probe(
+            "state_transfer",
+            max(self.cfg.health_stall_ms, self.cfg.st_stall_timeout_ms) / 1e3,
+            busy_fn=lambda: st.is_fetching,
+            last_fn=lambda: st.last_activity,
+            detail_fn=lambda: {"state": st.state})
         self._st_stall_mark = (self.last_executed, time.monotonic())
         self.dispatcher.add_timer(
             max(self.cfg.st_stall_timeout_ms / 4000.0, 0.25),
@@ -589,6 +648,7 @@ class Replica(IReceiver):
             self.exec_lane.start()
         if self.admission is not None:
             self.admission.start()
+        self.health.start()
         self.dispatcher.start()
         with mdc_scope(r=self.id):       # start() runs on the caller thread
             log.info("replica up: n=%d f=%d c=%d view=%d primary=%d "
@@ -610,6 +670,7 @@ class Replica(IReceiver):
             self.exec_lane.stop()
         if self.admission is not None:
             self.admission.stop()
+        self.health.stop()
         self.dispatcher.stop()
         self.collector_pool.shutdown()
         self.cert_batcher.stop()
@@ -1768,14 +1829,19 @@ class Replica(IReceiver):
                 raise
             self._exec_enqueued = nxt
 
-    def _drain_exec_lane(self, timeout: float = 30.0) -> bool:
+    def _drain_exec_lane(self, timeout: Optional[float] = None) -> bool:
         """Dispatcher-side barrier: wait until the lane applied every
         submitted slot, then integrate the completed runs NOW (the
         level-triggered wakeup may still be queued behind us). Used
         before view-change send, view entry, state-transfer adoption,
-        wedge/barrier execution."""
+        wedge/barrier execution. The default budget is
+        ReplicaConfig.execution_drain_timeout_ms — the same threshold
+        the health watchdog holds the lane's progress to, so a drain
+        that would time out is independently reported as a stall."""
         if self.exec_lane is None:
             return True
+        if timeout is None:
+            timeout = self.cfg.execution_drain_timeout_ms / 1e3
         ok = self.exec_lane.drain(timeout)
         if not ok:
             log.warning("execution lane failed to drain in %.0fs "

@@ -27,7 +27,11 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from tpubft.consensus.keys import ClusterKeys
 from tpubft.crypto.interfaces import IVerifier
+from tpubft.ops.dispatch import BreakerOpen, device_breaker
+from tpubft.utils.logging import get_logger
 from tpubft.utils.metrics import Aggregator, Component
+
+log = get_logger("sigmgr")
 
 
 class SigManager:
@@ -99,6 +103,11 @@ class SigManager:
             "batched_verifies")
         self.scalar_fallbacks = self.metrics.register_counter(
             "scalar_fallbacks")
+        # items rerouted device→scalar at RUNTIME (device exception or a
+        # tripped circuit breaker) — a nonzero value means the system ran
+        # in degraded verification mode; the breaker snapshot says why
+        self.degraded_verifies = self.metrics.register_counter(
+            "degraded_verifies")
 
     # ---- signing ----
     def sign(self, data: bytes) -> bytes:
@@ -302,14 +311,36 @@ class SigManager:
                 pending.append(i)
         if pending:
             sub = [items[i] for i in pending]
-            if self._batch_fn is not None \
-                    and len(sub) >= self.device_min_batch:
-                verdicts, via_grace = self._verify_batch_cross(
-                    sub, seq, view_scoped,
-                    aliased=[aliased[i] for i in pending],
-                    pks=[pks[i] for i in pending])
-                self.batched_verifies.inc(len(sub))
-            else:
+            verdicts = None
+            use_device = (self._batch_fn is not None
+                          and len(sub) >= self.device_min_batch)
+            if use_device and not device_breaker().allow():
+                # non-mutating preview: while the breaker is OPEN, skip
+                # building the device batch entirely instead of paying
+                # list construction + a BreakerOpen round-trip on every
+                # degraded verify (attempt() below still guards the
+                # admitted path — a lost race just raises as before)
+                self.degraded_verifies.inc(len(sub))
+            elif use_device:
+                try:
+                    verdicts, via_grace = self._verify_batch_cross(
+                        sub, seq, view_scoped,
+                        aliased=[aliased[i] for i in pending],
+                        pks=[pks[i] for i in pending])
+                    self.batched_verifies.inc(len(sub))
+                except BreakerOpen:
+                    # breaker tripped: fast-fail BEFORE the device — the
+                    # scalar engines carry the load until the half-open
+                    # probe re-admits the device
+                    self.degraded_verifies.inc(len(sub))
+                except Exception:  # noqa: BLE001 — a device failure must
+                    # degrade verification, never fail it: the breaker
+                    # recorded the failure (trip after N consecutive)
+                    log.warning("device verify batch failed (%d items); "
+                                "rerouting to scalar engines",
+                                len(sub), exc_info=True)
+                    self.degraded_verifies.inc(len(sub))
+            if verdicts is None:
                 verdicts, via_grace = self._verify_batch_grouped(
                     sub, seq, view_scoped)
                 self.scalar_fallbacks.inc(len(sub))
@@ -366,7 +397,18 @@ class SigManager:
             if pk is not None:
                 entries.append((self._scheme_of(a), pk, data, sig))
                 keyed.append(i)
-        verdicts = self._batch_fn(entries)
+        # the device ride runs under the circuit breaker: exceptions and
+        # latency-SLO breaches count against the failure budget, an OPEN
+        # breaker raises BreakerOpen before building any device work
+        # (nested ops-level sections are pass-through — one failure is
+        # one failure), and a short/garbage verdict vector classifies as
+        # a device failure instead of silently truncating into drops
+        with device_breaker().attempt("sig_verify"):
+            verdicts = self._batch_fn(entries)
+            if len(verdicts) != len(entries):
+                raise RuntimeError(
+                    f"batch backend returned {len(verdicts)} verdicts "
+                    f"for {len(entries)} items")
         # counts only what actually reached the device dispatch
         self.sigs_device_dispatched.inc(len(entries))
         out = [False] * len(items)

@@ -96,9 +96,16 @@ class TpuEd25519Verifier(IVerifier):
 
     def verify_batch(self, items: Sequence[Tuple[bytes, bytes]]
                      ) -> List[bool]:
-        from tpubft.ops import ed25519 as ops
-        return [bool(x) for x in ops.verify_batch(
-            [(d, s, self.public_key_bytes) for d, s in items])]
+        try:
+            from tpubft.ops import ed25519 as ops
+            return [bool(x) for x in ops.verify_batch(
+                [(d, s, self.public_key_bytes) for d, s in items])]
+        except Exception:  # noqa: BLE001 — device loss (or an OPEN
+            # breaker fast-fail) degrades to the host verifier; the
+            # breaker recorded the failure at the kernel seam
+            from tpubft.crypto.cpu import make_verifier
+            v = make_verifier("ed25519", self.public_key_bytes)
+            return [v.verify(d, s) for d, s in items]
 
     @property
     def signature_length(self) -> int:
@@ -142,7 +149,11 @@ class TpuMultisigEd25519Verifier(MultisigEd25519Verifier):
                 return False
         except (struct.error, IndexError):
             return False
-        return all(verify_batch_items(entries))
+        try:
+            return all(verify_batch_items(entries))
+        except Exception:  # noqa: BLE001 — device loss: the host
+            # multisig check is byte-identical, just serial
+            return super().verify(data, sig)
 
     def verify_share_batch(self, items: Sequence[Tuple[int, bytes, bytes]]
                            ) -> List[bool]:
@@ -158,7 +169,10 @@ class TpuMultisigEd25519Verifier(MultisigEd25519Verifier):
                 ok_shape.append(True)
             else:
                 ok_shape.append(False)
-        verdicts = iter(verify_batch_items(entries))
+        try:
+            verdicts = iter(verify_batch_items(entries))
+        except Exception:  # noqa: BLE001 — degrade to per-share host
+            return [self.verify_share(i, d, s) for i, d, s in items]
         return [next(verdicts) if shaped else False for shaped in ok_shape]
 
 
@@ -178,11 +192,17 @@ class TpuBlsThresholdAccumulator(BlsThresholdAccumulator):
         crossover = int(os.environ.get("TPUBFT_MSM_CROSSOVER_K", "128"))
         if len(self._shares) < crossover and k < crossover:
             return super().get_full_signed_data()
-        from tpubft.ops import bls12_381 as dev
-        ids = sorted(self._shares)[:k]
-        # shares are affine (x, y) int tuples — the device MSM's native input
-        combined = dev.combine_shares(ids, [self._shares[i] for i in ids])
-        return bls.g1_compress(combined)
+        try:
+            from tpubft.ops import bls12_381 as dev
+            ids = sorted(self._shares)[:k]
+            # shares are affine (x, y) int tuples — the device MSM's
+            # native input
+            combined = dev.combine_shares(ids,
+                                          [self._shares[i] for i in ids])
+            return bls.g1_compress(combined)
+        except Exception:  # noqa: BLE001 — device loss: the host
+            # Pippenger combine produces the identical signature
+            return super().get_full_signed_data()
 
 
 class TpuBlsThresholdVerifier(BlsThresholdVerifier):

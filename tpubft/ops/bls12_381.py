@@ -91,8 +91,8 @@ def msm(points: Sequence, scalars: Sequence[int]):
             infinity[i] = True
     px, py = cv.affine_to_device(pts)
     bits = _bits_msb_batch(ks)
-    from tpubft.ops.dispatch import device_dispatch
-    with device_dispatch():
+    from tpubft.ops.dispatch import device_section
+    with device_section("bls_msm"):
         x, y, z = msm_kernel(jnp.asarray(bits), jnp.asarray(px),
                              jnp.asarray(py), jnp.asarray(infinity))
         x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
@@ -134,8 +134,8 @@ def batch_scalar_mul(points: Sequence, scalars: Sequence[int]) -> List:
         acc = cv.scalar_mul_bits(bits, p)
         return acc.x, acc.y, acc.z
 
-    from tpubft.ops.dispatch import device_dispatch
-    with device_dispatch():
+    from tpubft.ops.dispatch import device_section
+    with device_section("bls_mul"):
         x, y, z = kern(jnp.asarray(bits), jnp.asarray(px), jnp.asarray(py),
                        jnp.asarray(infinity))
         x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)

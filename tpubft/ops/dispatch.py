@@ -1,4 +1,5 @@
-"""Device dispatch gate — one execution stream to the accelerator.
+"""Device dispatch gate — one execution stream to the accelerator,
+guarded by the device circuit breaker.
 
 A TPU chip executes one XLA program at a time per core: concurrent
 host threads submitting programs don't overlap on the device, they
@@ -11,16 +12,76 @@ oversubscription collapses throughput far below the serial rate.
 
 Hold the gate for submit→materialize of one batch; never while doing
 host-side crypto or holding protocol locks.
+
+Every kernel call site enters through `device_section(kind)`, which
+wraps the gate in the process-wide device breaker
+(tpubft/utils/breaker.py): device exceptions and latency-SLO breaches
+count against the failure budget, a tripped breaker fast-fails callers
+into their scalar/host fallbacks with `BreakerOpen` instead of queueing
+work behind a dead accelerator transport, and half-open probe batches
+re-admit the device once it recovers. `device_dispatch()` (the raw
+gate) exists ONLY for this module — tools/check_device_seam.py rejects
+any other call site, so no future kernel call can bypass degradation
+handling.
 """
 from __future__ import annotations
 
 import threading
+import time
+
+from tpubft.utils.breaker import BreakerOpen, get_breaker  # noqa: F401
+# re-exported: callers catching the fast-fail import it from here so the
+# ops layer stays the only crypto↔breaker coupling point
 
 # RLock: a gated section may call another gated helper (e.g. a combine
 # that internally runs a gated MSM)
 _gate = threading.RLock()
 
+# ONE breaker for the whole device: the accelerator is a single shared
+# resource — if the transport wedges under the ed25519 kernel, the
+# sha256 batch is just as dead. Per-seam attribution rides the `kind`
+# tag (failures_by_kind in the snapshot).
+_breaker = get_breaker("device")
+
+
+def device_breaker():
+    """The process-wide device circuit breaker (health plane + replica
+    config wiring read/configure it here)."""
+    return _breaker
+
 
 def device_dispatch():
-    """Context manager serializing device program execution."""
+    """Raw context manager serializing device program execution. Only
+    this module may use it — kernels go through `device_section`."""
     return _gate
+
+
+class _Section:
+    """`with device_section(kind):` — breaker admission/classification
+    around the serialized device gate. Raises BreakerOpen without
+    touching the device when tripped."""
+
+    __slots__ = ("_attempt",)
+
+    def __init__(self, kind: str) -> None:
+        self._attempt = _breaker.attempt(kind)
+
+    def __enter__(self):
+        self._attempt.__enter__()
+        # breaker admission happens BEFORE the gate (a tripped breaker
+        # must fast-fail without queueing behind a wedged dispatch that
+        # still holds the gate), so the gate wait lands inside the
+        # attempt's clock — credit it back: queueing behind other
+        # healthy threads' batches is contention, not device slowness
+        t = time.monotonic()
+        _gate.acquire()
+        _breaker.exclude_wait(time.monotonic() - t)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _gate.release()
+        return bool(self._attempt.__exit__(*exc))
+
+
+def device_section(kind: str) -> _Section:
+    return _Section(kind)

@@ -124,9 +124,14 @@ def verify_batch(curve_name: str,
     if curve_name not in _KERNELS:
         _KERNELS[curve_name] = make_verify_kernel(curve_name)
     prep = prepare_batch(curve_name, items)
-    from tpubft.ops.dispatch import device_dispatch
-    with device_dispatch():
+    from tpubft.ops.dispatch import device_section
+    with device_section("ecdsa"):
         out = _KERNELS[curve_name](prep.u1_bits, prep.u2_bits,
                                    prep.qx, prep.qy,
                                    prep.r_raw, prep.r_plus_n_raw)
-        return np.asarray(out) & prep.host_valid
+        out = np.asarray(out)
+        if out.shape[0] < len(items):
+            raise RuntimeError(
+                f"ecdsa kernel returned {out.shape[0]} verdicts "
+                f"for a batch of {len(items)}")
+        return out & prep.host_valid

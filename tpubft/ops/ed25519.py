@@ -416,9 +416,16 @@ def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
         width[axis] = (0, m - n)
         return np.pad(a, width)
 
-    from tpubft.ops.dispatch import device_dispatch
-    with device_dispatch():
+    from tpubft.ops.dispatch import device_section
+    with device_section("ed25519"):
         dev = kernel(pad(prep.s_win, 1), pad(prep.h_win, 1),
                      pad(prep.a_y, 1), pad(prep.a_sign, 0),
                      pad(prep.r_y, 1), pad(prep.r_sign, 0))
-        return np.asarray(dev)[:n] & prep.host_valid
+        out = np.asarray(dev)
+        if out.shape[0] < n:
+            # a garbage device result must classify as a device failure
+            # (breaker), never silently truncate into false verdicts
+            raise RuntimeError(
+                f"ed25519 kernel returned {out.shape[0]} verdicts "
+                f"for a batch of {n}")
+        return out[:n] & prep.host_valid

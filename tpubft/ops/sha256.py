@@ -132,7 +132,10 @@ def sha256_batch(messages: Sequence[bytes]) -> List[bytes]:
     n = len(messages)
     padded_n = 1 << (n - 1).bit_length()
     padded = list(messages) + [messages[0]] * (padded_n - n)
-    out = digest_words_to_bytes(sha256_kernel(jnp.asarray(prepare(padded))))
+    words = prepare(padded)
+    from tpubft.ops.dispatch import device_section
+    with device_section("sha256"):
+        out = digest_words_to_bytes(sha256_kernel(jnp.asarray(words)))
     return out[:n]
 
 
@@ -187,8 +190,10 @@ def sha256_batch_mixed(messages: Sequence[bytes]) -> List[bytes]:
     padded_n = 1 << (n - 1).bit_length()
     padded = list(messages) + [messages[0]] * (padded_n - n)
     words, nblocks = prepare_mixed(padded)
-    out = digest_words_to_bytes(
-        sha256_kernel_masked(jnp.asarray(words), jnp.asarray(nblocks)))
+    from tpubft.ops.dispatch import device_section
+    with device_section("sha256"):
+        out = digest_words_to_bytes(
+            sha256_kernel_masked(jnp.asarray(words), jnp.asarray(nblocks)))
     return out[:n]
 
 

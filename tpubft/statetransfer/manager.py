@@ -272,6 +272,12 @@ class StateTransferManager:
     def is_fetching(self) -> bool:
         return self.state != _IDLE
 
+    @property
+    def last_activity(self) -> float:
+        """Monotonic timestamp of the fetch plane's last send/receive —
+        the health watchdog's progress pulse while `is_fetching`."""
+        return self._last_activity
+
     # ------------------------------------------------------------------
     # consensus upcalls (dispatcher thread)
     # ------------------------------------------------------------------

@@ -144,6 +144,41 @@ class ReplicaConfig:
     # max messages one admission drain cycle pulls from the ingest
     # queue (bounds verify-batch size and admission latency)
     admission_drain_max: int = 256
+    # overload backpressure: when the admission ingest queue reaches the
+    # high watermark the plane enters shed mode — fresh client requests
+    # (ClientRequest/ClientBatch datagrams) are dropped at ingest (each
+    # counted in adm_shed_overload) until depth falls back to the low
+    # watermark. Protocol-critical traffic (view-change family,
+    # checkpoints, state transfer, restart votes) rides a separate
+    # priority queue that shedding never touches and workers drain
+    # first, so an overloaded replica keeps participating in liveness
+    # machinery while client goodput is shed. high = 0 disables
+    # watermark shedding (the hard ingest bound remains).
+    admission_high_watermark: int = 15000
+    admission_low_watermark: int = 5000
+
+    # device circuit breaker (tpubft/utils/breaker.py — process-wide,
+    # wrapped around every device kernel seam): trip OPEN after this
+    # many CONSECUTIVE device failures, fast-failing callers into the
+    # scalar/host engines
+    breaker_failure_threshold: int = 3
+    # how long an OPEN breaker waits before letting one half-open probe
+    # batch re-test the device (doubles on failed probes, up to 16x)
+    breaker_cooldown_ms: int = 2000
+    # latency SLO: a device dispatch slower than this classifies as a
+    # failure even when it succeeds (a wedging accelerator transport
+    # turns slow long before it raises). 0 disables the classifier —
+    # the default, because first-dispatch XLA compiles legitimately
+    # take seconds; enable post-warmup or with a compile-clearing value.
+    breaker_latency_slo_ms: int = 0
+
+    # health plane (tpubft/consensus/health.py): poll cadence of the
+    # watchdog thread and the stall threshold for the dispatcher /
+    # admission probes (the execution lane uses
+    # execution_drain_timeout_ms; state transfer uses st_stall_timeout_ms
+    # scaled by its retry machinery)
+    health_poll_ms: int = 1000
+    health_stall_ms: int = 5000
 
     # execution pipelining (reference: post-execution separation +
     # block accumulation). True = committed slots are executed by a
@@ -158,6 +193,13 @@ class ReplicaConfig:
     # state digests stay comparable cluster-wide. 1 degenerates to
     # per-slot commits (still off the dispatcher).
     execution_max_accumulation: int = 16
+    # how long the dispatcher-side barrier (view-change send/entry,
+    # state-transfer adoption, wedge/barrier batches) waits for the
+    # lane to apply every submitted slot before giving up and retrying
+    # on the next event. The health watchdog uses the same budget as
+    # the lane's stall threshold, so a drain that would time out is
+    # reported (stack dump + verdict) instead of silently eaten.
+    execution_drain_timeout_ms: int = 30000
 
     # retransmissions
     retransmissions_enabled: bool = True
@@ -219,6 +261,18 @@ class ReplicaConfig:
             raise ValueError("admission_workers must be >= 0")
         if self.admission_drain_max < 1:
             raise ValueError("admission_drain_max must be >= 1")
+        if self.admission_high_watermark \
+                and not 0 < self.admission_low_watermark \
+                < self.admission_high_watermark:
+            raise ValueError("need 0 < admission_low_watermark < "
+                             "admission_high_watermark (or high = 0 to "
+                             "disable overload shedding)")
+        if self.execution_drain_timeout_ms < 1:
+            raise ValueError("execution_drain_timeout_ms must be >= 1")
+        if self.breaker_failure_threshold < 1:
+            raise ValueError("breaker_failure_threshold must be >= 1")
+        if self.health_poll_ms < 1 or self.health_stall_ms < 1:
+            raise ValueError("health_poll_ms/health_stall_ms must be >= 1")
 
     # ---- serialization ----
     def to_json(self) -> str:
