@@ -1,6 +1,8 @@
 """Byzantine-behavior tests: forged client requests in a PrePrepare must
 be rejected by backups; replayed requests must not re-execute; forwarded
-client requests must still be admitted."""
+client requests must still be admitted; a wrong-digest or genuinely
+equivocating primary (WrapCommunication strategy framework) must be
+view-changed away while the honest quorum still commits."""
 import time
 
 from tpubft.apps import counter
@@ -68,3 +70,87 @@ def test_forwarded_client_request_reaches_primary():
         v = counter.decode_reply(
             cl.send_write(counter.encode_add(3), timeout_ms=15000))
         assert v == 3
+
+
+_FAST_VC = {"view_change_timer_ms": 900}
+
+
+def _wait_value(cluster, replicas, expected, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(cluster.handlers[r].value == expected for r in replicas):
+            return
+        time.sleep(0.05)
+    got = {r: cluster.handlers[r].value for r in replicas}
+    raise AssertionError(f"replicas never converged on {expected}: {got}")
+
+
+def test_corrupt_preprepare_primary_is_viewchanged_away():
+    """Wrong-digest primary (corrupt-preprepare strategy wraps replica
+    0's transport): every proposal it broadcasts carries a bit-flipped
+    requests_digest under a stale signature. Backups must reject it,
+    view-change away, and the honest quorum commits the request."""
+    with InProcessCluster(f=1, byzantine={0: "corrupt-preprepare"},
+                          cfg_overrides=dict(_FAST_VC)) as cluster:
+        cl = cluster.client()
+        v = counter.decode_reply(
+            cl.send_write(counter.encode_add(7), timeout_ms=30000))
+        assert v == 7
+        for r in (1, 2, 3):
+            assert cluster.replicas[r].view >= 1, \
+                f"replica {r} never left the corrupt primary's view"
+        _wait_value(cluster, (1, 2, 3), 7)
+
+
+def test_equivocating_primary_commits_exactly_one_fork():
+    """Genuinely equivocating primary (equivocate strategy, re-signed
+    forks): odd-id backups receive a validly signed VARIANT of each
+    PrePrepare, even-id backups the original — no digest can reach a
+    commit quorum in view 0. The view change must resolve exactly one
+    fork: the write applies once and all honest replicas converge."""
+    with InProcessCluster(f=1, byzantine={0: "equivocate"},
+                          cfg_overrides=dict(_FAST_VC)) as cluster:
+        cl = cluster.client()
+        v = counter.decode_reply(
+            cl.send_write(counter.encode_add(9), timeout_ms=45000))
+        assert v == 9  # exactly-once across the fork
+        for r in (1, 2, 3):
+            assert cluster.replicas[r].view >= 1, \
+                f"replica {r} never left the equivocating primary's view"
+        _wait_value(cluster, (1, 2, 3), 9)
+
+
+def test_equivocate_strategy_resigns_valid_fork():
+    """Unit-level contract of the equivocate mutator: the fork sent to
+    odd-id destinations parses, differs in requests_digest, and carries
+    a VALID signature over the mutated payload (that validity is what
+    separates equivocation from a wrong-digest primary)."""
+    from tpubft.consensus.keys import ClusterKeys
+    from tpubft.testing.byzantine import _Equivocate
+    from tpubft.utils.config import ReplicaConfig
+
+    keys = ClusterKeys.generate(ReplicaConfig(f_val=1),
+                                num_clients=2).for_node(0)
+    eq = _Equivocate(signer=keys.my_signer())
+    reqs = [m.ClientRequestMsg(sender_id=4, req_seq_num=i, flags=0,
+                               request=counter.encode_add(i + 1),
+                               cid=f"c{i}", signature=b"\x00" * 64).pack()
+            for i in range(2)]
+    pp = m.PrePrepareMsg(
+        sender_id=0, view=0, seq_num=1,
+        first_path=int(m.CommitPath.SLOW), time=0,
+        requests_digest=m.PrePrepareMsg.compute_requests_digest(reqs),
+        requests=reqs, signature=b"")
+    pp.signature = keys.my_signer().sign(pp.signed_payload())
+    wire = pp.pack()
+
+    assert eq(2, wire) == wire, "even-id destination must see the original"
+    forked = eq(1, wire)
+    assert forked is not None and forked != wire
+    fork = m.unpack(forked)
+    assert fork.requests_digest != pp.requests_digest
+    assert len(fork.requests) == len(pp.requests) - 1
+    verifier = keys.verifier_of(0)
+    assert verifier.verify(fork.signed_payload(), fork.signature), \
+        "fork must be validly re-signed (else it's just a corrupt PP)"
+    assert verifier.verify(pp.signed_payload(), pp.signature)

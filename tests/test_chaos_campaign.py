@@ -1,0 +1,170 @@
+"""Tier-1 wiring for the chaos-campaign engine (tpubft/testing/campaign
++ benchmarks/bench_chaos.py --smoke shape): the replay-determinism
+contract — same seed ⇒ identical event-log digest — plus a fast slice
+of the scenario matrix run twice end-to-end, and the artifact shape
+bench_chaos.py publishes (seed, event log digest, per-scenario verdicts,
+recovery stats, PR 4's probe_error convention on degraded runs). The
+full matrix (real-subprocess kills, SIGSTOP partitions, env-triggered
+crashpoints) runs via `python -m benchmarks.bench_chaos`; the slow
+marker covers the complete in-process smoke matrix."""
+import json
+
+import pytest
+
+from tpubft.testing import campaign as cmp
+
+
+# ----------------------------------------------------------------------
+# pure determinism units (no clusters)
+# ----------------------------------------------------------------------
+
+
+def test_event_log_digest_is_order_and_content_sensitive():
+    a, b = cmp.EventLog(), cmp.EventLog()
+    for log in (a, b):
+        log.append("s1", "kill", replica=0)
+        log.append("s1", "draw", label="add", value=7)
+    assert a.digest() == b.digest()
+    b.append("s1", "heal")
+    assert a.digest() != b.digest()
+    c = cmp.EventLog()
+    c.append("s1", "draw", label="add", value=7)
+    c.append("s1", "kill", replica=0)
+    assert c.digest() != a.digest(), "digest must bind event ORDER"
+
+
+def test_sub_seed_isolates_scenarios():
+    """Each scenario's RNG derives from (master, name): adding or
+    reordering scenarios never perturbs another scenario's draws."""
+    assert cmp.sub_seed(1, "a") == cmp.sub_seed(1, "a")
+    assert cmp.sub_seed(1, "a") != cmp.sub_seed(1, "b")
+    assert cmp.sub_seed(1, "a") != cmp.sub_seed(2, "a")
+    log = cmp.EventLog()
+    ctx = cmp.ScenarioContext("a", 1, log, "/tmp")
+    draws = [ctx.randint("x", 0, 10**9) for _ in range(4)]
+    ctx2 = cmp.ScenarioContext("a", 1, cmp.EventLog(), "/tmp")
+    assert [ctx2.randint("x", 0, 10**9) for _ in range(4)] == draws
+
+
+def test_matrix_names_unique_and_wellformed():
+    specs = cmp.full_matrix()
+    names = [s.name for s in specs]
+    assert len(names) == len(set(names)), "duplicate scenario names"
+    assert all(s.kind in ("inproc", "process") for s in specs)
+    assert all(s.time_budget_s > 0 for s in specs)
+    # the matrix the acceptance bar names: >= 6 entries, a compound
+    # breaker+view-change run, and two crashpoint recovery drills
+    assert len(specs) >= 6
+    tags = {s.name: set(s.tags) for s in specs}
+    assert any({"compound", "view-change"} <= t for t in tags.values())
+    assert sum(1 for t in tags.values() if "crashpoint" in t) >= 2
+
+
+def test_failing_scenario_yields_red_verdict_not_crash():
+    def boom(ctx):
+        ctx.event("inject", what="nothing")
+        raise AssertionError("invariant X violated")
+
+    spec = cmp.ScenarioSpec("always-red", boom, "inproc", 5)
+    art = cmp.ChaosCampaign(seed=7, specs=[spec]).run()
+    assert art["failed"] == 1 and art["passed"] == 0
+    v = art["scenarios"][0]
+    assert not v["ok"] and "invariant X" in v["error"]
+    # the schedule prefix it DID execute is still digested/replayable
+    assert any(e["action"] == "inject" for e in art["event_log"])
+
+
+# ----------------------------------------------------------------------
+# end-to-end slice: two scenarios, run twice, digests must match
+# ----------------------------------------------------------------------
+
+_SLICE = ("crashpoint-exec-post-apply", "breaker-viewchange")
+
+
+def _run_slice():
+    by_name = cmp.matrix_by_name()
+    return cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,
+                             specs=[by_name[n] for n in _SLICE]).run()
+
+
+def test_campaign_slice_replays_identically():
+    """A crashpoint recovery drill + the compound breaker/view-change
+    scenario, run twice with the same seed: all green both times, and
+    the event-log digests are byte-identical (the property that makes a
+    red seed attachable to a bug report)."""
+    first = _run_slice()
+    assert first["failed"] == 0, json.dumps(first["scenarios"], indent=1)
+    second = _run_slice()
+    assert second["failed"] == 0, json.dumps(second["scenarios"], indent=1)
+    assert first["event_log_digest"] == second["event_log_digest"]
+    # recovery stats exist but live OUTSIDE the digested schedule
+    assert set(first["recovery_s"]) == set(_SLICE)
+    # the compound scenario ran degraded: PR 4's artifact convention
+    assert first["degraded"] and "breaker" in first["probe_error"]
+
+
+@pytest.mark.slow
+def test_full_smoke_matrix_green():
+    art = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,
+                            specs=cmp.smoke_matrix()).run()
+    assert art["failed"] == 0, json.dumps(art["scenarios"], indent=1)
+
+
+def test_bench_chaos_cli_shape(tmp_path, capsys):
+    """bench_chaos --smoke artifact/record shape without paying for the
+    matrix: a stub scenario rides the real CLI path (artifact file, one
+    JSON line, exit code)."""
+    import benchmarks.bench_chaos as bc
+
+    def tiny(ctx):
+        ctx.event("noop")
+        return {"recovery_s": 0.0}
+
+    spec = cmp.ScenarioSpec("tiny", tiny, "inproc", 5)
+    out = tmp_path / "CHAOS_test.json"
+    orig = cmp.smoke_matrix
+    cmp.smoke_matrix = lambda: [spec]
+    try:
+        rc = bc.main(["--smoke", "--seed", "42", "--out", str(out),
+                      "--replay-check"])
+    finally:
+        cmp.smoke_matrix = orig
+    assert rc == 0
+    art = json.loads(out.read_text())
+    assert art["seed"] == 42 and art["passed"] == 1
+    assert art["replay_check"]["match"] is True
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["unit"] == "scenarios" and line["value"] == 1
+    assert line["seed"] == 42 and line["replay_match"] is True
+    assert line["event_log_digest"] == art["event_log_digest"]
+    assert art["replay_check"]["second_failed"] == []
+
+
+def test_bench_chaos_replay_red_second_pass_fails(capsys):
+    """A scenario that goes red only on the replay pass — identical
+    schedule, nondeterministic outcome, the exact bug class
+    --replay-check exists to surface — must fail the run even though
+    the digests match."""
+    import benchmarks.bench_chaos as bc
+
+    calls = {"n": 0}
+
+    def flaky(ctx):
+        ctx.event("noop")           # same schedule both passes
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise AssertionError("recovery raced")
+        return {}
+
+    spec = cmp.ScenarioSpec("flaky", flaky, "inproc", 5)
+    orig = cmp.smoke_matrix
+    cmp.smoke_matrix = lambda: [spec]
+    try:
+        rc = bc.main(["--smoke", "--seed", "7", "--no-artifact",
+                      "--replay-check"])
+    finally:
+        cmp.smoke_matrix = orig
+    assert rc == 1
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["replay_match"] is True        # digests DID match
+    assert line["replay_failed"] == ["flaky"]  # but the rerun went red

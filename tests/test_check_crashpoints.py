@@ -1,0 +1,114 @@
+"""Tier-1 wiring for the crashpoint lint (tools/check_crashpoints.py):
+every seam/drill reference to a crashpoint name must exist in
+crashpoints.REGISTRY, every REGISTRY entry must be threaded at a real
+durability seam, and a scan that finds nothing must fail loudly — a
+renamed seam would otherwise turn its recovery drill into a timeout
+that asserts nothing."""
+import importlib.util
+import os
+import textwrap
+
+_TOOL = os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "check_crashpoints.py")
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_crashpoints",
+                                                  os.path.abspath(_TOOL))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_tree(tmp_path, registry_body: str, extra: dict):
+    """Minimal scan tree: a crashpoints.py with the given REGISTRY plus
+    {relpath: source} extra modules."""
+    cp_dir = tmp_path / "tpubft" / "testing"
+    cp_dir.mkdir(parents=True)
+    (cp_dir / "crashpoints.py").write_text(
+        "REGISTRY = {\n%s}\n\n"
+        "def crashpoint(name, rid=None):\n    pass\n" % registry_body)
+    for rel, src in extra.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+
+def test_repo_registry_seams_and_drills_agree():
+    tool = _load_tool()
+    violations = tool.find_violations(_ROOT)
+    assert violations == [], (
+        "crashpoint registry/seam/drill drift:\n"
+        + "\n".join(f"{p}:{ln}: {msg}" for p, ln, msg in violations))
+
+
+def test_lint_catches_unregistered_and_unthreaded_names(tmp_path):
+    tool = _load_tool()
+    _write_tree(tmp_path, '    "a.real": "doc",\n    "b.phantom": "doc",\n', {
+        # a.real is threaded at a production seam; b.phantom is not
+        "tpubft/consensus/mod.py":
+            'from tpubft.testing.crashpoints import crashpoint\n'
+            'def f(rid):\n'
+            '    crashpoint("a.real", rid=rid)\n'
+            '    crashpoint("c.unknown", rid=rid)\n',
+        # tests referencing an unknown name via arm() and via env spec
+        "tests/test_drill.py":
+            'from tpubft.testing.crashpoints import arm\n'
+            'def test_x(net):\n'
+            '    arm("d.unknown", rid=2)\n'
+            '    net.restart_replica(2, extra_env={\n'
+            '        "TPUBFT_CRASHPOINT": "e.unknown:2"})\n',
+    })
+    violations = tool.find_violations(str(tmp_path))
+    msgs = "\n".join(m for _, _, m in violations)
+    assert "'c.unknown'" in msgs and "unregistered" in msgs
+    assert "'d.unknown'" in msgs
+    assert "'e.unknown'" in msgs          # env-spec form, hit count split
+    assert "'b.phantom'" in msgs and "not threaded" in msgs
+    assert "'a.real'" not in msgs
+
+
+def test_lint_requires_literal_seam_names(tmp_path):
+    """A computed crashpoint() name defeats grep-driven drills; arm()
+    loops over the registry stay legal (the harness may iterate)."""
+    tool = _load_tool()
+    _write_tree(tmp_path, '    "a.real": "doc",\n', {
+        "tpubft/consensus/mod.py":
+            'from tpubft.testing.crashpoints import crashpoint\n'
+            'def f(which):\n'
+            '    crashpoint("a.real")\n'
+            '    crashpoint("a." + which)\n',
+        "tests/test_drill.py":
+            'from tpubft.testing.crashpoints import REGISTRY, arm\n'
+            'def test_all():\n'
+            '    for n in REGISTRY:\n'
+            '        arm(n)\n',
+    })
+    violations = tool.find_violations(str(tmp_path))
+    assert len(violations) == 1, violations
+    assert "string literal" in violations[0][2]
+    assert violations[0][0] == os.path.join("tpubft", "consensus", "mod.py")
+
+
+def test_lint_fails_when_nothing_scanned(tmp_path):
+    tool = _load_tool()
+    violations = tool.find_violations(str(tmp_path / "nonexistent"))
+    assert len(violations) == 1
+    assert "wrong root" in violations[0][2]
+
+
+def test_lint_fails_on_zero_seams(tmp_path):
+    """A registry whose every seam was refactored away must fail even
+    if no name is individually wrong (phantom coverage)."""
+    tool = _load_tool()
+    _write_tree(tmp_path, '    "a.real": "doc",\n', {
+        "tests/test_drill.py":
+            'from tpubft.testing.crashpoints import arm\n'
+            'def test_x():\n'
+            '    arm("a.real")\n',
+    })
+    violations = tool.find_violations(str(tmp_path))
+    msgs = "\n".join(m for _, _, m in violations)
+    assert "not threaded" in msgs
+    assert "zero crashpoint seams" in msgs

@@ -62,7 +62,11 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
         comm_cfg = CommConfig(self_id=args.replica, endpoints=eps)
     comm = create_communication(comm_cfg, args.transport)
     if comm_wrapper is not None:
-        comm = comm_wrapper(comm)
+        # byzantine strategies that re-sign mutated messages (equivocate)
+        # get the replica's own signing key — the reference's strategies
+        # likewise live inside the tester replica, key in hand
+        comm = comm_wrapper(comm, signer=keys.my_signer()
+                            if keys.my_sign_seed else None)
     db_path = (os.path.join(args.db_dir, f"replica-{args.replica}.kvlog")
                if args.db_dir else None)
     agg = Aggregator()
@@ -146,8 +150,9 @@ def main() -> None:
     if args.fault_port is not None:
         from tpubft.testing.faults import FaultyComm
 
-        def wrap_faulty(inner, _prev=comm_wrapper):
-            return FaultyComm(_prev(inner) if _prev is not None else inner)
+        def wrap_faulty(inner, signer=None, _prev=comm_wrapper):
+            return FaultyComm(_prev(inner, signer=signer)
+                              if _prev is not None else inner)
 
         comm_wrapper = wrap_faulty
     kr = build_replica(args, comm_wrapper)

@@ -42,6 +42,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from tpubft.storage.interfaces import WriteBatch
+from tpubft.testing.crashpoints import crashpoint
 from tpubft.utils.logging import get_logger, mdc_scope
 from tpubft.utils.racecheck import get_watchdog, make_lock
 
@@ -263,6 +264,7 @@ class ExecutionLane:
         # must never requeue the run, or the retry would re-execute
         # requests whose blocks are already durable (duplicate blocks,
         # permanent state divergence). ----
+        crashpoint("exec.pre_apply", rid=r.id)
         t0 = time.perf_counter()
         folded = False
         if acc:
@@ -284,6 +286,7 @@ class ExecutionLane:
                     log.exception("run [%d..%d]: reply-pages batch "
                                   "failed post point-of-no-return",
                                   result.first, result.last)
+            crashpoint("exec.post_apply", rid=r.id)
             commit_ms = (time.perf_counter() - t0) * 1e3
             # the run is durable: NOW the at-most-once/reply-cache
             # records become visible (crash before this point replays

@@ -80,6 +80,12 @@ class PersistedState:
     last_executed_seq: int = 0
     last_stable_seq: int = 0
     in_view_change: bool = False
+    # target view of an in-flight view change (0 = none): a replica that
+    # crashes between persisting in_view_change and completing the change
+    # must know WHICH view it was moving to, or it cannot rebuild and
+    # retransmit its ViewChangeMsg on restart (the quorum may be counting
+    # on it)
+    pending_view: int = 0
     seq_states: Dict[int, PersistedSeqState] = None  # set in __post_init__
     # view-change safety state (reference PersistentStorageDescriptors):
     # packed view_change.Restriction / messages.PreparedCertificate blobs
@@ -195,6 +201,7 @@ class FilePersistentStorage(PersistentStorage):
         return {
             "v": st.last_view, "e": st.last_executed_seq,
             "s": st.last_stable_seq, "ivc": st.in_view_change,
+            "pv": st.pending_view,
             "seqs": {str(k): {
                 "pp": b64(v.pre_prepare), "pf": b64(v.prepare_full),
                 "cf": b64(v.commit_full), "fcp": b64(v.full_commit_proof),
@@ -213,6 +220,7 @@ class FilePersistentStorage(PersistentStorage):
             return base64.b64decode(x) if x is not None else None
         st = PersistedState(last_view=d["v"], last_executed_seq=d["e"],
                             last_stable_seq=d["s"], in_view_change=d["ivc"],
+                            pending_view=d.get("pv", 0),
                             restrictions=[unb64(r)
                                           for r in d.get("restr", [])],
                             carried_certs=[unb64(c)

@@ -56,6 +56,7 @@ from tpubft.crypto.digest import digest as sha256
 # re-run these per request per slot (function-level `import` still pays
 # a sys.modules lookup + binding on every execution)
 from tpubft.diagnostics import TimeRecorder
+from tpubft.testing.crashpoints import crashpoint
 from tpubft.testing.slowdown import PHASE_EXECUTE
 from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.logging import get_logger, mdc_scope
@@ -212,7 +213,13 @@ class Replica(IReceiver):
         self.vc = ViewChangeState(self.info.complaint_quorum,
                                   self.info.view_change_quorum)
         self.in_view_change = st.in_view_change
-        self.pending_view: Optional[int] = None
+        # restore the in-flight target too: a crash after vc.persist must
+        # resume the SAME view change (start() retransmits the rebuilt
+        # ViewChangeMsg — peers may be counting this replica toward the
+        # view-change quorum)
+        self.pending_view: Optional[int] = (
+            st.pending_view if st.in_view_change and st.pending_view
+            else None)
         # safety state surviving crashes mid-view-change (the reference
         # persists view-change descriptors, PersistentStorageDescriptors):
         # restrictions = what the current view's primary must re-propose;
@@ -644,6 +651,15 @@ class Replica(IReceiver):
             self.incoming.push_internal("repropose", None)
         self.dispatcher.register_internal("repropose",
                                           lambda _: self._repropose())
+        # crash between persisting view-change intent (vc.persist seam)
+        # and the change completing: resume it — rebuild and retransmit
+        # our ViewChangeMsg from the persisted evidence (peers may need
+        # it to reach the view-change quorum). Runs on the dispatcher so
+        # it serializes with incoming view-change traffic.
+        self.dispatcher.register_internal("resume_vc",
+                                          self._resume_view_change)
+        if self.in_view_change and (self.pending_view or 0) > self.view:
+            self.incoming.push_internal("resume_vc", None)
         if self.exec_lane is not None:
             self.exec_lane.start()
         if self.admission is not None:
@@ -1384,6 +1400,14 @@ class Replica(IReceiver):
         (CollectorOfThresholdSignatures::addMsgWithPartialSignature)."""
         if msg.view != self.view or not self.info.is_replica(msg.sender_id):
             return
+        if self.in_view_change:
+            # ordering in this view is frozen and _on_combine_result
+            # discards results while the change is in flight: a share
+            # accepted here can only launch combines that cannot land.
+            # Under a breaker-OPEN + view-change storm those combines run
+            # on the scalar fallback — stale-view shares were burning the
+            # exact CPU the degraded cluster needs to finish the change.
+            return
         if not self.window.in_window(msg.seq_num) \
                 or msg.seq_num <= self.last_stable:
             return
@@ -1894,6 +1918,7 @@ class Replica(IReceiver):
                 self.m_last_executed.set(run.last)
             with self._tran() as st:
                 st.last_executed_seq = self.last_executed
+            crashpoint("meta.watermark", rid=self.id)
             self._last_progress = time.monotonic()
             if run.checkpoint is not None:
                 seq, state_digest, pages_digest = run.checkpoint
@@ -2310,6 +2335,7 @@ class Replica(IReceiver):
         """onSeqNumIsStable: slide the work window, GC old state."""
         if seq <= self.last_stable:
             return
+        crashpoint("ckpt.stable", rid=self.id)
         log.debug("checkpoint stable at seq %d", seq)
         # checkpoint-era key expiry (reference CryptoManager per-era keys)
         self.sig.on_stable(seq)
@@ -2484,8 +2510,38 @@ class Replica(IReceiver):
         self.vc.add_view_change(vc)
         with self._tran() as st:
             st.in_view_change = True
+            st.pending_view = target
             st.carried_certs = [pack_cert(c) for c in certs]
             st.carried_bodies = list(self.vc_bodies.values())
+        crashpoint("vc.persist", rid=self.id)
+        self._broadcast(vc)
+        self._try_complete_view_change(target)
+
+    def _resume_view_change(self, _payload=None) -> None:
+        """Crash recovery mid-view-change: in_view_change/pending_view
+        were persisted (the vc.persist seam) but the change never
+        completed. Rebuild the ViewChangeMsg from the persisted evidence
+        and retransmit. The rebuild is deterministic over persisted state
+        (carried_certs, last_stable), so peers that already hold our
+        pre-crash message see an identical digest — a NewViewMsg formed
+        from either copy resolves."""
+        target = self.pending_view or 0
+        if not self.in_view_change or target <= self.view:
+            return
+        if self._my_vc_msg is not None:
+            return                        # already rebuilt/resumed
+        self._vc_started_at = time.monotonic()
+        certs = sorted(self.carried_certs.values(),
+                       key=lambda c: (c.seq_num, c.kind))
+        vc = m.ViewChangeMsg(sender_id=self.id, new_view=target,
+                             last_stable_seq=self.last_stable,
+                             prepared=certs, signature=b"",
+                             epoch=self.epoch)
+        vc.signature = self.sig.sign(vc.signed_payload())
+        self._my_vc_msg = vc
+        self.vc.add_view_change(vc)
+        log.info("resuming view change to %d after restart "
+                 "(%d carried certs)", target, len(certs))
         self._broadcast(vc)
         self._try_complete_view_change(target)
 
@@ -2702,12 +2758,14 @@ class Replica(IReceiver):
         with self._tran() as st:
             st.last_view = new_view
             st.in_view_change = False
+            st.pending_view = 0
             st.seq_states.clear()
             st.restrictions = [pack_restriction(r)
                                for r in restrictions.values()]
             st.carried_certs = [pack_cert(c)
                                 for c in self.carried_certs.values()]
             st.carried_bodies = list(self.vc_bodies.values())
+        crashpoint("vc.enter", rid=self.id)
         if self.is_primary:
             self._repropose()
 

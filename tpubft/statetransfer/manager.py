@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from tpubft.kvbc.blockchain import BlockchainError, KeyValueBlockchain
 from tpubft.statetransfer import messages as stm
 from tpubft.statetransfer.rvt import RangeValidationTree, RvtProof
+from tpubft.testing.crashpoints import crashpoint
 from tpubft.utils import serialize as ser
 from tpubft.utils.metrics import Aggregator, Component, Meter
 from tpubft.utils.tracing import Span, get_tracer
@@ -719,6 +720,7 @@ class StateTransferManager:
                 leaves, [rng.proofs[b] for b in range(rng.lo, rng.hi + 1)]):
             self._punish_range(rng, "rvt mismatch")
             return
+        crashpoint("st.window_adopt", rid=self.id)
         self.bc.add_raw_st_blocks(rng.raws)
         for b in rng.raws:
             self._staged_src[b] = rng.source

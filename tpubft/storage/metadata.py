@@ -150,8 +150,9 @@ class DBPersistentStorage(PersistentStorage):
     # ---- codecs ----
     def _pack_desc(self) -> bytes:
         st = self._state
-        return struct.pack("<qqqB", st.last_view, st.last_executed_seq,
-                           st.last_stable_seq, 1 if st.in_view_change else 0)
+        return struct.pack("<qqqqB", st.last_view, st.last_executed_seq,
+                           st.last_stable_seq, st.pending_view,
+                           1 if st.in_view_change else 0)
 
     def _pack_vc(self) -> bytes:
         st = self._state
@@ -195,9 +196,14 @@ class DBPersistentStorage(PersistentStorage):
             st.clear_tracking()
             self._legacy = True
             return st
-        v, e, s, ivc = struct.unpack("<qqqB", desc)
+        if len(desc) == struct.calcsize("<qqqB"):   # pre-pending_view row
+            v, e, s, ivc = struct.unpack("<qqqB", desc)
+            pv = 0
+        else:
+            v, e, s, pv, ivc = struct.unpack("<qqqqB", desc)
         st = PersistedState(last_view=v, last_executed_seq=e,
-                            last_stable_seq=s, in_view_change=ivc == 1)
+                            last_stable_seq=s, pending_view=pv,
+                            in_view_change=ivc == 1)
         vc = self._db.get(_KEY_VC, _FAMILY)
         if vc:
             mv = memoryview(vc)

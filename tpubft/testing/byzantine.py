@@ -92,6 +92,61 @@ def _corrupt_shares(dest: NodeNum, data: bytes) -> Optional[bytes]:
     return data
 
 
+class _Equivocate:
+    """A genuinely equivocating primary: odd-id destinations receive a
+    validly re-signed VARIANT of every outgoing PrePrepare (the batch's
+    last request dropped, requests_digest recomputed), even-id
+    destinations the original. Both proposals verify, so the backups
+    split across two digests for the same (view, seq) — no digest can
+    reach a commit quorum and the cluster must view-change away without
+    ever committing both. Needs the replica's signer (the in-process
+    cluster and the tester replica both have it); without one the
+    variant keeps the stale signature and degrades to a wrong-digest
+    primary (receivers reject the fork outright)."""
+
+    def __init__(self, signer=None) -> None:
+        self._signer = signer
+
+    def __call__(self, dest: NodeNum, data: bytes) -> Optional[bytes]:
+        from tpubft.consensus import messages as cm
+        if _msg_code(data) != int(cm.MsgCode.PrePrepare) \
+                or int(dest) % 2 == 0:
+            return data
+        try:
+            pp = cm.unpack(data)
+        except cm.MsgError:
+            return data
+        reqs = list(pp.requests[:-1])
+        fork = cm.PrePrepareMsg(
+            sender_id=pp.sender_id, view=pp.view, seq_num=pp.seq_num,
+            first_path=pp.first_path, time=pp.time,
+            requests_digest=cm.PrePrepareMsg.compute_requests_digest(reqs),
+            requests=reqs, signature=pp.signature, epoch=pp.epoch)
+        if self._signer is not None:
+            fork.signature = self._signer.sign(fork.signed_payload())
+        return fork.pack()
+
+
+def _corrupt_preprepare(dest: NodeNum, data: bytes) -> Optional[bytes]:
+    """Wrong-digest primary: every outgoing PrePrepare's requests_digest
+    is bit-flipped while the (now stale) signature rides along. Receivers
+    must reject it at parse/verify — from the cluster's viewpoint the
+    primary proposes garbage and must be view-changed away."""
+    from tpubft.consensus import messages as cm
+    if _msg_code(data) != int(cm.MsgCode.PrePrepare):
+        return data
+    try:
+        pp = cm.unpack(data)
+    except cm.MsgError:
+        return data
+    bad = bytes([pp.requests_digest[0] ^ 0xFF]) + pp.requests_digest[1:]
+    fork = cm.PrePrepareMsg(
+        sender_id=pp.sender_id, view=pp.view, seq_num=pp.seq_num,
+        first_path=pp.first_path, time=pp.time, requests_digest=bad,
+        requests=pp.requests, signature=pp.signature, epoch=pp.epoch)
+    return fork.pack()
+
+
 class _RandomDrop:
     def __init__(self, rate: float, seed: int = 0xBF7) -> None:
         self._rate = rate
@@ -155,21 +210,33 @@ class _Delay:
         return None
 
 
-STRATEGIES: Dict[str, Callable[[], Callable]] = {
-    # reference strategy analogs (ByzantineStrategy.hpp implementations)
-    "silent": lambda: _drop_all,                       # mute replica
-    "silent-preprepare": lambda: _silent_preprepare,   # primary withholds PP
-    "corrupt-shares": lambda: _corrupt_shares,         # bad threshold shares
-    "drop-20": lambda: _RandomDrop(0.2),               # lossy links
-    "drop-50": lambda: _RandomDrop(0.5),
+STRATEGIES: Dict[str, Callable[..., Callable]] = {
+    # reference strategy analogs (ByzantineStrategy.hpp implementations);
+    # every factory takes an optional signer=... (the wrapped replica's
+    # own signing key) — only the re-signing strategies use it
+    "silent": lambda signer=None: _drop_all,           # mute replica
+    "silent-preprepare":
+        lambda signer=None: _silent_preprepare,        # primary withholds PP
+    "corrupt-shares":
+        lambda signer=None: _corrupt_shares,           # bad threshold shares
+    "corrupt-preprepare":
+        lambda signer=None: _corrupt_preprepare,       # wrong-digest primary
+    "equivocate":
+        lambda signer=None: _Equivocate(signer),       # two-faced primary
+    "drop-20": lambda signer=None: _RandomDrop(0.2),   # lossy links
+    "drop-50": lambda signer=None: _RandomDrop(0.5),
 }
 
 
-def strategy_wrapper(name: str) -> Callable[[ICommunication], ICommunication]:
+def strategy_wrapper(name: str) -> Callable[..., ICommunication]:
+    """Returns wrap(inner, signer=None): the per-name communication
+    wrapper. `signer` is the wrapped replica's own signing key, forwarded
+    to strategies that need to re-sign mutated messages (equivocate)."""
     if name.startswith("delay-"):
         delay_ms = int(name.split("-", 1)[1])
 
-        def wrap_delay(inner: ICommunication) -> ICommunication:
+        def wrap_delay(inner: ICommunication,
+                       signer=None) -> ICommunication:
             d = _Delay(delay_ms / 1000.0)
             d.bind(inner)
 
@@ -184,6 +251,6 @@ def strategy_wrapper(name: str) -> Callable[[ICommunication], ICommunication]:
         raise ValueError(f"unknown byzantine strategy {name!r}; "
                          f"have {sorted(STRATEGIES)} + delay-<ms>")
 
-    def wrap(inner: ICommunication) -> ICommunication:
-        return WrapCommunication(inner, STRATEGIES[name]())
+    def wrap(inner: ICommunication, signer=None) -> ICommunication:
+        return WrapCommunication(inner, STRATEGIES[name](signer=signer))
     return wrap

@@ -1,0 +1,874 @@
+"""Deterministic, seeded chaos-campaign engine.
+
+Every adversarial scenario this repo grew so far — Apollo-style process
+kills, SIGSTOP partitions, per-link drop planes, byzantine strategies,
+breaker trips — existed as one-off tests drawing from unseeded RNGs: a
+failure that showed up once could not be replayed. This module composes
+those primitives into a *campaign*: a matrix of named scenarios where
+
+  * every random draw flows from one ``random.Random(seed)`` (each
+    scenario gets a sub-RNG derived as SHA-256(master_seed, name), so
+    adding or reordering scenarios never perturbs the others' draws);
+  * every scheduled action and draw is appended to an **event log**
+    whose canonical-JSON SHA-256 digest is the campaign's identity —
+    running the same seed twice yields the identical digest, so a red
+    run attaches ``(seed, digest)`` to the bug report and anyone
+    replays the exact fault schedule;
+  * verdicts, recovery-time stats, and wall-clock live OUTSIDE the
+    digest (they are measurements, not schedule).
+
+Two scenario kinds: ``inproc`` (InProcessCluster over the loopback bus —
+the tier-1 smoke matrix; seconds per scenario) and ``process`` (real
+replica subprocesses via BftTestNetwork with SIGSTOP/SIGKILL and the
+per-link fault plane — the full matrix, run by ``bench_chaos.py``).
+
+Recovery invariants asserted by every scenario that crashes something:
+exactly-once replay (no double-applied request), no ledger divergence
+(all live replicas converge on the same state), and re-convergence
+within the scenario's time budget.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+DEFAULT_SEED = 20260803
+
+# ----------------------------------------------------------------------
+# event log + context
+# ----------------------------------------------------------------------
+
+
+class EventLog:
+    """Append-only schedule record. Only *scheduled* facts belong here
+    (injected faults, seeded draws, logical step order) — never
+    wall-clock readings or measured outcomes, which would break the
+    replay-digest contract."""
+
+    def __init__(self) -> None:
+        self.events: List[dict] = []
+
+    def append(self, scenario: str, action: str, **params) -> None:
+        self.events.append({"i": len(self.events), "scenario": scenario,
+                            "action": action, **params})
+
+    def digest(self) -> str:
+        blob = json.dumps(self.events, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+
+def sub_seed(master: int, name: str) -> int:
+    h = hashlib.sha256(f"{master}:{name}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+class ScenarioContext:
+    """One scenario's handle: its derived RNG, its slice of the event
+    log, a scratch dir, and polling helpers."""
+
+    def __init__(self, name: str, master_seed: int, log: EventLog,
+                 tmp_root: str) -> None:
+        import random
+        self.name = name
+        self.master_seed = master_seed
+        self.rng = random.Random(sub_seed(master_seed, name))
+        self._log = log
+        self._tmp_root = tmp_root
+        self._tmpdir: Optional[str] = None
+
+    # ---- schedule (digested) ----
+    def event(self, action: str, **params) -> None:
+        self._log.append(self.name, action, **params)
+
+    def randint(self, label: str, a: int, b: int) -> int:
+        v = self.rng.randint(a, b)
+        self.event("draw", label=label, value=v)
+        return v
+
+    def choice(self, label: str, seq):
+        v = self.rng.choice(list(seq))
+        self.event("draw", label=label, value=v)
+        return v
+
+    def cluster_seed(self) -> bytes:
+        return f"chaos-{self.name}-{self.master_seed}".encode()
+
+    # ---- scratch ----
+    @property
+    def tmpdir(self) -> str:
+        if self._tmpdir is None:
+            self._tmpdir = os.path.join(self._tmp_root,
+                                        self.name.replace("/", "_"))
+            os.makedirs(self._tmpdir, exist_ok=True)
+        return self._tmpdir
+
+    # ---- measurement (NOT digested) ----
+    @staticmethod
+    def wait_until(pred: Callable[[], bool], timeout: float,
+                   poll: float = 0.05, what: str = "condition") -> float:
+        """Poll until pred() is truthy; returns elapsed seconds. Raises
+        AssertionError on timeout (the scenario's red verdict)."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return time.monotonic() - t0
+            time.sleep(poll)
+        raise AssertionError(f"{what} not reached within {timeout:.0f}s")
+
+
+# ----------------------------------------------------------------------
+# scenario specs + campaign runner
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class ScenarioSpec:
+    name: str
+    fn: Callable[[ScenarioContext], dict]
+    kind: str                       # "inproc" | "process"
+    time_budget_s: float
+    tags: tuple = ()
+
+
+@dataclass
+class ScenarioVerdict:
+    name: str
+    ok: bool
+    duration_s: float
+    time_budget_s: float
+    stats: dict = field(default_factory=dict)
+    error: str = ""
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "ok": self.ok,
+                "duration_s": round(self.duration_s, 3),
+                "time_budget_s": self.time_budget_s,
+                "stats": self.stats, "error": self.error}
+
+
+class ChaosCampaign:
+    def __init__(self, seed: int = DEFAULT_SEED,
+                 specs: Optional[List[ScenarioSpec]] = None,
+                 keep_tmp: bool = False) -> None:
+        self.seed = seed
+        self.specs = specs if specs is not None else smoke_matrix()
+        self.keep_tmp = keep_tmp
+
+    def run(self) -> dict:
+        log = EventLog()
+        verdicts: List[ScenarioVerdict] = []
+        tmp_root = tempfile.mkdtemp(prefix="tpubft-chaos-")
+        try:
+            for spec in self.specs:
+                ctx = ScenarioContext(spec.name, self.seed, log, tmp_root)
+                ctx.event("begin", kind=spec.kind)
+                t0 = time.monotonic()
+                try:
+                    stats = spec.fn(ctx) or {}
+                    dt = time.monotonic() - t0
+                    ok = dt <= spec.time_budget_s
+                    err = ("" if ok else
+                           f"over time budget: {dt:.1f}s > "
+                           f"{spec.time_budget_s:.0f}s")
+                except Exception as e:  # noqa: BLE001 — red verdict
+                    dt = time.monotonic() - t0
+                    stats, ok = {}, False
+                    err = f"{type(e).__name__}: {e}"
+                finally:
+                    self._cleanup_globals()
+                verdicts.append(ScenarioVerdict(
+                    spec.name, ok, dt, spec.time_budget_s, stats, err))
+        finally:
+            if not self.keep_tmp:
+                shutil.rmtree(tmp_root, ignore_errors=True)
+        degraded = [v for v in verdicts if v.stats.get("degraded")]
+        artifact = {
+            "seed": self.seed,
+            "scenarios": [v.as_dict() for v in verdicts],
+            "passed": sum(1 for v in verdicts if v.ok),
+            "failed": sum(1 for v in verdicts if not v.ok),
+            "event_log": log.events,
+            "event_log_digest": log.digest(),
+            "recovery_s": {v.name: v.stats["recovery_s"]
+                           for v in verdicts if "recovery_s" in v.stats},
+        }
+        if degraded:
+            # PR 4's convention: a degraded artifact names WHY, so a
+            # reader can tell injected degradation from a perf story
+            artifact["degraded"] = True
+            artifact["probe_error"] = "; ".join(
+                v.stats.get("probe_error", v.name) for v in degraded)
+        return artifact
+
+    @staticmethod
+    def _cleanup_globals() -> None:
+        """Process-wide state a scenario may have mutated must never
+        leak into the next scenario (or a later test): disarm
+        crashpoints, release parked threads, close the breaker."""
+        from tpubft.testing import crashpoints as cp
+        cp.disarm_all()
+        cp.release_parked()
+        try:
+            from tpubft.ops.dispatch import device_breaker
+            device_breaker().reset()
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
+
+
+# ----------------------------------------------------------------------
+# smoke matrix (in-process; tier-1 wires this via bench_chaos --smoke)
+# ----------------------------------------------------------------------
+
+_FAST_VC = {"view_change_timer_ms": 900}
+
+
+def _counter_cluster(ctx: ScenarioContext, **kw):
+    from tpubft.testing.cluster import InProcessCluster
+    kw.setdefault("cfg_overrides", dict(_FAST_VC))
+    return InProcessCluster(f=1, seed=ctx.cluster_seed(), **kw)
+
+
+def _persistent_factories(ctx: ScenarioContext):
+    from tpubft.apps.counter import PersistentCounterHandler
+    from tpubft.consensus.persistent import FilePersistentStorage
+    base = ctx.tmpdir
+
+    def storage_factory(r: int):
+        return FilePersistentStorage(os.path.join(base, f"r{r}.wal"))
+
+    def handler_factory(r: int):
+        return PersistentCounterHandler(os.path.join(base, f"c{r}.state"))
+
+    return storage_factory, handler_factory
+
+
+def _wait_converged(ctx: ScenarioContext, cluster, expected: int,
+                    replicas, timeout: float, what: str) -> float:
+    """No-ledger-divergence check for counter clusters: every live
+    replica's applied state reaches the same expected value."""
+    return ctx.wait_until(
+        lambda: all(cluster.handlers[r].value == expected
+                    for r in replicas),
+        timeout, what=what)
+
+
+def scenario_wrong_digest_primary(ctx: ScenarioContext) -> dict:
+    """Wrong-digest primary (corrupted PrePrepare broadcast): backups
+    reject every proposal, view-change away, and the honest quorum
+    commits; the byzantine replica still converges as a backup."""
+    from tpubft.apps import counter
+    amount = ctx.randint("add", 1, 1000)
+    ctx.event("byzantine", replica=0, strategy="corrupt-preprepare")
+    with _counter_cluster(ctx, byzantine={0: "corrupt-preprepare"}) \
+            as cluster:
+        cl = cluster.client()
+        t0 = time.monotonic()
+        reply = cl.send_write(counter.encode_add(amount), timeout_ms=30000)
+        recovery = time.monotonic() - t0
+        assert counter.decode_reply(reply) == amount
+        for r in (1, 2, 3):
+            assert cluster.replicas[r].view >= 1, \
+                f"replica {r} never left the corrupt primary's view"
+        _wait_converged(ctx, cluster, amount, (1, 2, 3), 15,
+                        "honest replicas converge")
+    return {"recovery_s": round(recovery, 3)}
+
+
+def scenario_equivocating_primary(ctx: ScenarioContext) -> dict:
+    """Truly equivocating primary (both forks validly signed): the
+    backups split across two digests, neither can commit, and the
+    view change must resolve ONE fork deterministically — the cluster
+    commits exactly once, never both forks."""
+    from tpubft.apps import counter
+    amount = ctx.randint("add", 1, 1000)
+    ctx.event("byzantine", replica=0, strategy="equivocate")
+    with _counter_cluster(ctx, byzantine={0: "equivocate"}) as cluster:
+        cl = cluster.client()
+        t0 = time.monotonic()
+        reply = cl.send_write(counter.encode_add(amount), timeout_ms=45000)
+        recovery = time.monotonic() - t0
+        # exactly-once across the fork: the counter reflects ONE apply
+        assert counter.decode_reply(reply) == amount
+        for r in (1, 2, 3):
+            assert cluster.replicas[r].view >= 1, \
+                f"replica {r} never left the equivocating primary's view"
+        _wait_converged(ctx, cluster, amount, (1, 2, 3), 15,
+                        "honest replicas converge on one fork")
+    return {"recovery_s": round(recovery, 3)}
+
+
+def scenario_partition_heal(ctx: ScenarioContext) -> dict:
+    """Asymmetric backup partition (2→3 dropped, 3→2 flows): liveness
+    must not suffer at all; after heal everyone converges."""
+    from tpubft.apps import counter
+    frm, to = 2, 3
+    ctx.event("partition", frm=frm, to=to, mode="asymmetric")
+    healed = threading.Event()
+
+    def drop(s, d, data):
+        if not healed.is_set() and s == frm and d == to:
+            return None
+        return data
+
+    with _counter_cluster(ctx) as cluster:
+        cluster.bus.add_hook(drop)
+        cl = cluster.client()
+        total = 0
+        n_writes = ctx.randint("writes", 3, 5)
+        for i in range(n_writes):
+            delta = ctx.randint(f"add{i}", 1, 50)
+            total += delta
+            reply = cl.send_write(counter.encode_add(delta),
+                                  timeout_ms=20000)
+            assert counter.decode_reply(reply) == total, \
+                "ordering wedged under a one-way link cut"
+        ctx.event("heal", frm=frm, to=to)
+        healed.set()
+        t0 = time.monotonic()
+        _wait_converged(ctx, cluster, total, range(cluster.n), 20,
+                        "all replicas converge after heal")
+        recovery = time.monotonic() - t0
+    return {"recovery_s": round(recovery, 3), "writes": n_writes}
+
+
+def scenario_breaker_viewchange(ctx: ScenarioContext) -> dict:
+    """COMPOUND: the device circuit breaker trips (all replicas of the
+    process share the device, PR 5) and the primary dies while the
+    plane is degraded — the view change must complete on the scalar
+    fallback and ordering must resume, still degraded."""
+    from tpubft.apps import counter
+    from tpubft.ops.dispatch import device_breaker
+    from tpubft.utils.breaker import CLOSED
+    b = device_breaker()
+    with _counter_cluster(ctx) as cluster:
+        cl = cluster.client()
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(3),
+                          timeout_ms=30000)) == 3
+        ctx.event("breaker_trip", threshold=b.failure_threshold)
+        for _ in range(b.failure_threshold):
+            b.record_failure(kind="chaos", cause="injected")
+        assert b.state != CLOSED, "breaker did not trip"
+        ctx.event("kill_primary", replica=0)
+        cluster.kill(0)
+        t0 = time.monotonic()
+        reply = cl.send_write(counter.encode_add(4), timeout_ms=30000)
+        recovery = time.monotonic() - t0
+        assert counter.decode_reply(reply) == 7
+        assert b.state != CLOSED, \
+            "breaker silently closed without a probe verdict"
+        for r in (1, 2, 3):
+            assert cluster.replicas[r].view >= 1
+        _wait_converged(ctx, cluster, 7, (1, 2, 3), 15,
+                        "survivors converge while degraded")
+        trips = b.trips
+    return {"recovery_s": round(recovery, 3), "degraded": True,
+            "breaker_trips": trips,
+            "probe_error": "device breaker tripped by chaos injection "
+                           "(%d consecutive failures)" % b.failure_threshold}
+
+
+def scenario_crash_restart_replay(ctx: ScenarioContext) -> dict:
+    """Plain crash recovery: a backup restarts from its WAL and replays
+    to the cluster's state exactly once."""
+    from tpubft.apps import counter
+    sf, hf = _persistent_factories(ctx)
+    victim = ctx.choice("victim", (1, 2, 3))
+    with _counter_cluster(ctx, storage_factory=sf,
+                          handler_factory=hf) as cluster:
+        cl = cluster.client()
+        total = 0
+        for i in range(2):
+            delta = ctx.randint(f"add{i}", 1, 50)
+            total += delta
+            assert counter.decode_reply(
+                cl.send_write(counter.encode_add(delta),
+                              timeout_ms=30000)) == total
+        ctx.wait_until(lambda: cluster.replicas[victim].last_executed >= 1,
+                       10, what="victim executed a prefix")
+        ctx.event("crash_restart", replica=victim)
+        t0 = time.monotonic()
+        rep = cluster.restart(victim)
+        assert rep.last_executed >= 1, "WAL recovery lost the prefix"
+        delta = ctx.randint("add_post", 1, 50)
+        total += delta
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(delta),
+                          timeout_ms=30000)) == total
+        _wait_converged(ctx, cluster, total, range(cluster.n), 20,
+                        "restarted replica replays exactly once")
+        recovery = time.monotonic() - t0
+    return {"recovery_s": round(recovery, 3)}
+
+
+def scenario_crashpoint_exec_post_apply(ctx: ScenarioContext) -> dict:
+    """Crashpoint drill 1 — exec.post_apply: a replica dies after the
+    run's durable apply but before watermark/bookkeeping. Recovery from
+    its WAL must replay the committed suffix EXACTLY ONCE (the durable
+    at-most-once state dedups) and reach the cluster's value."""
+    from tpubft.apps import counter
+    from tpubft.comm.loopback import LoopbackBus
+    from tpubft.consensus.persistent import FilePersistentStorage
+    from tpubft.consensus.replica import Replica
+    from tpubft.testing import crashpoints as cp
+    from tpubft.utils.config import ReplicaConfig
+    sf, hf = _persistent_factories(ctx)
+    victim = 2
+    hit = threading.Event()
+
+    def crash_here() -> None:
+        hit.set()
+        cp.park()                 # SIGKILL analog: not one more statement
+
+    with _counter_cluster(ctx, storage_factory=sf,
+                          handler_factory=hf) as cluster:
+        cl = cluster.client()
+        first = ctx.randint("add1", 1, 50)
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(first),
+                          timeout_ms=30000)) == first
+        ctx.wait_until(lambda: cluster.replicas[victim].last_executed >= 1,
+                       10, what="victim applied the baseline")
+        ctx.event("arm_crashpoint", point="exec.post_apply",
+                  replica=victim)
+        cp.arm("exec.post_apply", rid=victim, action=crash_here)
+        second = ctx.randint("add2", 1, 50)
+        total = first + second
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(second),
+                          timeout_ms=20000)) == total
+        ctx.wait_until(hit.is_set, 15, what="crashpoint fired")
+        ctx.event("crashed", replica=victim, point="exec.post_apply")
+        # ---- recovery: restore the victim standalone from its durable
+        # state (WAL + counter file + surviving reserved pages) with the
+        # lane off, so the committed-suffix replay happens in __init__ —
+        # and assert it applied exactly once ----
+        t0 = time.monotonic()
+        cfg = ReplicaConfig(replica_id=victim, f_val=1,
+                            num_of_client_proxies=2,
+                            execution_lane=False, **_FAST_VC)
+        recovered = Replica(
+            cfg, cluster.keys.for_node(victim),
+            LoopbackBus().create(victim),
+            hf(victim),
+            storage=FilePersistentStorage(
+                os.path.join(ctx.tmpdir, f"r{victim}.wal")),
+            reserved_pages=cluster._pages_dbs[victim])
+        recovery = time.monotonic() - t0
+        assert recovered.handler.value == total, (
+            f"replay divergence: recovered value "
+            f"{recovered.handler.value} != {total} (double-applied?)")
+        assert recovered.last_executed >= 2, \
+            "recovery did not replay the committed suffix"
+        # release the parked lane thread BEFORE cluster teardown so the
+        # victim's stop() doesn't eat its full join timeout
+        cp.disarm_all()
+        cp.release_parked()
+    return {"recovery_s": round(recovery, 3),
+            "recovered_value": total}
+
+
+def scenario_crashpoint_vc_persist(ctx: ScenarioContext) -> dict:
+    """Crashpoint drill 2 — vc.persist: a replica dies after persisting
+    its view-change intent but BEFORE broadcasting the ViewChangeMsg.
+    With the old primary dead, the view-change quorum NEEDS this
+    replica: its restart must resume the change from storage and
+    retransmit (the pending_view persistence + _resume_view_change
+    path), or the cluster wedges forever."""
+    from tpubft.apps import counter
+    from tpubft.testing import crashpoints as cp
+    sf, hf = _persistent_factories(ctx)
+    victim = 2
+    hit = threading.Event()
+
+    def crash_here() -> None:
+        hit.set()
+        cp.park()
+
+    with _counter_cluster(ctx, storage_factory=sf,
+                          handler_factory=hf) as cluster:
+        cl = cluster.client()
+        first = ctx.randint("add1", 1, 50)
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(first),
+                          timeout_ms=30000)) == first
+        ctx.event("arm_crashpoint", point="vc.persist", replica=victim)
+        cp.arm("vc.persist", rid=victim, action=crash_here)
+        ctx.event("kill_primary", replica=0)
+        cluster.kill(0)
+        # complaints (and thus the view change the victim parks inside)
+        # only fire while work is in flight — drive a write in the
+        # background; it can only complete after the victim recovers,
+        # because the view-change quorum (2f+1 = 3) needs all three
+        # survivors and the victim crashes before broadcasting its msg
+        second = ctx.randint("add2", 1, 50)
+        total = first + second
+        box: dict = {}
+
+        def drive() -> None:
+            try:
+                box["reply"] = cl.send_write(counter.encode_add(second),
+                                             timeout_ms=60000)
+            except Exception as e:  # noqa: BLE001 — asserted below
+                box["err"] = e
+
+        th = threading.Thread(target=drive, daemon=True)
+        th.start()
+        ctx.wait_until(hit.is_set, 30,
+                       what="victim crashed at vc.persist")
+        ctx.event("crashed", replica=victim, point="vc.persist")
+        old = cluster.replicas[victim]       # parked mid-seam
+        ctx.event("crash_restart", replica=victim)
+        t0 = time.monotonic()
+        cluster.crash(victim)                # recover from WAL, rebind bus
+        # the resumed view change must complete: 1, 3 and the recovered
+        # victim reach the view-change quorum, view >= 1 activates, and
+        # ordering resumes with history intact
+        th.join(60)
+        recovery = time.monotonic() - t0
+        assert not th.is_alive() and "err" not in box, \
+            f"driver write failed: {box.get('err', 'timed out')}"
+        assert counter.decode_reply(box["reply"]) == total, \
+            "cluster never recovered from the mid-view-change crash"
+        for r in (1, 2, 3):
+            assert cluster.replicas[r].view >= 1, \
+                f"replica {r} stuck in view 0"
+        _wait_converged(ctx, cluster, total, (1, 2, 3), 20,
+                        "recovered replica rejoins the new view")
+        # let the abandoned pre-crash instance observe its stop flags
+        cp.disarm_all()
+        cp.release_parked()
+        try:
+            old.stop()
+        except Exception:  # noqa: BLE001 — it crashed; best-effort
+            pass
+    return {"recovery_s": round(recovery, 3)}
+
+
+def smoke_matrix() -> List[ScenarioSpec]:
+    return [
+        ScenarioSpec("wrong-digest-primary", scenario_wrong_digest_primary,
+                     "inproc", 60, tags=("byzantine", "view-change")),
+        ScenarioSpec("equivocating-primary", scenario_equivocating_primary,
+                     "inproc", 90, tags=("byzantine", "view-change")),
+        ScenarioSpec("partition-heal", scenario_partition_heal,
+                     "inproc", 60, tags=("partition",)),
+        ScenarioSpec("breaker-viewchange", scenario_breaker_viewchange,
+                     "inproc", 60, tags=("compound", "degraded",
+                                         "view-change")),
+        ScenarioSpec("crash-restart-replay", scenario_crash_restart_replay,
+                     "inproc", 60, tags=("recovery",)),
+        ScenarioSpec("crashpoint-exec-post-apply",
+                     scenario_crashpoint_exec_post_apply,
+                     "inproc", 60, tags=("crashpoint", "recovery")),
+        ScenarioSpec("crashpoint-vc-persist",
+                     scenario_crashpoint_vc_persist,
+                     "inproc", 90, tags=("crashpoint", "view-change",
+                                         "recovery")),
+    ]
+
+
+# ----------------------------------------------------------------------
+# full matrix (real replica subprocesses; bench_chaos.py without --smoke)
+# ----------------------------------------------------------------------
+
+
+def _net(ctx: ScenarioContext, **kw):
+    from tpubft.testing.network import BftTestNetwork
+    base_port = ctx.randint("base_port", 210, 479) * 100
+    kw.setdefault("view_change_timeout_ms", 2500)
+    return BftTestNetwork(f=1, base_port=base_port,
+                          db_dir=ctx.tmpdir,
+                          seed=ctx.cluster_seed().decode(), **kw)
+
+
+def _commit(kv, key: bytes, value: bytes, timeout_ms: int = 10000,
+            tries: int = 6) -> bool:
+    for _ in range(tries):
+        try:
+            if kv.write([(key, value)], timeout_ms=timeout_ms).success:
+                return True
+        except Exception:  # noqa: BLE001 — retried
+            pass
+    return False
+
+
+def _views(net, replicas) -> dict:
+    return {r: net.current_view(r) or 0 for r in replicas}
+
+
+def proc_crash_primary_mid_viewchange(ctx: ScenarioContext) -> dict:
+    """The old primary is isolated, then HARD-CRASHES halfway through
+    the view-change window and restarts: the cluster must still
+    complete the change, and the restarted ex-primary must rejoin the
+    new view with its ledger intact."""
+    with _net(ctx) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"pre", b"1"), "baseline write failed"
+        ctx.event("isolate", replica=0)
+        net.isolate_replica(0)
+        # crash the old primary mid-window (half the VC timeout in)
+        time.sleep(net.view_change_timeout_ms / 2e3)
+        ctx.event("kill", replica=0)
+        net.kill_replica(0)
+        t0 = time.monotonic()
+        assert _commit(kv, b"during", b"2", timeout_ms=15000, tries=8), \
+            "cluster never recovered from the crashed primary"
+        views = _views(net, (1, 2, 3))
+        assert all(v >= 1 for v in views.values()), views
+        ctx.event("restart", replica=0)
+        net.start_replica(0)
+        net.wait_for_replicas_up(replicas=[0])
+        net.wait_for(lambda: (net.current_view(0) or 0) >= 1, timeout=60)
+        assert _commit(kv, b"post", b"3", timeout_ms=15000)
+        recovery = time.monotonic() - t0
+        assert kv.read([b"pre", b"during", b"post"]) == {
+            b"pre": b"1", b"during": b"2", b"post": b"3"}, \
+            "ledger divergence after the mid-view-change crash"
+    return {"recovery_s": round(recovery, 3)}
+
+
+def proc_asymmetric_partition_heal(ctx: ScenarioContext) -> dict:
+    """A deaf backup (sends, hears nothing) must not cost liveness;
+    after heal it re-converges from retransmissions/state transfer."""
+    victim = ctx.choice("victim", (2, 3))
+    with _net(ctx) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"a", b"1")
+        ctx.event("deafen", replica=victim)
+        net.deafen_replica(victim)
+        for i in range(3):
+            assert _commit(kv, b"k%d" % i, b"v", timeout_ms=15000), \
+                "liveness lost to a single deaf backup"
+        ctx.event("heal", replica=victim)
+        net.heal(victim)
+        t0 = time.monotonic()
+        target = net.last_executed(0) or 0
+        net.wait_for(lambda: (net.last_executed(victim) or 0) >= target,
+                     timeout=60)
+        recovery = time.monotonic() - t0
+        assert _commit(kv, b"b", b"2")
+    return {"recovery_s": round(recovery, 3)}
+
+
+def proc_equivocating_primary(ctx: ScenarioContext) -> dict:
+    """Process-grade equivocation: replica 0 runs with the equivocate
+    strategy (validly signed forks). The honest quorum must view-change
+    away and commit."""
+    net = _net(ctx)
+    ctx.event("byzantine", replica=0, strategy="equivocate")
+    try:
+        for r in range(net.n):
+            net.start_replica(r, extra_args=(
+                ["--strategy", "equivocate"] if r == 0 else None))
+        net.wait_for_replicas_up()
+        kv = net.skvbc_client(0)
+        t0 = time.monotonic()
+        assert _commit(kv, b"x", b"1", timeout_ms=15000, tries=10), \
+            "honest quorum never committed under an equivocating primary"
+        recovery = time.monotonic() - t0
+        views = _views(net, (1, 2, 3))
+        assert all(v >= 1 for v in views.values()), views
+        assert _commit(kv, b"y", b"2", timeout_ms=15000)
+        assert kv.read([b"x", b"y"]) == {b"x": b"1", b"y": b"2"}
+    finally:
+        net.stop_all()
+    return {"recovery_s": round(recovery, 3)}
+
+
+def proc_f_crash_restart_st_catchup(ctx: ScenarioContext) -> dict:
+    """f replicas crash simultaneously and restart far behind: they must
+    catch back up (state transfer once the window is gone) and the
+    cluster re-converges."""
+    victim = ctx.choice("victim", (1, 2, 3))
+    with _net(ctx, checkpoint_window=10, work_window=20) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"seed", b"1")
+        ctx.event("kill", replica=victim)
+        net.kill_replica(victim)
+        n_writes = 30               # > work_window: forces ST catch-up
+        ctx.event("writes_behind", count=n_writes)
+        for i in range(n_writes):
+            assert _commit(kv, b"w%03d" % i, b"v", timeout_ms=15000), i
+        ctx.event("restart", replica=victim)
+        net.start_replica(victim)
+        net.wait_for_replicas_up(replicas=[victim])
+        t0 = time.monotonic()
+        target = net.last_executed(0) or 0
+        # a lagging replica's ST anchor comes from live CheckpointMsgs
+        # beyond its window (reference: ST triggers off checkpoint
+        # certificates riding ordering) — an idle cluster gives it no
+        # signal to transfer from, so keep traffic flowing while it
+        # catches up
+        deadline = time.monotonic() + 240
+        i = 0
+        while time.monotonic() < deadline \
+                and (net.last_executed(victim) or 0) < target:
+            _commit(kv, b"t%03d" % i, b"v", timeout_ms=10000, tries=2)
+            i += 1
+            time.sleep(0.2)
+        assert (net.last_executed(victim) or 0) >= target, \
+            "victim never caught up via state transfer"
+        recovery = time.monotonic() - t0
+        assert _commit(kv, b"tail", b"2")
+    return {"recovery_s": round(recovery, 3), "writes_behind": n_writes}
+
+
+def proc_crashpoint_exec_drill(ctx: ScenarioContext) -> dict:
+    """Process crashpoint drill: a replica restarted with
+    TPUBFT_CRASHPOINT=exec.post_apply dies AT the seam (exit code 173,
+    proving it was the seam and not a stray fault), restarts clean, and
+    must replay exactly once — reads stay consistent clusterwide."""
+    from tpubft.testing.crashpoints import CRASH_EXIT_CODE, ENV_VAR
+    victim = 2
+    with _net(ctx) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"pre", b"1")
+        ctx.event("restart_with_crashpoint", replica=victim,
+                  point="exec.post_apply")
+        net.restart_replica(victim,
+                            extra_env={ENV_VAR: "exec.post_apply"})
+        net.wait_for_replicas_up(replicas=[victim])
+        # the victim dies on its first applied run (recovery replay of
+        # the committed suffix counts — it IS a durable apply)
+        assert _commit(kv, b"boom", b"2", timeout_ms=15000)
+        code = net.wait_exit(victim, timeout=60)
+        assert code == CRASH_EXIT_CODE, \
+            f"victim exited {code}, not at the crashpoint seam"
+        ctx.event("crashed", replica=victim, point="exec.post_apply")
+        ctx.event("restart", replica=victim)
+        t0 = time.monotonic()
+        net.start_replica(victim)           # clean env: no crashpoint
+        net.wait_for_replicas_up(replicas=[victim])
+        assert _commit(kv, b"post", b"3", timeout_ms=15000)
+        target = net.last_executed(0) or 0
+        net.wait_for(lambda: (net.last_executed(victim) or 0) >= target,
+                     timeout=60)
+        recovery = time.monotonic() - t0
+        assert kv.read([b"pre", b"boom", b"post"]) == {
+            b"pre": b"1", b"boom": b"2", b"post": b"3"}, \
+            "ledger divergence after the exec-seam crash"
+    return {"recovery_s": round(recovery, 3), "exit_code": code}
+
+
+def proc_crashpoint_vc_drill(ctx: ScenarioContext) -> dict:
+    """Process crashpoint drill: a backup dies at vc.persist while the
+    old primary is isolated — after a clean restart it must RESUME the
+    persisted view change and retransmit its ViewChangeMsg so the
+    quorum completes."""
+    from tpubft.testing.crashpoints import CRASH_EXIT_CODE, ENV_VAR
+    victim = ctx.choice("victim", (2, 3))
+    with _net(ctx) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"pre", b"1")
+        ctx.event("restart_with_crashpoint", replica=victim,
+                  point="vc.persist")
+        net.restart_replica(victim, extra_env={ENV_VAR: "vc.persist"})
+        net.wait_for_replicas_up(replicas=[victim])
+        ctx.event("isolate", replica=0)
+        net.isolate_replica(0)
+        # complaints (and the view change the victim dies inside) only
+        # fire while work is in flight: drive a write from a background
+        # thread. It cannot complete before the victim recovers — the
+        # view-change quorum (2f+1 = 3) needs all three survivors and
+        # the victim crashes before broadcasting its ViewChangeMsg.
+        box: dict = {}
+
+        def drive() -> None:
+            box["ok"] = _commit(kv, b"during", b"2", timeout_ms=15000,
+                                tries=20)
+
+        th = threading.Thread(target=drive, daemon=True)
+        th.start()
+        code = net.wait_exit(victim, timeout=90)
+        assert code == CRASH_EXIT_CODE, \
+            f"victim exited {code}, not at the vc.persist seam"
+        ctx.event("crashed", replica=victim, point="vc.persist")
+        ctx.event("restart", replica=victim)
+        t0 = time.monotonic()
+        net.start_replica(victim)           # clean env
+        net.wait_for_replicas_up(replicas=[victim])
+        th.join(120)
+        recovery = time.monotonic() - t0
+        assert not th.is_alive() and box.get("ok"), \
+            "view change never completed after the vc.persist crash"
+        views = _views(net, [r for r in (1, 2, 3)])
+        assert all(v >= 1 for v in views.values()), views
+        net.heal(0)
+        assert _commit(kv, b"post", b"3", timeout_ms=15000)
+        assert kv.read([b"pre", b"during", b"post"]) == {
+            b"pre": b"1", b"during": b"2", b"post": b"3"}
+    return {"recovery_s": round(recovery, 3), "exit_code": code}
+
+
+def proc_breaker_trip_mid_viewchange(ctx: ScenarioContext) -> dict:
+    """COMPOUND at process scale: every replica's device breaker is
+    tripped through the fault-control plane, then the primary is
+    isolated — the view change and subsequent ordering run entirely
+    degraded."""
+    from tpubft.testing.faults import fault_command
+    with _net(ctx) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"pre", b"1")
+        ctx.event("breaker_trip", replicas=list(range(1, net.n)))
+        for r in range(1, net.n):
+            res = fault_command(net.fault_base + r, cmd="breaker",
+                                action="trip")
+            assert res and "breaker" in res, f"breaker trip failed on {r}"
+        ctx.event("isolate", replica=0)
+        net.isolate_replica(0)
+        t0 = time.monotonic()
+        assert _commit(kv, b"during", b"2", timeout_ms=15000, tries=10), \
+            "degraded cluster never completed the view change"
+        recovery = time.monotonic() - t0
+        views = _views(net, (1, 2, 3))
+        assert all(v >= 1 for v in views.values()), views
+        snap = fault_command(net.fault_base + 1, cmd="breaker",
+                             action="get")
+        trips = (snap or {}).get("breaker", {}).get("trips", 0)
+        assert trips >= 1, "breaker snapshot lost the injected trip"
+        net.heal(0)
+        assert _commit(kv, b"post", b"3", timeout_ms=15000)
+    return {"recovery_s": round(recovery, 3), "degraded": True,
+            "breaker_trips": trips,
+            "probe_error": "device breaker tripped via fault-control "
+                           "plane during view change"}
+
+
+def full_matrix() -> List[ScenarioSpec]:
+    return smoke_matrix() + [
+        ScenarioSpec("proc-crash-primary-mid-viewchange",
+                     proc_crash_primary_mid_viewchange, "process", 300,
+                     tags=("crash", "view-change")),
+        ScenarioSpec("proc-asymmetric-partition-heal",
+                     proc_asymmetric_partition_heal, "process", 300,
+                     tags=("partition",)),
+        ScenarioSpec("proc-equivocating-primary",
+                     proc_equivocating_primary, "process", 300,
+                     tags=("byzantine", "view-change")),
+        ScenarioSpec("proc-f-crash-restart-st-catchup",
+                     proc_f_crash_restart_st_catchup, "process", 420,
+                     tags=("crash", "state-transfer")),
+        ScenarioSpec("proc-crashpoint-exec-drill",
+                     proc_crashpoint_exec_drill, "process", 300,
+                     tags=("crashpoint", "recovery")),
+        ScenarioSpec("proc-crashpoint-vc-drill",
+                     proc_crashpoint_vc_drill, "process", 300,
+                     tags=("crashpoint", "view-change", "recovery")),
+        ScenarioSpec("proc-breaker-trip-mid-viewchange",
+                     proc_breaker_trip_mid_viewchange, "process", 300,
+                     tags=("compound", "degraded", "view-change")),
+    ]
+
+
+def matrix_by_name() -> Dict[str, ScenarioSpec]:
+    return {s.name: s for s in full_matrix()}

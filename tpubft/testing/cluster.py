@@ -23,7 +23,8 @@ class InProcessCluster:
                  handler_factory: Optional[Callable[[], IRequestsHandler]] = None,
                  cfg_overrides: Optional[dict] = None,
                  storage_factory: Optional[Callable[[int], PersistentStorage]] = None,
-                 seed: bytes = b"tpubft-test-cluster"):
+                 seed: bytes = b"tpubft-test-cluster",
+                 byzantine: Optional[Dict[int, str]] = None):
         from tpubft.apps.counter import CounterHandler
         self.handler_factory = handler_factory or CounterHandler
         base_cfg = ReplicaConfig(f_val=f, c_val=c,
@@ -39,6 +40,11 @@ class InProcessCluster:
         self.handlers: Dict[int, IRequestsHandler] = {}
         self.replicas: Dict[int, Replica] = {}
         self.storage_factory = storage_factory
+        # replica_id -> byzantine strategy name (testing/byzantine.py):
+        # that replica's transport is wrapped exactly like the tester
+        # replica's --strategy flag, signer in hand for re-signing
+        # strategies (equivocate)
+        self.byzantine = dict(byzantine or {})
         self._pages_dbs: Dict[int, object] = {}
         self._cfg_overrides = cfg_overrides or {}
         self._num_clients = num_clients
@@ -66,7 +72,14 @@ class InProcessCluster:
             from tpubft.consensus.reserved_pages import ReservedPages
             from tpubft.storage.memorydb import MemoryDB
             pages = self._pages_dbs[r] = ReservedPages(MemoryDB())
-        rep = Replica(cfg, self.keys.for_node(r), self.bus.create(r),
+        node_keys = self.keys.for_node(r)
+        comm = self.bus.create(r)
+        strategy = self.byzantine.get(r)
+        if strategy:
+            from tpubft.testing.byzantine import strategy_wrapper
+            comm = strategy_wrapper(strategy)(
+                comm, signer=node_keys.my_signer())
+        rep = Replica(cfg, node_keys, comm,
                       handler, storage=storage, aggregator=agg,
                       reserved_pages=pages)
         # KVBC-backed handlers get a state-transfer manager, mirroring
@@ -130,6 +143,25 @@ class InProcessCluster:
         """Stop + recreate from persistent storage (crash recovery)."""
         self.kill(replica_id)
         rep = self._make_replica(replica_id)
+        rep.start()
+        return rep
+
+    def crash(self, replica_id: int) -> Replica:
+        """Crash-recover WITHOUT a clean stop: the old instance is
+        abandoned exactly as it stands (its threads may be parked at a
+        crashpoint seam), the loopback endpoint is rebound to a new
+        replica restored from persistent storage — the in-process analog
+        of SIGKILL + restart. Only state that reached storage (or the
+        surviving reserved-pages db) is recovered."""
+        old = self.replicas.pop(replica_id, None)  # no stop(): it crashed
+        if old is not None:
+            # mute the abandoned instance's transport (flag flip only —
+            # no joins, no clean shutdown): a SIGKILLed process sends
+            # nothing, and an old thread that is merely parked (or still
+            # running) must not keep emitting with the recovered
+            # replica's identity — that would be accidental equivocation
+            old.comm.stop()
+        rep = self._make_replica(replica_id)      # bus.create() rebinds
         rep.start()
         return rep
 

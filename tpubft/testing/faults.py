@@ -189,24 +189,55 @@ class FaultControlServer:
                 continue
             except OSError:
                 return
+            # the control thread is the harness's only handle into the
+            # process: it must answer EVERY datagram — malformed JSON, a
+            # non-object payload, unknown commands, bad field types — with
+            # an error dict rather than dying silently (a dead control
+            # thread turns every later heal()/set into a mystery timeout)
             try:
-                cmd = json.loads(data.decode())
-                if cmd.get("cmd") == "clear":
-                    self._faults.configure(drop_to=(), drop_from=(), loss=0,
-                                           delay_ms=0, jitter_ms=0)
-                elif cmd.get("cmd") == "set":
-                    self._faults.configure(cmd.get("drop_to"),
-                                           cmd.get("drop_from"),
-                                           cmd.get("loss"),
-                                           cmd.get("delay_ms"),
-                                           cmd.get("jitter_ms"))
-                reply = json.dumps(self._faults.state()).encode()
-            except (ValueError, KeyError) as e:
-                reply = json.dumps({"error": str(e)}).encode()
+                reply = json.dumps(self._handle(data)).encode()
+            except Exception as e:  # noqa: BLE001 — never kill the thread
+                reply = json.dumps({"error": f"{type(e).__name__}: {e}"}
+                                   ).encode()
             try:
                 self._sock.sendto(reply, addr)
             except OSError:
                 pass
+
+    def _handle(self, data: bytes) -> dict:
+        cmd = json.loads(data.decode())
+        if not isinstance(cmd, dict):
+            return {"error": "command must be a JSON object"}
+        op = cmd.get("cmd")
+        if op == "clear":
+            self._faults.configure(drop_to=(), drop_from=(), loss=0,
+                                   delay_ms=0, jitter_ms=0)
+        elif op == "set":
+            self._faults.configure(cmd.get("drop_to"),
+                                   cmd.get("drop_from"),
+                                   cmd.get("loss"),
+                                   cmd.get("delay_ms"),
+                                   cmd.get("jitter_ms"))
+        elif op == "breaker":
+            # chaos handle into the degradation plane: trip or reset the
+            # process-wide device breaker so campaigns can compose
+            # device-degraded modes with protocol faults (a breaker that
+            # trips mid-view-change is the compound failure a real
+            # cluster sees when a chip dies under load)
+            from tpubft.ops.dispatch import device_breaker
+            b = device_breaker()
+            action = cmd.get("action")
+            if action == "trip":
+                for _ in range(b.failure_threshold):
+                    b.record_failure(kind="chaos", cause="injected")
+            elif action == "reset":
+                b.reset()
+            elif action != "get":
+                return {"error": f"unknown breaker action {action!r}"}
+            return {"breaker": b.snapshot()}
+        elif op != "get":
+            return {"error": f"unknown cmd {op!r}"}
+        return self._faults.state()
 
     def stop(self) -> None:
         self._running = False

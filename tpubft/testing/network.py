@@ -20,6 +20,7 @@ import socket
 import subprocess
 import sys
 import time
+import weakref
 from typing import Dict, List, Optional
 
 from tpubft.apps.simple_test import endpoint_table
@@ -119,6 +120,37 @@ class BftTestNetwork:
         self.procs: Dict[int, subprocess.Popen] = {}
         self.paused: set = set()
         self._clients: Dict[int, BftClient] = {}
+        # teardown guarantee: even when a red assertion (or a crashed
+        # test runner) skips __exit__/stop_all, no SIGSTOP'd or live
+        # replica subprocess may outlive this harness — a stopped orphan
+        # holds its ports and poisons every later test on the host. The
+        # finalizer fires at GC or interpreter exit and must not hold a
+        # reference to self (it would never fire), so it closes over the
+        # mutable dicts only.
+        self._finalizer = weakref.finalize(
+            self, BftTestNetwork._reap_procs, self.procs, self.paused)
+
+    @staticmethod
+    def _reap_procs(procs: Dict[int, subprocess.Popen],
+                    paused: set) -> None:
+        """Last-resort reaper: SIGCONT anything stopped, SIGKILL, reap.
+        (SIGKILL does kill a stopped process, but the SIGCONT keeps the
+        behavior uniform with stop_all's graceful path and unsticks any
+        descendant blocked on the stopped parent.)"""
+        for r, p in list(procs.items()):
+            try:
+                if p.poll() is None:
+                    if r in paused:
+                        p.send_signal(signal.SIGCONT)
+                    p.kill()
+            except OSError:
+                pass
+        for p in list(procs.values()):
+            try:
+                p.wait(timeout=5)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+        paused.clear()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -141,7 +173,8 @@ class BftTestNetwork:
         return self
 
     def start_replica(self, r: int,
-                      extra_args: Optional[List[str]] = None) -> None:
+                      extra_args: Optional[List[str]] = None,
+                      extra_env: Optional[Dict[str, str]] = None) -> None:
         assert r not in self.procs or self.procs[r].poll() is not None
         # persistent kernel cache: device-backend replicas (crypto tpu)
         # otherwise pay a cold XLA compile per process — the dominant
@@ -149,7 +182,8 @@ class BftTestNetwork:
         env = dict(os.environ, PYTHONPATH=_REPO_ROOT, JAX_PLATFORMS="cpu",
                    JAX_COMPILATION_CACHE_DIR=os.path.join(_REPO_ROOT,
                                                           ".jax_cache"),
-                   JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="2")
+                   JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="2",
+                   **(extra_env or {}))
         args = [sys.executable, "-m", "tpubft.apps.skvbc_replica",
                 "--replica", str(r), "--f", str(self.f), "--c", str(self.c),
                 "--ro", str(self.num_ro),
@@ -226,18 +260,29 @@ class BftTestNetwork:
         return rid
 
     def stop_all(self) -> None:
-        for r, p in self.procs.items():
+        for r, p in list(self.procs.items()):
             if p.poll() is None:
+                # SIGCONT first: a SIGTERM delivered to a stopped process
+                # stays pending until it resumes — without this, every
+                # paused replica rides the 5s escalation below
                 if r in self.paused:
                     p.send_signal(signal.SIGCONT)
                 p.send_signal(signal.SIGTERM)
-        for p in self.procs.values():
+        for p in list(self.procs.values()):
             try:
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+                try:
+                    p.wait(timeout=5)   # actually reap — no zombies
+                except subprocess.TimeoutExpired:
+                    pass
+        self.paused.clear()
         for cl in self._clients.values():
-            cl.stop()
+            try:
+                cl.stop()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
 
     # ------------------------------------------------------------------
     # fault injection (Apollo kill/restart + partition analogs)
@@ -248,10 +293,18 @@ class BftTestNetwork:
         if p.poll() is None:
             p.send_signal(signal.SIGKILL)
             p.wait()
+        self.paused.discard(r)       # a dead process is no longer paused
 
-    def restart_replica(self, r: int) -> None:
+    def wait_exit(self, r: int, timeout: float = 30.0) -> int:
+        """Block until replica r's process exits on its own (crashpoint
+        drills assert the exit CODE to prove the seam fired)."""
+        return self.procs[r].wait(timeout=timeout)
+
+    def restart_replica(self, r: int,
+                        extra_args: Optional[List[str]] = None,
+                        extra_env: Optional[Dict[str, str]] = None) -> None:
         self.kill_replica(r)
-        self.start_replica(r)
+        self.start_replica(r, extra_args=extra_args, extra_env=extra_env)
 
     def pause_replica(self, r: int) -> None:
         """SIGSTOP: the replica is partitioned from the cluster (alive,
