@@ -112,8 +112,8 @@ def _drain_done(rep, injected: int, distinct: int) -> bool:
     else:
         ingested = rep.dispatcher.handled_external >= injected
     return (ingested
-            and rep.incoming._external.qsize() == 0
-            and rep.incoming._internal.qsize() == 0
+            and rep.incoming.external_depth == 0
+            and rep.incoming.internal_depth == 0
             and not rep._req_verifying
             and len(rep._forwarded) >= distinct)
 

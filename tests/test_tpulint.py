@@ -1,0 +1,366 @@
+"""Tier-1 wiring + unit coverage for the whole-program concurrency
+analyzer (tools/tpulint/).
+
+The gate: `python -m tools.tpulint` over the repo must exit 0 — every
+finding of every pass (thread-roles, static-race, lock-order,
+dispatcher-blocking, plus the four migrated legacy lints) is either
+fixed or carries a justified tools/tpulint/baseline.toml entry. The
+failure modes the ISSUE names are covered as fixtures: a seeded
+unguarded cross-role store, a seeded A→B/B→A lock nesting, a seeded
+`time.sleep` in a dispatcher-role function and a forbidden hot-path
+verify are each reported at the correct file:line by their pass;
+zero-modules-scanned and an unknown/stale suppression key both fail
+loudly; a suppressed finding exits clean.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.tpulint import Context, analyze, main  # noqa: E402
+from tools.tpulint.core import (BaselineError, ScanError,  # noqa: E402
+                                parse_baseline)
+from tools.tpulint import rolemap  # noqa: E402
+
+
+# ----------------------------------------------------------------------
+# the tier-1 gate: the repo itself is clean modulo the justified baseline
+# ----------------------------------------------------------------------
+
+def test_repo_is_clean_with_baseline():
+    findings, _n_suppressed, errors = analyze(
+        _ROOT, baseline_path=os.path.join(_ROOT, "tools", "tpulint",
+                                          "baseline.toml"))
+    assert findings == [], "non-baselined tpulint findings:\n" + \
+        "\n".join(f.render() for f in findings)
+    assert errors == [], "baseline errors:\n" + \
+        "\n".join(f.render() for f in errors)
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint"], cwd=_ROOT,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK: tpulint clean" in proc.stdout
+
+
+def test_list_passes_names_all_eight(capsys):
+    assert main(["--list-passes"]) == 0
+    out = capsys.readouterr().out
+    for pid in ("thread-roles", "static-race", "lock-order",
+                "dispatcher-blocking", "imports", "hotpath",
+                "device-seam", "crashpoints"):
+        assert pid in out
+
+
+# ----------------------------------------------------------------------
+# loud failure modes
+# ----------------------------------------------------------------------
+
+def test_zero_modules_scanned_fails_loudly(tmp_path):
+    (tmp_path / "tpubft").mkdir()
+    with pytest.raises(ScanError):
+        analyze(str(tmp_path), pass_ids=["static-race"])
+    assert main([str(tmp_path), "--no-baseline",
+                 "--passes", "static-race"]) == 2
+
+
+def test_stale_suppression_key_fails(tmp_path, fixture_tree):
+    root = fixture_tree("class A:\n    pass\n")
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\npass = "static-race"\n'
+                  'key = "tpubft/fix.py:Nothing.matches:attr"\n'
+                  'reason = "left behind after the fix"\n')
+    _f, _n, errors = analyze(root, pass_ids=["static-race"],
+                             baseline_path=str(bl))
+    assert any("stale baseline entry" in e.message for e in errors)
+
+
+def test_unknown_pass_in_baseline_fails(tmp_path, fixture_tree):
+    root = fixture_tree("class A:\n    pass\n")
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\npass = "no-such-pass"\nkey = "k"\n'
+                  'reason = "typo"\n')
+    _f, _n, errors = analyze(root, pass_ids=["static-race"],
+                             baseline_path=str(bl))
+    assert any("unknown pass" in e.message for e in errors)
+
+
+def test_malformed_baseline_rejected(tmp_path):
+    bad = tmp_path / "b.toml"
+    bad.write_text('[[suppress]]\npass = "static-race"\nkey = "k"\n')
+    with pytest.raises(BaselineError):        # missing reason
+        parse_baseline(str(bad))
+    bad.write_text('[[suppress]]\npass = "x"\nkey = "k"\nreason = ""\n')
+    with pytest.raises(BaselineError):        # empty reason
+        parse_baseline(str(bad))
+    bad.write_text("not toml at all\n")
+    with pytest.raises(BaselineError):
+        parse_baseline(str(bad))
+
+
+# ----------------------------------------------------------------------
+# seeded-defect fixtures, one per pass
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def fixture_tree(tmp_path, monkeypatch):
+    """Build a one-module tpubft/ tree under tmp_path and point the
+    role seeds at it (the real seed table names real repo modules and
+    would otherwise report every seed stale)."""
+    def build(source, seeds=None):
+        pkg = tmp_path / "tpubft"
+        pkg.mkdir(exist_ok=True)
+        (pkg / "fix.py").write_text(textwrap.dedent(source))
+        monkeypatch.setattr(rolemap, "THREAD_ROLES", dict(seeds or {}))
+        monkeypatch.setattr(rolemap, "API_SEEDS", {})
+        return str(tmp_path)
+    return build
+
+
+_RACY = """\
+from tpubft.utils.racecheck import make_lock
+
+class Plane:
+    def __init__(self):
+        self._mu = make_lock("plane")
+        self.depth = 0
+        self.safe = 0
+
+    def from_a(self):
+        self._mutate()
+
+    def from_b(self):
+        self._mutate()
+
+    def _mutate(self):
+        self.depth += 1            # line 16: unguarded cross-role store
+        with self._mu:
+            self.safe += 1         # guarded: not a finding
+"""
+
+_RACE_SEEDS = {
+    ("tpubft/fix.py", "Plane", "from_a"): frozenset({"role_a"}),
+    ("tpubft/fix.py", "Plane", "from_b"): frozenset({"role_b"}),
+}
+
+
+def test_race_fixture_reports_file_line_roles(fixture_tree):
+    root = fixture_tree(_RACY, _RACE_SEEDS)
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles", "static-race"])
+    race = [f for f in findings if f.pass_id == "static-race"]
+    assert len(race) == 1, [f.render() for f in findings]
+    f = race[0]
+    assert (f.path, f.line) == ("tpubft/fix.py", 16), f.render()
+    assert "role_a×role_b" in f.message and "depth" in f.message
+    assert f.key == "tpubft/fix.py:Plane._mutate:depth"
+
+
+def test_race_fixture_suppressed_is_clean(fixture_tree, tmp_path):
+    root = fixture_tree(_RACY, _RACE_SEEDS)
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\npass = "static-race"\n'
+                  'key = "tpubft/fix.py:Plane._mutate:depth"\n'
+                  'reason = "fixture: suppressed on purpose"\n')
+    findings, n, errors = analyze(
+        root, pass_ids=["thread-roles", "static-race"],
+        baseline_path=str(bl))
+    assert findings == [] and errors == [] and n == 1
+
+
+def test_raw_lock_guard_is_its_own_finding(fixture_tree):
+    src = """\
+import threading
+
+class Plane:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.depth = 0
+
+    def from_a(self):
+        with self._mu:
+            self.depth += 1
+
+    def from_b(self):
+        self.from_a()
+"""
+    root = fixture_tree(src, {
+        ("tpubft/fix.py", "Plane", "from_a"): frozenset({"a"}),
+        ("tpubft/fix.py", "Plane", "from_b"): frozenset({"b"}),
+    })
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles", "static-race"])
+    race = [f for f in findings if f.pass_id == "static-race"]
+    assert len(race) == 1
+    assert race[0].key.endswith(":raw-lock")
+    assert "raw lock" in race[0].message
+
+
+_CYCLE = """\
+from tpubft.utils.racecheck import make_lock
+
+class Grid:
+    def __init__(self):
+        self._a = make_lock("a")
+        self._b = make_lock("b")
+
+    def forward(self):
+        with self._a:
+            with self._b:      # edge a -> b (line 10)
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:      # edge b -> a: closes the cycle
+                pass
+"""
+
+
+def test_lock_order_cycle_fixture(fixture_tree):
+    root = fixture_tree(_CYCLE)
+    findings, _, _ = analyze(root, pass_ids=["lock-order"])
+    cyc = [f for f in findings if f.pass_id == "lock-order"]
+    assert len(cyc) == 1, [f.render() for f in findings]
+    f = cyc[0]
+    assert f.path == "tpubft/fix.py" and f.line == 10, f.render()
+    assert "Grid._a" in f.message and "Grid._b" in f.message
+    assert f.key == "cycle:Grid._a|Grid._b"
+
+
+def test_lock_order_cycle_through_call_edge(fixture_tree):
+    src = """\
+from tpubft.utils.racecheck import make_lock
+
+class Grid:
+    def __init__(self):
+        self._a = make_lock("a")
+        self._b = make_lock("b")
+
+    def _take_a(self):
+        with self._a:
+            pass
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._b:
+            self._take_a()     # b -> a through the call graph
+"""
+    root = fixture_tree(src)
+    findings, _, _ = analyze(root, pass_ids=["lock-order"])
+    assert any(f.pass_id == "lock-order"
+               and f.key == "cycle:Grid._a|Grid._b" for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_condition_unifies_with_backing_lock(fixture_tree):
+    src = """\
+import threading
+from tpubft.utils.racecheck import make_lock
+
+class Lane:
+    def __init__(self):
+        self._mu = make_lock("lane")
+        self._cond = threading.Condition(self._mu)
+        self.depth = 0
+
+    def from_a(self):
+        with self._cond:
+            self.depth += 1    # guarded: Condition wraps the make_lock
+
+    def from_b(self):
+        with self._mu:
+            self.depth -= 1
+"""
+    root = fixture_tree(src, {
+        ("tpubft/fix.py", "Lane", "from_a"): frozenset({"a"}),
+        ("tpubft/fix.py", "Lane", "from_b"): frozenset({"b"}),
+    })
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles", "static-race",
+                                       "lock-order"])
+    assert [f for f in findings if f.pass_id != "thread-roles"] == [], \
+        [f.render() for f in findings]
+
+
+_BLOCKING = """\
+import time
+
+class Loop:
+    def _run(self):
+        time.sleep(0.5)        # line 5: parks the dispatcher
+        x = ",".join(["a"])    # str.join: not a thread join
+        return x
+"""
+
+
+def test_dispatcher_blocking_fixture(fixture_tree):
+    root = fixture_tree(_BLOCKING, {
+        ("tpubft/fix.py", "Loop", "_run"): frozenset({"dispatcher"}),
+    })
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles",
+                                       "dispatcher-blocking"])
+    blk = [f for f in findings if f.pass_id == "dispatcher-blocking"]
+    assert len(blk) == 1, [f.render() for f in findings]
+    assert (blk[0].path, blk[0].line) == ("tpubft/fix.py", 5)
+    assert "time.sleep" in blk[0].message
+
+
+def test_thread_join_flagged_str_join_not(fixture_tree):
+    src = """\
+import threading
+
+class Loop:
+    def _run(self):
+        t = threading.Thread(target=print)
+        t.join()               # line 6: thread join
+        return ",".join(["x", "y"])
+"""
+    root = fixture_tree(src, {
+        ("tpubft/fix.py", "Loop", "_run"): frozenset({"dispatcher"}),
+    })
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles",
+                                       "dispatcher-blocking"])
+    blk = [f for f in findings if f.pass_id == "dispatcher-blocking"]
+    assert len(blk) == 1 and blk[0].line == 6, \
+        [f.render() for f in findings]
+
+
+def test_unseeded_thread_target_is_flagged(fixture_tree):
+    src = """\
+import threading
+
+class Svc:
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        pass
+"""
+    root = fixture_tree(src)
+    findings, _, _ = analyze(root, pass_ids=["thread-roles"])
+    assert any("unseeded thread entry point" in f.message
+               and "Svc._run" in f.message for f in findings), \
+        [f.render() for f in findings]
+
+
+def test_stale_role_seed_is_flagged(fixture_tree):
+    root = fixture_tree("class A:\n    pass\n", {
+        ("tpubft/fix.py", "Gone", "_run"): frozenset({"dispatcher"}),
+    })
+    findings, _, _ = analyze(root, pass_ids=["thread-roles"])
+    assert any("stale" in f.message and "Gone._run" in f.message
+               for f in findings)

@@ -1,0 +1,126 @@
+"""Equivalence coverage for the four legacy lints migrated into the
+tpulint framework: each pass, invoked through the framework
+(tools/tpulint/passes/*), still rejects its original violation corpus,
+and the tools/check_*.py CLI shims return byte-identical violation
+lists to the framework implementation they delegate to."""
+import os
+import sys
+import textwrap
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.tpulint.passes import (crashpoints, device_seam,  # noqa: E402
+                                  hotpath, imports_)
+from tools import (check_crashpoints, check_device_seam,  # noqa: E402
+                   check_hotpath, check_imports)
+
+
+def test_imports_corpus(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""\
+        import requests                       # violation
+        from cryptography import x509         # violation
+        import os                             # stdlib: fine
+        import jax                            # approved: fine
+        try:
+            import torch                      # soft-guarded: fine
+        except ImportError:
+            torch = None
+
+        def lazy():
+            import pandas                     # lazy: fine
+    """))
+    got = imports_.find_violations(str(tmp_path))
+    mods = sorted(m for _, _, m in got)
+    assert mods == ["cryptography", "requests"], got
+    assert got == check_imports.find_violations(str(tmp_path))
+
+
+def test_device_seam_corpus(tmp_path):
+    mod_dir = tmp_path / "tpubft" / "ops"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "rogue.py").write_text(textwrap.dedent("""\
+        from tpubft.ops.dispatch import device_dispatch
+
+        def kernel_call():
+            with device_dispatch():
+                pass
+    """))
+    (mod_dir / "dispatch.py").write_text(
+        "def device_dispatch():\n    return None\n")
+    got = device_seam.find_violations(str(tmp_path))
+    files = {p for p, _, _ in got}
+    assert files == {os.path.join("tpubft", "ops", "rogue.py")}, got
+    assert got == check_device_seam.find_violations(str(tmp_path))
+
+
+def test_hotpath_corpus(tmp_path):
+    """The ISSUE's fourth seeded defect: a forbidden verify in a
+    hot-path handler, reported at the correct file:line."""
+    mod_dir = tmp_path / "tpubft" / "consensus"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "incoming.py").write_text(textwrap.dedent("""\
+        class Dispatcher:
+            def _loop_body(self):
+                msg = m.unpack(raw)
+                ok = self.sig.verify(msg)
+                return ok
+    """))
+    narrowed = {("tpubft/consensus/incoming.py", "Dispatcher"):
+                {"_loop_body"}}
+    got = hotpath.find_violations(str(tmp_path), hot_path=narrowed)
+    assert [(p, ln) for p, ln, _ in got] == [
+        ("tpubft/consensus/incoming.py", 3),
+        ("tpubft/consensus/incoming.py", 4)], got
+    assert "unpack" in got[0][2] and "verify" in got[1][2]
+
+
+def test_hotpath_missing_handler_flagged(tmp_path):
+    mod_dir = tmp_path / "tpubft" / "consensus"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "incoming.py").write_text(
+        "class Dispatcher:\n    def other(self):\n        pass\n")
+    narrowed = {("tpubft/consensus/incoming.py", "Dispatcher"):
+                {"_loop_body"}}
+    got = hotpath.find_violations(str(tmp_path), hot_path=narrowed)
+    assert len(got) == 1 and "not found" in got[0][2], got
+
+
+def test_crashpoints_corpus(tmp_path):
+    harness = tmp_path / "tpubft" / "testing"
+    harness.mkdir(parents=True)
+    (harness / "crashpoints.py").write_text(
+        'REGISTRY = {\n    "exec.apply": "doc",\n'
+        '    "phantom.seam": "doc",\n}\n\n'
+        "def crashpoint(name, **kw):\n    pass\n")
+    prod = tmp_path / "tpubft" / "consensus"
+    prod.mkdir(parents=True)
+    (prod / "lane.py").write_text(textwrap.dedent("""\
+        from tpubft.testing.crashpoints import crashpoint
+
+        def apply():
+            crashpoint("exec.apply")
+            crashpoint("not.registered")
+    """))
+    got = crashpoints.find_violations(str(tmp_path))
+    msgs = " | ".join(m for _, _, m in got)
+    assert "'not.registered'" in msgs and "unregistered" in msgs
+    assert "'phantom.seam'" in msgs and "phantom" in msgs
+    assert got == check_crashpoints.find_violations(str(tmp_path))
+
+
+def test_crashpoints_wrong_root_fails(tmp_path):
+    got = crashpoints.find_violations(str(tmp_path / "nope"))
+    assert got and "wrong root" in got[0][2]
+
+
+def test_shim_configs_are_copies():
+    """The shims expose mutable per-module copies: a test narrowing
+    check_hotpath.HOT_PATH must never leak into the framework pass."""
+    assert check_hotpath.HOT_PATH == hotpath.HOT_PATH
+    assert check_hotpath.HOT_PATH is not hotpath.HOT_PATH
+    for k in check_hotpath.HOT_PATH:
+        assert check_hotpath.HOT_PATH[k] is not hotpath.HOT_PATH[k]
+    assert check_imports.APPROVED == imports_.APPROVED
+    assert check_imports.APPROVED is not imports_.APPROVED

@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.tpulint` works from the repo
+# root; the individual check_*.py lint CLIs remain directly runnable.

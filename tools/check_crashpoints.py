@@ -1,173 +1,41 @@
 """Crashpoint lint: the registry, the seams, and the tests agree.
 
-The recovery drills (tpubft/testing/campaign.py, tests) address
-durability seams BY NAME — `crashpoint("vc.persist", ...)` in the
-replica, `arm("vc.persist")` / `TPUBFT_CRASHPOINT=vc.persist` in the
-harness. The whole scheme decays silently if those names drift: a
-renamed seam turns the drill that covers it into a no-op that waits for
-a crash that never comes (masked only by its timeout), and a registry
-entry whose seam was refactored away reads as coverage that no longer
-exists. This lint (wired into tier-1 by tests/test_check_crashpoints.py)
-parses every module under tpubft/, benchmarks/ and tests/ and enforces:
-
-  * every name passed to `crashpoint(...)` / `arm(...)` — and every
-    name inside a TPUBFT_CRASHPOINT env value — is a string literal
-    present in `crashpoints.REGISTRY`;
-  * every REGISTRY name is threaded at >= 1 real seam (a
-    `crashpoint("<name>")` call site outside tpubft/testing/);
-  * zero scanned seams (wrong root, package rename) fails loudly
-    rather than reporting a vacuous OK.
-
-Name uniqueness is enforced structurally (REGISTRY is a dict) — what
-this lint adds is the cross-file agreement a dict cannot see.
+CLI/back-compat shim — the implementation now lives in the unified
+analyzer framework (tools/tpulint/passes/crashpoints.py; run everything
+with `python -m tools.tpulint`). Enforced: every `crashpoint(...)` /
+`arm(...)` name (and every TPUBFT_CRASHPOINT env literal) is a string
+literal registered in crashpoints.REGISTRY; every REGISTRY entry is
+threaded at >= 1 real seam outside the harness; zero scanned modules
+fails loudly rather than reporting a vacuous OK.
 
 Usage:
   python tools/check_crashpoints.py [root]    # default: the repo root
-Exit 1 with one line per violation.
+Exit 1 with one line per violation. Wired into tier-1 by
+tests/test_check_crashpoints.py.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Set, Tuple
+from typing import List
 
-Violation = Tuple[str, int, str]
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-HOOK_FUNCS = {"crashpoint", "arm"}
-SCAN_DIRS = ("tpubft", "benchmarks", "tests")
-# seams live in production code: registry coverage is only satisfied by
-# a call site outside the harness itself
-HARNESS_PREFIXES = (os.path.join("tpubft", "testing") + os.sep,
-                    "benchmarks" + os.sep, "tests" + os.sep)
+from tools.tpulint.passes import crashpoints as _impl  # noqa: E402
 
-
-def _literal_name(node: ast.Call) -> Tuple[bool, str]:
-    """(is_literal, value) of the call's first positional arg / name=."""
-    arg = node.args[0] if node.args else next(
-        (kw.value for kw in node.keywords if kw.arg == "name"), None)
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-        return True, arg.value
-    return False, ""
+HOOK_FUNCS = _impl.HOOK_FUNCS
+SCAN_DIRS = _impl.SCAN_DIRS
+HARNESS_PREFIXES = _impl.HARNESS_PREFIXES
 
 
-def _env_names(node: ast.AST) -> List[str]:
-    """Crashpoint names inside string literals shaped like env specs:
-    {"TPUBFT_CRASHPOINT": "name[:hit]"} dict displays."""
-    names: List[str] = []
-    if isinstance(node, ast.Dict):
-        for k, v in zip(node.keys, node.values):
-            key = getattr(k, "value", None)
-            is_env_key = key == "TPUBFT_CRASHPOINT" or (
-                isinstance(k, ast.Name) and k.id == "ENV_VAR")
-            if is_env_key and isinstance(v, ast.Constant) \
-                    and isinstance(v.value, str):
-                names.append(v.value.partition(":")[0])
-    return names
-
-
-def _scan_module(path: str, rel: str, registry: Set[str],
-                 seams: Dict[str, int]) -> List[Violation]:
-    with open(path, "rb") as f:
-        try:
-            tree = ast.parse(f.read(), filename=path)
-        except SyntaxError as e:
-            return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
-    out: List[Violation] = []
-    in_harness = rel.startswith(HARNESS_PREFIXES)
-    for node in ast.walk(tree):
-        for name in _env_names(node):
-            if name not in registry:
-                out.append((rel, node.lineno,
-                            f"TPUBFT_CRASHPOINT={name!r} names an "
-                            f"unregistered crashpoint"))
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        called = (fn.id if isinstance(fn, ast.Name)
-                  else fn.attr if isinstance(fn, ast.Attribute) else None)
-        if called not in HOOK_FUNCS:
-            continue
-        is_lit, name = _literal_name(node)
-        if not is_lit:
-            # registry.REGISTRY-driven loops (the lint's own tests, a
-            # drill iterating all seams) are fine for arm(); a seam
-            # itself must be a greppable literal
-            if called == "crashpoint":
-                out.append((rel, node.lineno,
-                            "crashpoint() seam name must be a string "
-                            "literal (drills address seams by grep)"))
-            continue
-        if name not in registry:
-            out.append((rel, node.lineno,
-                        f"{called}({name!r}) references an unregistered "
-                        f"crashpoint (add it to crashpoints.REGISTRY)"))
-        elif called == "crashpoint" and not in_harness \
-                and rel != os.path.join("tpubft", "testing",
-                                        "crashpoints.py"):
-            seams[name] = seams.get(name, 0) + 1
-    return out
-
-
-def _load_registry(root: str) -> Tuple[Set[str], List[Violation]]:
-    """REGISTRY keys, AST-parsed from the root's own crashpoints.py (no
-    import: the module under test must be the one under `root`, not
-    whatever sys.modules cached)."""
-    rel = os.path.join("tpubft", "testing", "crashpoints.py")
-    path = os.path.join(root, rel)
-    if not os.path.exists(path):
-        return set(), [(rel, 0, "crashpoints.py not found — wrong root?")]
-    with open(path, "rb") as f:
-        tree = ast.parse(f.read(), filename=path)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.AnnAssign) or isinstance(node, ast.Assign):
-            targets = ([node.target] if isinstance(node, ast.AnnAssign)
-                       else node.targets)
-            if any(isinstance(t, ast.Name) and t.id == "REGISTRY"
-                   for t in targets) and isinstance(node.value, ast.Dict):
-                keys = [k.value for k in node.value.keys
-                        if isinstance(k, ast.Constant)]
-                return set(keys), []
-    return set(), [(rel, 0, "REGISTRY dict literal not found")]
-
-
-def find_violations(root: str) -> List[Violation]:
-    registry, out = _load_registry(root)
-    if out:
-        return out
-    seams: Dict[str, int] = {}
-    scanned = 0
-    for sub in SCAN_DIRS:
-        top = os.path.join(root, sub)
-        for dirpath, _dirnames, filenames in os.walk(top):
-            for fn in sorted(filenames):
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                rel = os.path.relpath(path, root)
-                scanned += 1
-                out.extend(_scan_module(path, rel, registry, seams))
-    if not scanned:
-        # a wrong root must FAIL, not report a vacuous OK
-        out.append((root, 0, "no Python modules found to scan — wrong "
-                             "root? (expected <root>/{%s}/**/*.py)"
-                             % ",".join(SCAN_DIRS)))
-        return sorted(out)
-    for name in sorted(registry - set(seams)):
-        out.append((os.path.join("tpubft", "testing", "crashpoints.py"), 0,
-                    f"REGISTRY entry {name!r} is not threaded at any "
-                    f"durability seam (phantom coverage — remove it or "
-                    f"add the crashpoint() call)"))
-    if not seams:
-        out.append((root, 0, "zero crashpoint seams found outside the "
-                             "harness — the recovery drills cover "
-                             "nothing"))
-    return sorted(out)
+def find_violations(root: str):
+    return _impl.find_violations(root)
 
 
 def main(argv: List[str]) -> int:
-    root = argv[1] if len(argv) > 1 else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = argv[1] if len(argv) > 1 else _ROOT
     violations = find_violations(root)
     for path, lineno, msg in violations:
         print(f"{path}:{lineno}: {msg}")

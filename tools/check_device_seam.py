@@ -1,85 +1,41 @@
 """Device-seam lint: every kernel call site goes through the breaker.
 
-The degradation plane (tpubft/utils/breaker.py + ops/dispatch.py) only
-works if NOTHING dispatches to the accelerator outside the
-breaker-guarded `device_section(kind)` seam: a naked
-`device_dispatch()` call site would bypass failure classification, the
-OPEN fast-fail, and the half-open probe accounting — a device loss
-would wedge or crash that caller instead of degrading it to its scalar
-fallback. Like tools/check_hotpath.py, the property is enforced by
-construction: this lint (wired into tier-1 by
-tests/test_check_device_seam.py) parses every module under tpubft/ and
-rejects any reference to `device_dispatch` — import, call, or
-attribute — outside `tpubft/ops/dispatch.py` itself, where the raw
-gate lives.
+CLI/back-compat shim — the implementation now lives in the unified
+analyzer framework (tools/tpulint/passes/device_seam.py; run everything
+with `python -m tools.tpulint`). Any reference to the raw
+`device_dispatch` gate — import, call, or attribute — outside
+tpubft/ops/dispatch.py bypasses failure classification, the OPEN
+fast-fail, and half-open probe accounting, so it is rejected by
+construction; a zero-module scan fails loudly.
 
 Usage:
   python tools/check_device_seam.py [root]    # default: the repo root
-Exit 1 with one line per violation.
+Exit 1 with one line per violation. Wired into tier-1 by
+tests/test_check_device_seam.py.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Tuple
+from typing import List
 
-FORBIDDEN = "device_dispatch"
-# the one module allowed to touch the raw gate (it defines it and wraps
-# it in the breaker-guarded device_section)
-ALLOWED = {os.path.join("tpubft", "ops", "dispatch.py")}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
+from tools.tpulint.passes import device_seam as _impl  # noqa: E402
 
-def _scan_module(path: str, rel: str) -> List[Tuple[str, int, str]]:
-    with open(path, "rb") as f:
-        try:
-            tree = ast.parse(f.read(), filename=path)
-        except SyntaxError as e:
-            return [(rel, e.lineno or 0, f"syntax error: {e.msg}")]
-    out: List[Tuple[str, int, str]] = []
-    for node in ast.walk(tree):
-        hit = None
-        if isinstance(node, ast.Name) and node.id == FORBIDDEN:
-            hit = f"references {FORBIDDEN}"
-        elif isinstance(node, ast.Attribute) and node.attr == FORBIDDEN:
-            hit = f"references .{FORBIDDEN}"
-        elif isinstance(node, ast.ImportFrom) \
-                and any(a.name == FORBIDDEN for a in node.names):
-            hit = f"imports {FORBIDDEN}"
-        if hit:
-            out.append((rel, node.lineno,
-                        f"{hit} — kernel call sites must use the "
-                        f"breaker-guarded device_section(kind) seam "
-                        f"(tpubft/ops/dispatch.py)"))
-    return out
+FORBIDDEN = _impl.FORBIDDEN
+ALLOWED = set(_impl.ALLOWED)
 
 
-def find_violations(root: str) -> List[Tuple[str, int, str]]:
-    out: List[Tuple[str, int, str]] = []
-    pkg = os.path.join(root, "tpubft")
-    scanned = 0
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            scanned += 1
-            if rel in ALLOWED:
-                continue
-            out.extend(_scan_module(path, rel))
-    if not scanned:
-        # a wrong root (or a package rename) must FAIL, not report a
-        # vacuous OK — the enforced-by-construction property would
-        # silently stop being enforced
-        out.append((pkg, 0, "no Python modules found to scan — wrong "
-                            "root? (expected <root>/tpubft/**/*.py)"))
-    return sorted(out)
+def find_violations(root: str):
+    return _impl.find_violations(root, forbidden=FORBIDDEN,
+                                 allowed=ALLOWED)
 
 
 def main(argv: List[str]) -> int:
-    root = argv[1] if len(argv) > 1 else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = argv[1] if len(argv) > 1 else _ROOT
     violations = find_violations(root)
     for path, lineno, msg in violations:
         print(f"{path}:{lineno}: {msg}")

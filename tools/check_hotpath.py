@@ -1,111 +1,47 @@
 """Dispatcher hot-path lint: no parse/verify call sites in the
 admitted-message handlers.
 
-The admission plane (tpubft/consensus/admission.py) exists so the single
-consensus dispatcher — the thread all protocol state mutates on — never
-pays `m.unpack()` or a SigManager verification for admitted traffic.
-That property only survives refactors if it is enforced by construction:
-this lint (tools/check_imports.py-style, wired into tier-1 by
-tests/test_check_hotpath.py) parses the hot-path functions and rejects
-any direct call to
-
-  * `unpack(...)` / `m.unpack(...)`          (full message parse)
-  * `<anything>.verify(...)` / `.verify_batch(...)`  (signature check)
-
-inside them. Inline fallbacks for the legacy `admission_workers=0` path
-are still allowed — they live in dedicated `_verify_*` helper seams
-OUTSIDE the hot list, and the handlers reach them only when no admission
-verdict is attached. Adding a new parse/verify to a handler forces the
-author through that seam, keeping the control thread lean.
+CLI/back-compat shim — the implementation now lives in the unified
+analyzer framework (tools/tpulint/passes/hotpath.py; run everything
+with `python -m tools.tpulint`). The admission plane exists so the
+consensus dispatcher never pays `m.unpack()` or a SigManager
+verification for admitted traffic; this lint rejects any direct
+`unpack()` / `.verify()` / `.verify_batch()` call inside the hot-path
+handlers, and flags a listed handler that disappears from the source
+(the list must track the code). Inline fallbacks for the legacy
+`admission_workers=0` path live in `_verify_*` seams OUTSIDE the hot
+list.
 
 Usage:
   python tools/check_hotpath.py           # lints the repo's tpubft/
-Exit 1 with one line per violation.
+Exit 1 with one line per violation. Wired into tier-1 by
+tests/test_check_hotpath.py.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Dict, List, Set, Tuple
+from typing import List
 
-# (module path, class name) -> function names forming the dispatcher's
-# admitted-message hot path: the loop itself plus every handler an
-# AdmittedMsg can reach synchronously on the dispatcher thread.
-HOT_PATH: Dict[Tuple[str, str], Set[str]] = {
-    ("tpubft/consensus/incoming.py", "Dispatcher"): {
-        "_loop_body",
-    },
-    ("tpubft/consensus/replica.py", "Replica"): {
-        "_on_admitted",
-        "_dispatch_external",
-        "_on_client_request",
-        "_handle_client_request",
-        "_post_admission",
-        "_on_pre_prepare",
-        "_on_share",
-        "_handle_full_cert",
-        "_on_checkpoint",
-        "_on_time_opinion",
-        "_on_ask_to_leave_view",
-        "_on_view_change",
-        "_on_new_view",
-        "_on_restart_ready",
-    },
-}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-FORBIDDEN_CALLS = {"unpack", "verify", "verify_batch"}
+from tools.tpulint.passes import hotpath as _impl  # noqa: E402
+
+# module-local copies: tests narrow/mutate these per loaded instance
+# without touching the shared pass configuration
+HOT_PATH = {k: set(v) for k, v in _impl.HOT_PATH.items()}
+FORBIDDEN_CALLS = set(_impl.FORBIDDEN_CALLS)
 
 
-def _call_name(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return ""
-
-
-def _functions(tree: ast.Module, class_name: str):
-    for node in tree.body:
-        if isinstance(node, ast.ClassDef) and node.name == class_name:
-            for item in node.body:
-                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    yield item
-
-
-def find_violations(root: str) -> List[Tuple[str, int, str]]:
-    out: List[Tuple[str, int, str]] = []
-    for (rel, cls), fn_names in sorted(HOT_PATH.items()):
-        path = os.path.join(root, rel)
-        with open(path, "rb") as f:
-            tree = ast.parse(f.read(), filename=path)
-        found: Set[str] = set()
-        for fn in _functions(tree, cls):
-            if fn.name not in fn_names:
-                continue
-            found.add(fn.name)
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Call) \
-                        and _call_name(node) in FORBIDDEN_CALLS:
-                    out.append((
-                        os.path.join(rel),
-                        node.lineno,
-                        f"{cls}.{fn.name} calls {_call_name(node)}() — "
-                        f"hot-path handlers must consult the admission "
-                        f"verdict / route through a _verify_* seam"))
-        for missing in sorted(fn_names - found):
-            # a renamed handler silently leaving the lint's coverage is
-            # itself a violation: the list must track the code
-            out.append((rel, 0,
-                        f"{cls}.{missing} not found — update "
-                        f"tools/check_hotpath.py HOT_PATH"))
-    return sorted(out)
+def find_violations(root: str):
+    return _impl.find_violations(root, hot_path=HOT_PATH,
+                                 forbidden=FORBIDDEN_CALLS)
 
 
 def main(argv: List[str]) -> int:
-    root = argv[1] if len(argv) > 1 else os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
+    root = argv[1] if len(argv) > 1 else _ROOT
     violations = find_violations(root)
     for path, lineno, msg in violations:
         print(f"{path}:{lineno}: {msg}")

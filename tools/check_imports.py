@@ -1,17 +1,13 @@
 """Import-hygiene lint: no module-level third-party imports in tpubft/.
 
-The product tree must import cleanly in a bare environment — the whole
-point of the self-hosted crypto engine (tpubft/crypto/scalar.py) is that
-nothing under tpubft/ hard-depends on an uninstallable package (the seed
-regression: a module-level `import cryptography` in crypto/cpu.py broke
-collection of 32/51 test modules on hosts without OpenSSL bindings).
-
-Rule: a module-level `import X` / `from X import ...` (executed at
-import time — anything outside a function/class body and outside a
-`try:` soft-import guard) may only name the stdlib, the repo's own
-packages, or an approved always-present dependency (`jax`, `numpy` —
-baked into the image). Optional packages must be imported inside
-functions or behind a runtime feature probe (crypto/cpu._openssl()).
+CLI/back-compat shim — the implementation now lives in the unified
+analyzer framework (tools/tpulint/passes/imports_.py; run everything
+with `python -m tools.tpulint`). The rule: a module-level `import X` /
+`from X import ...` may only name the stdlib, the repo's own packages,
+or an approved always-present dependency (`jax`, `numpy`); optional
+packages import inside functions or behind a `try:` soft-import guard
+(the seed regression: a module-level `import cryptography` broke
+collection of 32/51 test modules).
 
 Usage:
   python tools/check_imports.py [root]     # default: tpubft/
@@ -20,98 +16,27 @@ tests/test_check_imports.py.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Iterator, List, Tuple
+from typing import List
 
-APPROVED = {"jax", "numpy"}
-INTERNAL = {"tpubft", "tests", "tools", "benchmarks"}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
+from tools.tpulint.passes import imports_ as _impl  # noqa: E402
 
-def _stdlib_names() -> frozenset:
-    return frozenset(sys.stdlib_module_names)  # 3.10+
-
-
-def _is_type_checking_test(test: ast.expr) -> bool:
-    """`if TYPE_CHECKING:` / `if typing.TYPE_CHECKING:` bodies never
-    execute at runtime — imports there are annotations-only, not a
-    collection-time dependency."""
-    if isinstance(test, ast.Name):
-        return test.id == "TYPE_CHECKING"
-    if isinstance(test, ast.Attribute):
-        return test.attr == "TYPE_CHECKING"
-    return False
+APPROVED = set(_impl.APPROVED)
+INTERNAL = set(_impl.INTERNAL)
 
 
-def _top_level_import_nodes(tree: ast.Module) -> Iterator[ast.stmt]:
-    """Statements executed at import time: the module body plus every
-    compound-statement body that runs during import — `if`/`else` (a
-    version gate still executes), `for`/`while` (+else), `with`, and a
-    `try`'s else/finally. EXCLUDED: `try:` bodies and their handlers
-    (try/except ImportError is the sanctioned soft-import idiom),
-    function/class bodies (lazy imports), and `if TYPE_CHECKING:`
-    (never executes)."""
-    stack: List[ast.stmt] = list(tree.body)
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.Import, ast.ImportFrom)):
-            yield node
-        elif isinstance(node, ast.If):
-            if not _is_type_checking_test(node.test):
-                stack.extend(node.body)
-            stack.extend(node.orelse)
-        elif isinstance(node, (ast.For, ast.While)):
-            stack.extend(node.body)
-            stack.extend(node.orelse)
-        elif isinstance(node, ast.With):
-            stack.extend(node.body)
-        elif isinstance(node, ast.Try):
-            stack.extend(node.orelse)
-            stack.extend(node.finalbody)
-
-
-def _imported_roots(node: ast.stmt) -> Iterator[Tuple[str, int]]:
-    if isinstance(node, ast.Import):
-        for alias in node.names:
-            yield alias.name.split(".")[0], node.lineno
-    elif isinstance(node, ast.ImportFrom):
-        if node.level:                       # relative import: internal
-            return
-        if node.module:
-            yield node.module.split(".")[0], node.lineno
-
-
-def find_violations(root: str) -> List[Tuple[str, int, str]]:
-    """Walk `root` for .py files; return (path, lineno, module) for each
-    module-level import of a non-stdlib, non-approved package."""
-    stdlib = _stdlib_names()
-    out: List[Tuple[str, int, str]] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, "rb") as f:
-                try:
-                    tree = ast.parse(f.read(), filename=path)
-                except SyntaxError as e:
-                    out.append((path, e.lineno or 0, f"<syntax error: {e}>"))
-                    continue
-            for node in _top_level_import_nodes(tree):
-                for mod, lineno in _imported_roots(node):
-                    if (mod in stdlib or mod in APPROVED
-                            or mod in INTERNAL):
-                        continue
-                    out.append((path, lineno, mod))
-    return sorted(out)
+def find_violations(root: str):
+    return _impl.find_violations(root, approved=APPROVED,
+                                 internal=INTERNAL)
 
 
 def main(argv: List[str]) -> int:
-    root = argv[1] if len(argv) > 1 else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "tpubft")
+    root = argv[1] if len(argv) > 1 else os.path.join(_ROOT, "tpubft")
     violations = find_violations(root)
     for path, lineno, mod in violations:
         print(f"{path}:{lineno}: module-level import of third-party "

@@ -1,0 +1,169 @@
+"""tpulint — whole-program concurrency analyzer + unified lint runner.
+
+One runner (`python -m tools.tpulint`, wired into tier-1 by
+tests/test_tpulint.py) over eight passes:
+
+  thread-roles        seed + propagate which thread(s) every function
+                      can run on (tools/tpulint/rolemap.py)
+  static-race         cross-role `self.<attr>` stores must sit in a
+                      make_lock/make_condition region (AST attribution)
+  lock-order          global static lock-order graph; cycles fail
+                      (complements the runtime LockOrderChecker, which
+                      only sees executed paths)
+  dispatcher-blocking no sleep/join/socket/fsync/device-compile on the
+                      consensus thread
+  imports / hotpath / device-seam / crashpoints
+                      the four historical tools/check_*.py lints,
+                      re-hosted on the shared loader (their CLI shims
+                      remain for back-compat)
+
+Findings are suppressed only through tools/tpulint/baseline.toml —
+every entry carries a one-line justification, stale or malformed
+entries fail the run (see docs/OPERATIONS.md "Static analysis &
+concurrency lint").
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.tpulint import rolemap
+from tools.tpulint.core import (BaselineError, Finding, ScanError,
+                                apply_baseline, load_modules,
+                                parse_baseline)
+from tools.tpulint.program import Program
+
+DEFAULT_BASELINE = os.path.join("tools", "tpulint", "baseline.toml")
+
+
+class Context:
+    """Shared per-run state: one module load and one Program build,
+    reused by every pass."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self._loads: Dict[Tuple[str, ...], tuple] = {}
+        self._program: Optional[Program] = None
+        self._roles: Optional[tuple] = None
+
+    def load(self, *subdirs: str):
+        """(modules, syntax-error findings) for the given scan roots —
+        cached; raises ScanError on a zero-module scan."""
+        key = tuple(subdirs)
+        if key not in self._loads:
+            self._loads[key] = load_modules(self.root, subdirs)
+        return self._loads[key]
+
+    @property
+    def program(self) -> Program:
+        """Whole-program index over tpubft/ minus the test-harness
+        exclusions (rolemap.CONCURRENCY_EXCLUDE)."""
+        if self._program is None:
+            mods, _ = self.load("tpubft")
+            keep = [m for m in mods
+                    if not m.rel.replace(os.sep, "/").startswith(
+                        rolemap.CONCURRENCY_EXCLUDE)]
+            self._program = Program(
+                keep, attr_hints=rolemap.ATTR_TYPE_HINTS,
+                return_hints=rolemap.RETURN_TYPE_HINTS)
+        return self._program
+
+    def ensure_roles(self):
+        if self._roles is None:
+            from tools.tpulint.passes.roles import compute_roles
+            self._roles = compute_roles(self)
+        return self._roles
+
+
+def run_passes(root: str, pass_ids: Optional[Sequence[str]] = None,
+               ) -> List[Finding]:
+    """Run the requested passes (default: all) and return raw findings
+    (pre-baseline). Loader syntax errors surface once."""
+    from tools.tpulint.passes import REGISTRY
+    ids = list(pass_ids) if pass_ids else list(REGISTRY)
+    unknown = [p for p in ids if p not in REGISTRY]
+    if unknown:
+        raise ScanError(f"unknown pass(es): {', '.join(unknown)} "
+                        f"(known: {', '.join(REGISTRY)})")
+    ctx = Context(root)
+    findings: List[Finding] = []
+    seen_syntax: Set[str] = set()
+    for pid in ids:
+        for f in REGISTRY[pid].run(ctx):
+            if f.pass_id == "loader":
+                if f.key in seen_syntax:
+                    continue
+                seen_syntax.add(f.key)
+            findings.append(f)
+    return findings
+
+
+def analyze(root: str, pass_ids: Optional[Sequence[str]] = None,
+            baseline_path: Optional[str] = None
+            ) -> Tuple[List[Finding], int, List[Finding]]:
+    """(surviving findings, n_suppressed, baseline errors)."""
+    from tools.tpulint.passes import REGISTRY
+    findings = run_passes(root, pass_ids)
+    if baseline_path is None:
+        return findings, 0, []
+    rel = os.path.relpath(baseline_path, root)
+    entries = parse_baseline(baseline_path) \
+        if os.path.exists(baseline_path) else []
+    known = list(REGISTRY) + ["loader"]
+    if pass_ids:
+        # partial run: entries for passes that did not run are neither
+        # applied nor stale-checked (their findings were never
+        # computed) — but unknown-pass entries must still fail
+        selected = set(pass_ids) | {"loader"}
+        entries = [e for e in entries
+                   if e.pass_id in selected or e.pass_id not in known]
+    return apply_baseline(findings, entries, known, rel)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="whole-program concurrency analyzer / lint runner")
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root (default: the tree containing this "
+                         "package)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="suppression file (default: "
+                         "tools/tpulint/baseline.toml under root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings, apply no suppressions")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    from tools.tpulint.passes import REGISTRY
+    if args.list_passes:
+        for pid, mod in REGISTRY.items():
+            first = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{pid:20s} {first}")
+        return 0
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pass_ids = args.passes.split(",") if args.passes else None
+    baseline = None if args.no_baseline else (
+        args.baseline or os.path.join(root, DEFAULT_BASELINE))
+    try:
+        findings, n_suppressed, errors = analyze(root, pass_ids, baseline)
+    except (ScanError, BaselineError) as e:
+        print(f"tpulint: FATAL: {e}", file=sys.stderr)
+        return 2
+    for f in findings + errors:
+        print(f.render())
+    if findings or errors:
+        print(f"tpulint: {len(findings)} finding(s), "
+              f"{len(errors)} baseline error(s), "
+              f"{n_suppressed} suppressed", file=sys.stderr)
+        return 1
+    n = len(pass_ids) if pass_ids else len(REGISTRY)
+    print(f"OK: tpulint clean — {n} pass(es), "
+          f"{n_suppressed} baselined finding(s)")
+    return 0

@@ -1,0 +1,214 @@
+"""tpulint framework core: module loader, findings, baseline.
+
+Shared by every pass (tools/tpulint/passes/): one AST parse per module,
+one scan-root convention, one loud zero-scan failure mode (the
+tools/check_device_seam.py convention — a wrong root or a package
+rename must FAIL, never report a vacuous OK), and one suppression
+mechanism (tools/tpulint/baseline.toml: every entry names a pass, a
+stable finding key, and a one-line justification; a stale entry — one
+that matches no current finding — is itself an error, so the baseline
+can only shrink silently, never rot).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ScanError(RuntimeError):
+    """Zero modules scanned or an unusable scan root — loud failure."""
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer finding. `key` is the stable baseline handle: it
+    deliberately excludes line numbers so an unrelated edit above a
+    baselined site does not invalidate the entry."""
+    pass_id: str
+    path: str                 # repo-relative
+    line: int
+    key: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+class SourceModule:
+    """One parsed module under a scan root."""
+    __slots__ = ("rel", "path", "tree")
+
+    def __init__(self, rel: str, path: str, tree: ast.Module) -> None:
+        self.rel = rel
+        self.path = path
+        self.tree = tree
+
+
+def load_modules(root: str, subdirs: Sequence[str] = ("tpubft",),
+                 ) -> Tuple[List[SourceModule], List[Finding]]:
+    """Walk `root/<subdir>` for .py files and parse each once. Returns
+    (modules, syntax-error findings). Zero parseable files raises
+    ScanError — the enforced-by-construction properties downstream
+    would silently stop being enforced on a vacuous scan."""
+    mods: List[SourceModule] = []
+    findings: List[Finding] = []
+    scanned = 0
+    for sub in subdirs:
+        top = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root)
+                scanned += 1
+                with open(path, "rb") as f:
+                    try:
+                        tree = ast.parse(f.read(), filename=path)
+                    except SyntaxError as e:
+                        findings.append(Finding(
+                            "loader", rel, e.lineno or 0,
+                            f"syntax:{rel}", f"syntax error: {e.msg}"))
+                        continue
+                mods.append(SourceModule(rel, path, tree))
+    if not scanned:
+        raise ScanError(
+            f"no Python modules found under {root} (subdirs: "
+            f"{','.join(subdirs)}) — wrong root? A zero-module scan "
+            f"must fail, not report a vacuous OK")
+    return mods, findings
+
+
+# ----------------------------------------------------------------------
+# baseline (suppression) file
+# ----------------------------------------------------------------------
+
+@dataclass
+class BaselineEntry:
+    pass_id: str
+    key: str
+    reason: str
+    line: int
+    used: bool = field(default=False, compare=False)
+
+
+class BaselineError(RuntimeError):
+    """Malformed baseline file — fail loudly, never half-apply."""
+
+
+def _toml_string(raw: str, path: str, lineno: int) -> str:
+    raw = raw.strip()
+    if len(raw) < 2 or raw[0] != '"' or raw[-1] != '"':
+        raise BaselineError(
+            f"{path}:{lineno}: value must be a double-quoted string")
+    body = raw[1:-1]
+    if '"' in body.replace('\\"', ""):
+        raise BaselineError(
+            f"{path}:{lineno}: unescaped quote inside string")
+    return body.replace('\\"', '"').replace("\\\\", "\\")
+
+
+def parse_baseline(path: str) -> List[BaselineEntry]:
+    """Minimal TOML-subset reader for baseline.toml (Python 3.10 has no
+    tomllib): `[[suppress]]` array-of-tables with `pass` / `key` /
+    `reason` basic-string fields and `#` comments. Anything else is a
+    BaselineError — a suppression file must never be half-understood."""
+    entries: List[BaselineEntry] = []
+    cur: Optional[Dict[str, object]] = None
+
+    def flush() -> None:
+        nonlocal cur
+        if cur is None:
+            return
+        for fld in ("pass", "key", "reason"):
+            if fld not in cur:
+                raise BaselineError(
+                    f"{path}:{cur['line']}: suppress entry missing "
+                    f"required field {fld!r}")
+        if not str(cur["reason"]).strip():
+            raise BaselineError(
+                f"{path}:{cur['line']}: empty `reason` — every baseline "
+                f"entry needs a one-line justification")
+        entries.append(BaselineEntry(str(cur["pass"]), str(cur["key"]),
+                                     str(cur["reason"]), int(cur["line"])))  # type: ignore[arg-type]
+        cur = None
+
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, raw_line in enumerate(f, 1):
+            line = raw_line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line == "[[suppress]]":
+                flush()
+                cur = {"line": lineno}
+                continue
+            if "=" in line and cur is not None:
+                name, _, value = line.partition("=")
+                name = name.strip()
+                if name not in ("pass", "key", "reason"):
+                    raise BaselineError(
+                        f"{path}:{lineno}: unknown field {name!r} "
+                        f"(allowed: pass, key, reason)")
+                # strip a trailing comment outside the string
+                value = value.strip()
+                if value.count('"') >= 2:
+                    end = value.rfind('"')
+                    value = value[:end + 1]
+                cur[name] = _toml_string(value, path, lineno)
+                continue
+            raise BaselineError(
+                f"{path}:{lineno}: unparseable line {line!r} (expected "
+                f"[[suppress]] tables with pass/key/reason strings)")
+    flush()
+    return entries
+
+
+def apply_baseline(findings: List[Finding], entries: List[BaselineEntry],
+                   known_passes: Sequence[str],
+                   baseline_rel: str) -> Tuple[List[Finding], int,
+                                               List[Finding]]:
+    """Split findings into (kept, n_suppressed, baseline_errors).
+    Baseline errors — an entry naming an unknown pass, a duplicate
+    (pass, key), or a stale entry matching no current finding — are
+    findings themselves: an unknown suppression key must fail loudly,
+    not silently suppress nothing."""
+    errors: List[Finding] = []
+    seen: Dict[Tuple[str, str], BaselineEntry] = {}
+    for e in entries:
+        if e.pass_id not in known_passes:
+            errors.append(Finding(
+                "baseline", baseline_rel, e.line,
+                f"unknown-pass:{e.pass_id}",
+                f"baseline entry names unknown pass {e.pass_id!r} "
+                f"(known: {', '.join(known_passes)})"))
+            continue
+        dup = seen.get((e.pass_id, e.key))
+        if dup is not None:
+            errors.append(Finding(
+                "baseline", baseline_rel, e.line,
+                f"dup:{e.pass_id}:{e.key}",
+                f"duplicate baseline entry for [{e.pass_id}] {e.key!r} "
+                f"(first at line {dup.line})"))
+            continue
+        seen[(e.pass_id, e.key)] = e
+    kept: List[Finding] = []
+    n_suppressed = 0
+    for f in findings:
+        e = seen.get((f.pass_id, f.key))
+        if e is not None:
+            e.used = True
+            n_suppressed += 1
+        else:
+            kept.append(f)
+    for e in seen.values():
+        if not e.used:
+            errors.append(Finding(
+                "baseline", baseline_rel, e.line,
+                f"stale:{e.pass_id}:{e.key}",
+                f"stale baseline entry: [{e.pass_id}] {e.key!r} matches "
+                f"no current finding — remove it (fixed findings must "
+                f"not leave dead suppressions behind)"))
+    return kept, n_suppressed, errors

@@ -1,0 +1,20 @@
+"""Pass registry. Order matters only for readability of reports;
+role inference is computed on demand (ctx.ensure_roles) by whichever
+dependent pass runs first."""
+from __future__ import annotations
+
+from tools.tpulint.passes import (blocking, crashpoints, device_seam,
+                                  hotpath, imports_, lockorder, races,
+                                  roles)
+
+# pass id -> module exposing run(ctx) -> List[Finding]
+REGISTRY = {
+    roles.PASS_ID: roles,                 # thread-roles
+    races.PASS_ID: races,                 # static-race
+    lockorder.PASS_ID: lockorder,         # lock-order
+    blocking.PASS_ID: blocking,           # dispatcher-blocking
+    imports_.PASS_ID: imports_,           # imports
+    hotpath.PASS_ID: hotpath,             # hotpath
+    device_seam.PASS_ID: device_seam,     # device-seam
+    crashpoints.PASS_ID: crashpoints,     # crashpoints
+}

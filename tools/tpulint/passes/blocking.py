@@ -1,0 +1,91 @@
+"""Pass: dispatcher blocking-call lint.
+
+The consensus dispatcher is THE protocol thread — every handler runs
+on it, so anything that parks it (a sleep, a thread join, a blocking
+socket/subprocess call, an fsync it didn't budget for, a device
+compile) stalls ordering for the whole replica. Any function whose
+inferred role set includes `dispatcher` must not call into the
+blocking table below. Legitimately-blocking dispatcher seams — the
+deliberate durability fsyncs, the bounded view-change drain barrier —
+are baselined with their justification rather than exempted in code,
+so every blocking site on the control thread is enumerable.
+
+`.join()` is flagged only with zero positional arguments: a thread
+join is `t.join()` / `t.join(timeout=...)`, while `str.join` always
+takes exactly one positional iterable.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.tpulint.core import Finding
+from tools.tpulint.program import (Program, dotted_expr, fid_key,
+                                   walk_body)
+
+PASS_ID = "dispatcher-blocking"
+
+# fully-qualified callables that park the calling thread
+BLOCKING_DOTTED = {
+    "time.sleep": "sleeps the consensus thread",
+    "os.fsync": "synchronous disk flush",
+    "os.fdatasync": "synchronous disk flush",
+    "select.select": "blocking fd wait",
+    "socket.create_connection": "blocking connect",
+    "subprocess.run": "blocking subprocess",
+    "subprocess.call": "blocking subprocess",
+    "subprocess.check_call": "blocking subprocess",
+    "subprocess.check_output": "blocking subprocess",
+    # first-touch device compile: tracing + XLA compilation ride the
+    # caller; warm kernels belong to bring-up, never to the dispatcher
+    "jax.jit": "device compile on first call",
+    "jax.device_put": "host→device transfer",
+}
+
+# method names that block regardless of receiver type
+BLOCKING_METHODS = {
+    "fsync": "synchronous disk flush",
+    "fdatasync": "synchronous disk flush",
+    "serve_forever": "blocks forever",
+    "recvfrom": "blocking socket receive",
+    "accept": "blocking socket accept",
+}
+
+
+def run(ctx) -> List[Finding]:
+    prog: Program = ctx.program
+    roles_map, _ = ctx.ensure_roles()
+    findings: List[Finding] = []
+    for fid in sorted(roles_map, key=fid_key):
+        if "dispatcher" not in roles_map[fid]:
+            continue
+        fi = prog.funcs.get(fid)
+        if fi is None:
+            continue
+        mi = prog.modules[fi.module]
+        for node in walk_body(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            label = None
+            name = None
+            d = dotted_expr(node.func)
+            if d:
+                full = prog.resolve_dotted(mi, d)
+                if full in BLOCKING_DOTTED:
+                    name, label = full, BLOCKING_DOTTED[full]
+            if label is None and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in BLOCKING_METHODS:
+                    name, label = f".{attr}", BLOCKING_METHODS[attr]
+                elif attr == "join" and not node.args:
+                    name, label = ".join", "thread join"
+            if label is None:
+                continue
+            findings.append(Finding(
+                PASS_ID, fi.module, node.lineno,
+                f"{fi.module}:{fi.qualname}:{name}",
+                f"{fi.qualname} runs on the dispatcher but calls "
+                f"{name}() — {label}; move it off the control thread "
+                f"(admission/exec lane/background) or baseline it with "
+                f"the justification"))
+    return findings

@@ -1,0 +1,165 @@
+"""Pass: crashpoint agreement lint (migrated from
+tools/check_crashpoints.py).
+
+The recovery drills address durability seams BY NAME; the scheme decays
+silently if names drift. Enforced: every `crashpoint(...)`/`arm(...)`
+name (and every TPUBFT_CRASHPOINT env literal) is registered in
+crashpoints.REGISTRY; every REGISTRY entry is threaded at ≥1 real seam
+outside the harness; zero scanned modules fails loudly.
+tools/check_crashpoints.py remains the CLI shim.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from tools.tpulint.core import Finding, ScanError, load_modules
+
+PASS_ID = "crashpoints"
+
+Violation = Tuple[str, int, str]
+
+HOOK_FUNCS = {"crashpoint", "arm"}
+SCAN_DIRS = ("tpubft", "benchmarks", "tests")
+# seams live in production code: registry coverage is only satisfied by
+# a call site outside the harness itself
+HARNESS_PREFIXES = (os.path.join("tpubft", "testing") + os.sep,
+                    "benchmarks" + os.sep, "tests" + os.sep)
+
+
+def _literal_name(node: ast.Call) -> Tuple[bool, str]:
+    """(is_literal, value) of the call's first positional arg / name=."""
+    arg = node.args[0] if node.args else next(
+        (kw.value for kw in node.keywords if kw.arg == "name"), None)
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return True, arg.value
+    return False, ""
+
+
+def _env_names(node: ast.AST) -> List[str]:
+    """Crashpoint names inside string literals shaped like env specs:
+    {"TPUBFT_CRASHPOINT": "name[:hit]"} dict displays."""
+    names: List[str] = []
+    if isinstance(node, ast.Dict):
+        for k, v in zip(node.keys, node.values):
+            key = getattr(k, "value", None)
+            is_env_key = key == "TPUBFT_CRASHPOINT" or (
+                isinstance(k, ast.Name) and k.id == "ENV_VAR")
+            if is_env_key and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                names.append(v.value.partition(":")[0])
+    return names
+
+
+def _scan_tree(tree: ast.Module, rel: str, registry: Set[str],
+               seams: Dict[str, int]) -> List[Violation]:
+    out: List[Violation] = []
+    in_harness = rel.startswith(HARNESS_PREFIXES)
+    for node in ast.walk(tree):
+        for name in _env_names(node):
+            if name not in registry:
+                out.append((rel, node.lineno,
+                            f"TPUBFT_CRASHPOINT={name!r} names an "
+                            f"unregistered crashpoint"))
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        called = (fn.id if isinstance(fn, ast.Name)
+                  else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if called not in HOOK_FUNCS:
+            continue
+        is_lit, name = _literal_name(node)
+        if not is_lit:
+            # registry.REGISTRY-driven loops (the lint's own tests, a
+            # drill iterating all seams) are fine for arm(); a seam
+            # itself must be a greppable literal
+            if called == "crashpoint":
+                out.append((rel, node.lineno,
+                            "crashpoint() seam name must be a string "
+                            "literal (drills address seams by grep)"))
+            continue
+        if name not in registry:
+            out.append((rel, node.lineno,
+                        f"{called}({name!r}) references an unregistered "
+                        f"crashpoint (add it to crashpoints.REGISTRY)"))
+        elif called == "crashpoint" and not in_harness \
+                and rel != os.path.join("tpubft", "testing",
+                                        "crashpoints.py"):
+            seams[name] = seams.get(name, 0) + 1
+    return out
+
+
+def _load_registry(root: str) -> Tuple[Set[str], List[Violation]]:
+    """REGISTRY keys, AST-parsed from the root's own crashpoints.py (no
+    import: the module under test must be the one under `root`, not
+    whatever sys.modules cached)."""
+    rel = os.path.join("tpubft", "testing", "crashpoints.py")
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return set(), [(rel, 0, "crashpoints.py not found — wrong root?")]
+    with open(path, "rb") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign) or isinstance(node, ast.Assign):
+            targets = ([node.target] if isinstance(node, ast.AnnAssign)
+                       else node.targets)
+            if any(isinstance(t, ast.Name) and t.id == "REGISTRY"
+                   for t in targets) and isinstance(node.value, ast.Dict):
+                keys = [k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)]
+                return set(keys), []
+    return set(), [(rel, 0, "REGISTRY dict literal not found")]
+
+
+def violations_for(root: str, mods, syntax) -> List[Violation]:
+    registry, out = _load_registry(root)
+    if out:
+        return out
+    seams: Dict[str, int] = {}
+    if not mods and not syntax:
+        # a wrong root must FAIL, not report a vacuous OK
+        return [(root, 0, "no Python modules found to scan — wrong "
+                          "root? (expected <root>/{%s}/**/*.py)"
+                          % ",".join(SCAN_DIRS))]
+    for f in syntax:
+        out.append((f.path, f.line, f.message))
+    for sm in mods:
+        out.extend(_scan_tree(sm.tree, sm.rel, registry, seams))
+    for name in sorted(registry - set(seams)):
+        out.append((os.path.join("tpubft", "testing", "crashpoints.py"), 0,
+                    f"REGISTRY entry {name!r} is not threaded at any "
+                    f"durability seam (phantom coverage — remove it or "
+                    f"add the crashpoint() call)"))
+    if not seams:
+        out.append((root, 0, "zero crashpoint seams found outside the "
+                             "harness — the recovery drills cover "
+                             "nothing"))
+    return sorted(out)
+
+
+def find_violations(root: str) -> List[Violation]:
+    try:
+        mods, syntax = load_modules(root, SCAN_DIRS)
+    except ScanError:
+        mods, syntax = [], []
+    return violations_for(root, mods, syntax)
+
+
+def run(ctx) -> List[Finding]:
+    # per-subdir loads so the tpubft/ parse is shared with every other
+    # pass through the Context cache; an individual empty subdir is
+    # fine, ALL empty is the loud zero-scan
+    mods, syntax = [], []
+    for sub in SCAN_DIRS:
+        try:
+            m, s = ctx.load(sub)
+        except ScanError:
+            continue
+        mods.extend(m)
+        syntax.extend(s)
+    findings: List[Finding] = []
+    for rel, line, msg in violations_for(ctx.root, mods, syntax):
+        findings.append(Finding(PASS_ID, rel, line, f"{rel}:{msg[:60]}",
+                                msg))
+    return findings

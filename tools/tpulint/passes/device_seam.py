@@ -1,0 +1,78 @@
+"""Pass: device-seam lint (migrated from tools/check_device_seam.py).
+
+Every kernel call site goes through the breaker-guarded
+`device_section(kind)` seam: any reference to the raw `device_dispatch`
+gate — import, call, or attribute — outside tpubft/ops/dispatch.py
+bypasses failure classification, the OPEN fast-fail, and half-open
+probe accounting. tools/check_device_seam.py remains the CLI shim.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Tuple
+
+from tools.tpulint.core import Finding, ScanError, load_modules
+
+PASS_ID = "device-seam"
+
+FORBIDDEN = "device_dispatch"
+# the one module allowed to touch the raw gate (it defines it and wraps
+# it in the breaker-guarded device_section)
+ALLOWED = {os.path.join("tpubft", "ops", "dispatch.py")}
+
+
+def scan_tree(tree: ast.Module, rel: str,
+              forbidden: str = FORBIDDEN) -> List[Tuple[str, int, str]]:
+    out: List[Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        hit = None
+        if isinstance(node, ast.Name) and node.id == forbidden:
+            hit = f"references {forbidden}"
+        elif isinstance(node, ast.Attribute) and node.attr == forbidden:
+            hit = f"references .{forbidden}"
+        elif isinstance(node, ast.ImportFrom) \
+                and any(a.name == forbidden for a in node.names):
+            hit = f"imports {forbidden}"
+        if hit:
+            out.append((rel, node.lineno,
+                        f"{hit} — kernel call sites must use the "
+                        f"breaker-guarded device_section(kind) seam "
+                        f"(tpubft/ops/dispatch.py)"))
+    return out
+
+
+def violations_for(mods, syntax, forbidden: str = FORBIDDEN,
+                   allowed=None) -> List[Tuple[str, int, str]]:
+    allowed = ALLOWED if allowed is None else allowed
+    out: List[Tuple[str, int, str]] = []
+    for f in syntax:
+        out.append((f.path, f.line, f.message))
+    for sm in mods:
+        if sm.rel in allowed:
+            continue
+        out.extend(scan_tree(sm.tree, sm.rel, forbidden))
+    return sorted(out)
+
+
+def find_violations(root: str, forbidden: str = FORBIDDEN,
+                    allowed=None) -> List[Tuple[str, int, str]]:
+    try:
+        mods, syntax = load_modules(root, ("tpubft",))
+    except ScanError:
+        # a wrong root (or a package rename) must FAIL, not report a
+        # vacuous OK — the enforced-by-construction property would
+        # silently stop being enforced
+        return [(os.path.join(root, "tpubft"), 0,
+                 "no Python modules found to scan — wrong root? "
+                 "(expected <root>/tpubft/**/*.py)")]
+    return violations_for(mods, syntax, forbidden, allowed)
+
+
+def run(ctx) -> List[Finding]:
+    mods, syntax = ctx.load("tpubft")     # cached parse; loud zero-scan
+    findings: List[Finding] = []
+    for rel, line, msg in violations_for(mods, syntax):
+        findings.append(Finding(PASS_ID, rel, line,
+                                f"{rel}:{FORBIDDEN}", msg))
+    return findings

@@ -1,0 +1,124 @@
+"""Pass: import hygiene (migrated from tools/check_imports.py).
+
+No module-level third-party imports under tpubft/: the product tree
+must import cleanly in a bare environment (the seed regression was a
+module-level `import cryptography` breaking collection of 32/51 test
+modules). Module-level means executed at import time — anything
+outside a function/class body and outside a `try:` soft-import guard.
+Approved always-present deps (`jax`, `numpy`) and the repo's own
+packages are allowed. tools/check_imports.py remains the CLI shim.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator, List, Tuple
+
+from tools.tpulint.core import Finding, ScanError, load_modules
+
+PASS_ID = "imports"
+
+APPROVED = {"jax", "numpy"}
+INTERNAL = {"tpubft", "tests", "tools", "benchmarks"}
+
+
+def _stdlib_names() -> frozenset:
+    return frozenset(sys.stdlib_module_names)  # 3.10+
+
+
+def _is_type_checking_test(test: ast.expr) -> bool:
+    """`if TYPE_CHECKING:` / `if typing.TYPE_CHECKING:` bodies never
+    execute at runtime — imports there are annotations-only, not a
+    collection-time dependency."""
+    if isinstance(test, ast.Name):
+        return test.id == "TYPE_CHECKING"
+    if isinstance(test, ast.Attribute):
+        return test.attr == "TYPE_CHECKING"
+    return False
+
+
+def _top_level_import_nodes(tree: ast.Module) -> Iterator[ast.stmt]:
+    """Statements executed at import time: the module body plus every
+    compound-statement body that runs during import — `if`/`else` (a
+    version gate still executes), `for`/`while` (+else), `with`, and a
+    `try`'s else/finally. EXCLUDED: `try:` bodies and their handlers
+    (try/except ImportError is the sanctioned soft-import idiom),
+    function/class bodies (lazy imports), and `if TYPE_CHECKING:`
+    (never executes)."""
+    stack: List[ast.stmt] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+        elif isinstance(node, ast.If):
+            if not _is_type_checking_test(node.test):
+                stack.extend(node.body)
+            stack.extend(node.orelse)
+        elif isinstance(node, (ast.For, ast.While)):
+            stack.extend(node.body)
+            stack.extend(node.orelse)
+        elif isinstance(node, ast.With):
+            stack.extend(node.body)
+        elif isinstance(node, ast.Try):
+            stack.extend(node.orelse)
+            stack.extend(node.finalbody)
+
+
+def _imported_roots(node: ast.stmt) -> Iterator[Tuple[str, int]]:
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            yield alias.name.split(".")[0], node.lineno
+    elif isinstance(node, ast.ImportFrom):
+        if node.level:                       # relative import: internal
+            return
+        if node.module:
+            yield node.module.split(".")[0], node.lineno
+
+
+def scan_tree(tree: ast.Module, approved=None,
+              internal=None) -> List[Tuple[int, str]]:
+    """(lineno, offending module) pairs for one parsed module."""
+    stdlib = _stdlib_names()
+    approved = APPROVED if approved is None else approved
+    internal = INTERNAL if internal is None else internal
+    out: List[Tuple[int, str]] = []
+    for node in _top_level_import_nodes(tree):
+        for mod, lineno in _imported_roots(node):
+            if mod in stdlib or mod in approved or mod in internal:
+                continue
+            out.append((lineno, mod))
+    return out
+
+
+def find_violations(root: str, approved=None,
+                    internal=None) -> List[Tuple[int, int, str]]:
+    """Walk `root` for .py files; return (path, lineno, module) for each
+    module-level import of a non-stdlib, non-approved package. (The
+    historical check_imports API: paths are root-joined, an empty tree
+    is an empty report — the framework `run` adds the loud zero-scan.)"""
+    try:
+        mods, syntax = load_modules(root, ("",))
+    except ScanError:
+        return []
+    out: List[Tuple[str, int, str]] = []
+    for f in syntax:
+        out.append((os.path.join(root, f.path), f.line,
+                    f"<{f.message}>"))
+    for sm in mods:
+        for lineno, mod in scan_tree(sm.tree, approved, internal):
+            out.append((sm.path, lineno, mod))
+    return sorted(out)
+
+
+def run(ctx) -> List[Finding]:
+    mods, syntax = ctx.load("tpubft")       # loud on zero scan
+    findings = list(syntax)
+    for sm in mods:
+        for lineno, mod in scan_tree(sm.tree):
+            findings.append(Finding(
+                PASS_ID, sm.rel, lineno, f"{sm.rel}:{mod}",
+                f"module-level import of third-party package {mod!r} "
+                f"(use a function-level or try-guarded import; approved "
+                f"always-on deps: {sorted(APPROVED)})"))
+    return findings

@@ -1,0 +1,173 @@
+"""Pass: static lock-order graph.
+
+Collects every `with <lock>:` region (class lock attributes and
+module-level lock globals) and records an order edge A→B whenever B is
+acquired while A is held — lexically nested regions, plus call edges:
+a call made inside A's region to a function that (transitively)
+acquires B also records A→B. A cycle in the global graph is a
+potential deadlock. This is the static complement of the runtime
+`racecheck.LockOrderChecker`, which only sees interleavings that
+actually execute under TPUBFT_THREADCHECK.
+
+Lock identity is `ClassName.attr` (Conditions constructed over another
+lock attribute unify with it) or `module:<rel>.var` for module
+globals; instances of the same class share a node — the usual
+conservative choice (per-instance cycles on one class, e.g. a
+hand-over-hand pattern, would need instance-sensitive analysis and are
+baselined instead).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.tpulint.core import Finding
+from tools.tpulint.program import (ClassInfo, FuncInfo, ModuleInfo,
+                                   Program, fid_key)
+from tools.tpulint.passes.races import _with_locks
+
+PASS_ID = "lock-order"
+
+_MAX_CALL_DEPTH = 4
+
+
+def _acquires(prog: Program, fi: FuncInfo, memo: Dict, stack: Set,
+              depth: int) -> Set[str]:
+    """Every lock id this function (or a callee, transitively) can
+    acquire. Recursion through the call graph is memoized and
+    cycle-cut; depth-limited as a backstop."""
+    cached = memo.get(fi.id)
+    if cached is not None:
+        return cached
+    if fi.id in stack or depth > _MAX_CALL_DEPTH:
+        return set()
+    stack.add(fi.id)
+    mi = prog.modules[fi.module]
+    ci = mi.classes.get(fi.cls) if fi.cls else None
+    out: Set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.With):
+            for li in _with_locks(prog, mi, ci, node):
+                out.add(li.lock_id)
+    for callee, _ in prog.callees(fi):
+        out |= _acquires(prog, callee, memo, stack, depth + 1)
+    stack.discard(fi.id)
+    memo[fi.id] = out
+    return out
+
+
+def _edges_in(prog: Program, mi: ModuleInfo, ci: Optional[ClassInfo],
+              fi: FuncInfo, node: ast.AST, held: List[str],
+              edges: Dict[Tuple[str, str], Tuple[str, int]],
+              acq_memo: Dict) -> None:
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(child, ast.With):
+            locks = [li.lock_id for li in _with_locks(prog, mi, ci, child)]
+            for lid in locks:
+                if held and held[-1] != lid:
+                    edges.setdefault((held[-1], lid),
+                                     (fi.module, child.lineno))
+                held.append(lid)
+            _edges_in(prog, mi, ci, fi, child, held, edges, acq_memo)
+            del held[len(held) - len(locks):]
+            continue
+        if isinstance(child, ast.Call) and held:
+            local_types = prog._local_types(fi)
+            for callee, line in ((c, child.lineno) for c in
+                                 prog.resolve_func_ref(fi, child.func,
+                                                       local_types)):
+                for lid in sorted(_acquires(prog, callee, acq_memo,
+                                            set(), 0)):
+                    if lid != held[-1]:
+                        edges.setdefault((held[-1], lid),
+                                         (fi.module, line))
+        _edges_in(prog, mi, ci, fi, child, held, edges, acq_memo)
+
+
+def _sccs(nodes: List[str],
+          adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan strongly-connected components."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            v, pi = work.pop()
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on.add(v)
+            recurse = False
+            succs = sorted(adj.get(v, ()))
+            for i in range(pi, len(succs)):
+                w = succs[i]
+                if w not in index:
+                    work.append((v, i + 1))
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(comp)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+    return out
+
+
+def run(ctx) -> List[Finding]:
+    prog: Program = ctx.program
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    acq_memo: Dict = {}
+    for fid in sorted(prog.funcs, key=fid_key):
+        fi = prog.funcs[fid]
+        mi = prog.modules[fi.module]
+        ci = mi.classes.get(fi.cls) if fi.cls else None
+        _edges_in(prog, mi, ci, fi, fi.node, [], edges, acq_memo)
+
+    adj: Dict[str, Set[str]] = {}
+    nodes: Set[str] = set()
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+        nodes.add(a)
+        nodes.add(b)
+
+    findings: List[Finding] = []
+    for comp in _sccs(sorted(nodes), adj):
+        cyclic = len(comp) > 1 or (comp and comp[0] in
+                                   adj.get(comp[0], ()))
+        if not cyclic:
+            continue
+        comp_set = set(comp)
+        cyc_edges = sorted((a, b, site) for (a, b), site in edges.items()
+                           if a in comp_set and b in comp_set)
+        rel, line = cyc_edges[0][2]
+        detail = "; ".join(f"{a}→{b} at {s[0]}:{s[1]}"
+                           for a, b, s in cyc_edges)
+        findings.append(Finding(
+            PASS_ID, rel, line,
+            "cycle:" + "|".join(sorted(comp_set)),
+            f"lock-order cycle over {{{', '.join(sorted(comp_set))}}} — "
+            f"two threads taking these locks in opposite orders can "
+            f"deadlock; order edges: {detail}"))
+    return findings

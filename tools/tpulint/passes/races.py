@@ -1,0 +1,145 @@
+"""Pass: static race detection.
+
+Any `self.<attr>` store inside a function reachable from two or more
+thread roles must be lexically enclosed in a `with self.<lock>:` region
+whose lock attribute was constructed by `racecheck.make_lock` /
+`make_condition` (lock attribution is by AST region — the static
+counterpart of the lock-discipline property TSan approximates with
+happens-before at runtime). Two finding shapes:
+
+  * unguarded  — no lock region encloses the store at all;
+  * raw-lock   — a region encloses it, but the lock is a bare
+    `threading.Lock/RLock/Condition`, invisible to the runtime
+    lock-order graph (`TPUBFT_THREADCHECK`): migrate it to
+    `make_lock`/`make_condition`.
+
+Deliberate under-approximations (documented in docs/OPERATIONS.md):
+stores in `__init__`/`__post_init__` precede thread start
+(happens-before via Thread.start); `start`/`stop` are lifecycle
+transitions — the threads they race against are the ones they create
+(Thread.start) or join (Thread.join), both happens-before edges;
+methods named `*_locked` follow the repo convention that the caller
+holds the class lock; only stores are checked (a single-writer
+attribute read cross-thread is the Python memory model's torn-free
+case).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from tools.tpulint.core import Finding
+from tools.tpulint.program import (ClassInfo, FuncInfo, LockInfo,
+                                   ModuleInfo, Program, fid_key)
+
+PASS_ID = "static-race"
+
+EXEMPT_METHODS = {"__init__", "__new__", "__post_init__",
+                  "__init_subclass__", "start", "stop"}
+
+
+def _roles_label(roles: Sequence[str]) -> str:
+    rs = sorted(roles)
+    label = "×".join(rs[:2])
+    if len(rs) > 2:
+        label += f"(+{len(rs) - 2})"
+    return label
+
+
+def _with_locks(prog: Program, mi: ModuleInfo, ci: Optional[ClassInfo],
+                node: ast.With) -> List[LockInfo]:
+    out: List[LockInfo] = []
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+                and e.value.id == "self" and ci is not None:
+            li = prog.class_lock(ci, e.attr)
+            if li is not None:
+                out.append(li)
+        elif isinstance(e, ast.Name) and e.id in mi.locks:
+            out.append(mi.locks[e.id])
+    return out
+
+
+def _store_targets(node: ast.AST) -> List[ast.Attribute]:
+    """`self.<attr>` targets of an assignment statement."""
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if isinstance(node, ast.AnnAssign) and node.value is None:
+            return []
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    out: List[ast.Attribute] = []
+    stack = targets
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            out.append(t)
+    return out
+
+
+def _scan(prog: Program, mi: ModuleInfo, ci: ClassInfo, fi: FuncInfo,
+          roles: Sequence[str], node: ast.AST, held: List[LockInfo],
+          findings: List[Finding]) -> None:
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue                       # its own FuncInfo / not now
+        if isinstance(child, ast.With):
+            locks = _with_locks(prog, mi, ci, child)
+            held.extend(locks)
+            _scan(prog, mi, ci, fi, roles, child, held, findings)
+            del held[len(held) - len(locks):]
+            continue
+        for t in _store_targets(child):
+            attr = t.attr
+            if prog.class_lock(ci, attr) is not None:
+                continue                   # the lock attribute itself
+            if not held:
+                findings.append(Finding(
+                    PASS_ID, fi.module, child.lineno,
+                    f"{fi.module}:{fi.qualname}:{attr}",
+                    f"{_roles_label(roles)} self.{attr} — unguarded "
+                    f"cross-thread store in {fi.qualname} (reachable "
+                    f"from roles {sorted(roles)}); wrap it in a "
+                    f"`with self.<lock>:` region built by "
+                    f"racecheck.make_lock"))
+            elif not any(li.registered for li in held):
+                li = held[-1]
+                findings.append(Finding(
+                    PASS_ID, fi.module, child.lineno,
+                    f"{fi.module}:{fi.qualname}:{attr}:raw-lock",
+                    f"{_roles_label(roles)} self.{attr} — store in "
+                    f"{fi.qualname} guarded only by raw lock "
+                    f"{li.lock_id} ({li.kind}); construct it with "
+                    f"racecheck.make_lock/make_condition so the "
+                    f"runtime lock-order graph sees it"))
+        _scan(prog, mi, ci, fi, roles, child, held, findings)
+
+
+def run(ctx) -> List[Finding]:
+    prog: Program = ctx.program
+    roles_map, _ = ctx.ensure_roles()
+    findings: List[Finding] = []
+    for fid in sorted(roles_map, key=fid_key):
+        roles = roles_map[fid]
+        if len(roles) < 2:
+            continue
+        fi = prog.funcs.get(fid)
+        if fi is None or fi.cls is None:
+            continue
+        leaf = fi.name.rsplit(".", 1)[-1]
+        if leaf in EXEMPT_METHODS or leaf.endswith("_locked"):
+            continue
+        mi = prog.modules[fi.module]
+        ci = mi.classes.get(fi.cls)
+        if ci is None:
+            continue
+        _scan(prog, mi, ci, fi, sorted(roles), fi.node, [], findings)
+    return findings

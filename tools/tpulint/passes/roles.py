@@ -1,0 +1,123 @@
+"""Pass: thread-role inference.
+
+Seeds every declared thread entry point / cross-thread API surface
+(tools/tpulint/rolemap.py) with its role, adds the callback-registrar
+rules (dispatcher timers/handlers, health probes), then propagates
+roles through the conservative call graph to a fixpoint: a function's
+role set is every thread role it can run under. Downstream passes
+(static-race, dispatcher-blocking) consume the map via
+`ctx.ensure_roles()`.
+
+Findings:
+  * stale seed — a rolemap entry naming a function that no longer
+    exists (the map must track the code, like check_hotpath.HOT_PATH);
+  * unseeded thread entry point — `threading.Thread(target=f)` where
+    `f` is a repo function with no THREAD_ROLES entry (an unseeded
+    thread is unanalyzed code).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.tpulint import rolemap
+from tools.tpulint.core import Finding
+from tools.tpulint.program import (FuncId, FuncInfo, Program,
+                                   dotted_expr, fid_key, walk_body)
+
+PASS_ID = "thread-roles"
+
+
+def _seed(prog: Program, table, roles, findings: List[Finding],
+          kind: str) -> None:
+    for fid, rs in sorted(table.items(), key=lambda kv: fid_key(kv[0])):
+        if fid not in prog.funcs:
+            rel, cls, name = fid
+            qual = f"{cls}.{name}" if cls else name
+            findings.append(Finding(
+                PASS_ID, rel, 0, f"stale-seed:{rel}:{qual}",
+                f"stale {kind} seed: {qual} not found in {rel} — update "
+                f"tools/tpulint/rolemap.py"))
+            continue
+        roles.setdefault(fid, set()).update(rs)
+
+
+def _callback_args(call: ast.Call, spec) -> List[ast.AST]:
+    pos_idx, kw_names, _role = spec
+    out: List[ast.AST] = []
+    for i in pos_idx:
+        if i < len(call.args):
+            out.append(call.args[i])
+    for kw in call.keywords:
+        if kw.arg in kw_names:
+            out.append(kw.value)
+    return out
+
+
+def compute_roles(ctx) -> Tuple[Dict[FuncId, Set[str]], List[Finding]]:
+    prog: Program = ctx.program
+    findings: List[Finding] = []
+    roles: Dict[FuncId, Set[str]] = {}
+
+    _seed(prog, rolemap.THREAD_ROLES, roles, findings, "thread")
+    _seed(prog, rolemap.API_SEEDS, roles, findings, "API")
+
+    # one structural sweep: registrar callbacks + thread-target audit
+    for fi in sorted(prog.funcs.values(),
+                     key=lambda f: fid_key(f.id)):
+        mi = prog.modules[fi.module]
+        # walk_body: a nested closure is its own FuncInfo in this very
+        # loop — ast.walk here would visit its calls twice
+        for node in walk_body(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            spec = rolemap.REGISTRARS.get(fname or "")
+            if spec is not None:
+                for arg in _callback_args(node, spec):
+                    for cb in prog.resolve_func_ref(fi, arg):
+                        roles.setdefault(cb.id, set()).add(spec[2])
+                continue
+            d = dotted_expr(node.func)
+            if d and prog.resolve_dotted(mi, d) == "threading.Thread":
+                target = next((kw.value for kw in node.keywords
+                               if kw.arg == "target"), None)
+                if target is None:
+                    continue
+                for tf in prog.resolve_func_ref(fi, target):
+                    if tf.id not in rolemap.THREAD_ROLES:
+                        findings.append(Finding(
+                            PASS_ID, fi.module, node.lineno,
+                            f"unseeded-thread:{fi.module}:{tf.qualname}",
+                            f"unseeded thread entry point "
+                            f"{tf.qualname} — declare its role in "
+                            f"tools/tpulint/rolemap.py THREAD_ROLES so "
+                            f"the analyzer can classify the code it "
+                            f"runs"))
+
+    # propagate to fixpoint through the call graph
+    work = [fid for fid in roles]
+    while work:
+        fid = work.pop()
+        fi = prog.funcs.get(fid)
+        if fi is None:
+            continue
+        src = roles.get(fid, set())
+        if not src:
+            continue
+        for callee, _line in prog.callees(fi):
+            dst = roles.setdefault(callee.id, set())
+            missing = src - dst
+            if missing:
+                dst.update(missing)
+                work.append(callee.id)
+    return roles, findings
+
+
+def run(ctx) -> List[Finding]:
+    _roles, findings = ctx.ensure_roles()
+    return findings

@@ -1,0 +1,618 @@
+"""Whole-program AST index over the tpubft tree.
+
+Builds, from the shared loader's parsed modules, the structures every
+concurrency pass consumes:
+
+  * per-module import tables (alias -> dotted target) and symbol tables
+    (classes, module-level functions, module-level locks);
+  * per-class method tables, base-class links (resolved within the
+    repo), attribute types inferred from `self.x = ClassName(...)`
+    assignments, and lock attributes with their provenance
+    (racecheck.make_lock / make_condition vs raw threading primitives,
+    plus Conditions layered over another lock attribute);
+  * a conservative syntactic call graph: `f()`, `mod.f()`, `self.m()`,
+    `self.attr.m()` and `local_var.m()` (where the attr/var type was
+    inferred), `ClassName(...)` -> `__init__`, and `lambda: <call>`
+    thunks.
+
+The graph is deliberately under-approximate where Python's dynamism
+gives no static answer (callables stored in attributes, dict dispatch):
+those edges are restored by the role seed table
+(tools/tpulint/rolemap.py) and the callback-registrar rules in the
+thread-role pass, which is how the framework stays precise enough to
+lint a real tree without drowning it in false positives.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.tpulint.core import SourceModule
+
+FuncId = Tuple[str, Optional[str], str]   # (module rel, class or None, name)
+
+
+def fid_key(fid: FuncId) -> Tuple[str, str, str]:
+    """Sort key for FuncIds (class may be None)."""
+    return (fid[0], fid[1] or "", fid[2])
+
+# lock provenance kinds
+MAKE_LOCK = "make_lock"
+MAKE_CONDITION = "make_condition"
+RAW_LOCK = "raw_lock"
+RAW_CONDITION = "raw_condition"
+
+_LOCK_FACTORIES = {
+    "tpubft.utils.racecheck.make_lock": MAKE_LOCK,
+    "tpubft.utils.racecheck.CheckedLock": MAKE_LOCK,
+    "tpubft.utils.racecheck.make_condition": MAKE_CONDITION,
+    "tpubft.utils.racecheck.CheckedCondition": MAKE_CONDITION,
+    "threading.Lock": RAW_LOCK,
+    "threading.RLock": RAW_LOCK,
+    "threading.Condition": RAW_CONDITION,
+}
+
+
+class LockInfo:
+    """One lock-valued attribute (or module global). `underlying` names
+    the lock attr a Condition wraps, so `with self._cond:` and
+    `with self._mu:` unify to one node in the order graph."""
+    __slots__ = ("owner", "attr", "kind", "line", "underlying")
+
+    def __init__(self, owner: str, attr: str, kind: str, line: int,
+                 underlying: Optional[str] = None) -> None:
+        self.owner = owner            # "ClassName" or "module:<rel>"
+        self.attr = attr
+        self.kind = kind
+        self.line = line
+        self.underlying = underlying
+
+    @property
+    def lock_id(self) -> str:
+        return f"{self.owner}.{self.underlying or self.attr}"
+
+    @property
+    def registered(self) -> bool:
+        return self.kind in (MAKE_LOCK, MAKE_CONDITION)
+
+
+class FuncInfo:
+    __slots__ = ("id", "module", "cls", "name", "node", "nested")
+
+    def __init__(self, module: str, cls: Optional[str], name: str,
+                 node: ast.AST) -> None:
+        self.id: FuncId = (module, cls, name)
+        self.module = module
+        self.cls = cls
+        self.name = name
+        self.node = node
+        # closures defined directly inside this function, by bare name
+        # (their FuncId name is "outer.inner")
+        self.nested: Dict[str, "FuncInfo"] = {}
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+class ClassInfo:
+    __slots__ = ("module", "name", "bases", "methods", "attr_types",
+                 "locks", "node")
+
+    def __init__(self, module: str, name: str, node: ast.ClassDef) -> None:
+        self.module = module
+        self.name = name
+        self.node = node
+        self.bases: List[str] = []            # dotted base names, raw
+        self.methods: Dict[str, FuncInfo] = {}
+        self.attr_types: Dict[str, "ClassInfo"] = {}
+        self.locks: Dict[str, LockInfo] = {}
+
+
+class ModuleInfo:
+    __slots__ = ("rel", "dotted", "tree", "imports", "classes",
+                 "functions", "locks")
+
+    def __init__(self, rel: str, dotted: str, tree: ast.Module) -> None:
+        self.rel = rel
+        self.dotted = dotted
+        self.tree = tree
+        self.imports: Dict[str, str] = {}     # local alias -> dotted
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.locks: Dict[str, LockInfo] = {}  # module-level lock vars
+
+
+def _dotted_of(rel: str) -> str:
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("\\", "/").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def dotted_expr(node: ast.AST) -> Optional[str]:
+    """`a.b.c` chain as a string, or None for anything non-trivial."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_body(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk limited to one function's own body: nested function /
+    lambda / class subtrees are skipped (their statements execute when
+    *they* run, on whatever thread calls them — the call graph and the
+    role map carry that, not lexical position)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+class Program:
+    def __init__(self, modules: Sequence[SourceModule],
+                 attr_hints=None, return_hints=None) -> None:
+        """`attr_hints`: {(rel, Class, attr): (rel, Class)} type facts
+        for constructor-injected collaborators the syntactic inference
+        cannot see. `return_hints`: {fully-dotted function: (rel,
+        Class)} for factory getters (`get_breaker(...)` etc.)."""
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.by_dotted: Dict[str, ModuleInfo] = {}
+        self.funcs: Dict[FuncId, FuncInfo] = {}
+        self._class_by_name: Dict[str, List[ClassInfo]] = {}
+        self._local_types_cache: Dict[FuncId, Dict[str, ClassInfo]] = {}
+        self._callees_cache: Dict[FuncId, List[Tuple[FuncInfo, int]]] = {}
+        self._subclasses: Optional[Dict] = None
+        for sm in modules:
+            self._index_module(sm)
+        for mi in self.modules.values():
+            self._link_module(mi)
+        self._returns: Dict[str, ClassInfo] = {}
+        for dotted, (rel, cls) in (return_hints or {}).items():
+            ci = self._class_at(rel, cls)
+            if ci is not None:
+                self._returns[dotted] = ci
+        for (rel, cls, attr), (trel, tcls) in (attr_hints or {}).items():
+            owner = self._class_at(rel, cls)
+            target = self._class_at(trel, tcls)
+            if owner is not None and target is not None:
+                owner.attr_types.setdefault(attr, target)
+
+    def _class_at(self, rel: str, cls: str) -> Optional["ClassInfo"]:
+        mi = self.modules.get(rel)
+        return mi.classes.get(cls) if mi is not None else None
+
+    def subclasses(self, ci: ClassInfo) -> List[ClassInfo]:
+        """Transitive repo subclasses of `ci`."""
+        if self._subclasses is None:
+            direct: Dict[Tuple[str, str], List[ClassInfo]] = {}
+            for mi in self.modules.values():
+                for c in mi.classes.values():
+                    for b in c.bases:
+                        base = self.resolve_class(mi, b)
+                        if base is not None:
+                            direct.setdefault(
+                                (base.module, base.name), []).append(c)
+            self._subclasses = direct
+        out: List[ClassInfo] = []
+        seen: Set[Tuple[str, str]] = set()
+        stack = [ci]
+        while stack:
+            cur = stack.pop()
+            for sub in self._subclasses.get((cur.module, cur.name), ()):
+                key = (sub.module, sub.name)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(sub)
+                    stack.append(sub)
+        return out
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _index_module(self, sm: SourceModule) -> None:
+        mi = ModuleInfo(sm.rel, _dotted_of(sm.rel), sm.tree)
+        self.modules[sm.rel] = mi
+        self.by_dotted[mi.dotted] = mi
+        pkg = mi.dotted.rsplit(".", 1)[0] if "." in mi.dotted else ""
+        for node in ast.walk(sm.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    mi.imports[alias] = (a.name if a.asname
+                                         else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    steps = mi.dotted.split(".")
+                    # level 1 = current package (module's own parent)
+                    anchor = steps[: len(steps) - node.level] or [""]
+                    base = ".".join(x for x in (".".join(anchor), base) if x)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    alias = a.asname or a.name
+                    mi.imports[alias] = f"{base}.{a.name}" if base else a.name
+        del pkg
+        for stmt in sm.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(sm.rel, None, stmt.name, stmt)
+                mi.functions[stmt.name] = fi
+                self.funcs[fi.id] = fi
+                self._index_nested(fi)
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(sm.rel, stmt.name, stmt)
+                for b in stmt.bases:
+                    d = dotted_expr(b)
+                    if d:
+                        ci.bases.append(d)
+                for item in stmt.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fi = FuncInfo(sm.rel, stmt.name, item.name, item)
+                        ci.methods[item.name] = fi
+                        self.funcs[fi.id] = fi
+                        self._index_nested(fi)
+                mi.classes[stmt.name] = ci
+                self._class_by_name.setdefault(stmt.name, []).append(ci)
+
+    def _index_nested(self, outer: FuncInfo) -> None:
+        for child in ast.iter_child_nodes(outer.node):
+            self._collect_nested(outer, child)
+
+    def _collect_nested(self, outer: FuncInfo, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi = FuncInfo(outer.module, outer.cls,
+                          f"{outer.name}.{node.name}", node)
+            outer.nested[node.name] = fi
+            self.funcs[fi.id] = fi
+            for child in ast.iter_child_nodes(node):
+                self._collect_nested(fi, child)
+            return
+        if isinstance(node, (ast.Lambda, ast.ClassDef)):
+            return
+        for child in ast.iter_child_nodes(node):
+            self._collect_nested(outer, child)
+
+    def _factory_kind(self, mi: ModuleInfo, call: ast.Call) -> Optional[str]:
+        d = dotted_expr(call.func)
+        if d is None:
+            return None
+        target = self.resolve_dotted(mi, d)
+        return _LOCK_FACTORIES.get(target or "")
+
+    def _lock_from_assign(self, mi: ModuleInfo, owner: str, attr: str,
+                          value: ast.expr, line: int,
+                          locks: Dict[str, LockInfo]) -> Optional[LockInfo]:
+        if not isinstance(value, ast.Call):
+            return None
+        kind = self._factory_kind(mi, value)
+        if kind is None:
+            return None
+        if kind == RAW_CONDITION and value.args:
+            arg = value.args[0]
+            # Condition(self._mu) / Condition(make_lock(...)): inherit
+            # the wrapped lock's provenance and identity
+            if isinstance(arg, ast.Attribute) \
+                    and isinstance(arg.value, ast.Name) \
+                    and arg.value.id == "self":
+                under = locks.get(arg.attr)
+                if under is not None:
+                    return LockInfo(owner, attr, under.kind, line,
+                                    underlying=under.attr)
+            elif isinstance(arg, ast.Call):
+                inner = self._factory_kind(mi, arg)
+                if inner in (MAKE_LOCK, MAKE_CONDITION):
+                    return LockInfo(owner, attr, inner, line)
+                if inner in (RAW_LOCK, RAW_CONDITION):
+                    return LockInfo(owner, attr, RAW_CONDITION, line)
+        return LockInfo(owner, attr, kind, line)
+
+    def _link_module(self, mi: ModuleInfo) -> None:
+        # module-level locks
+        for stmt in mi.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                li = self._lock_from_assign(mi, f"module:{mi.rel}", name,
+                                            stmt.value, stmt.lineno,
+                                            mi.locks)
+                if li is not None:
+                    mi.locks[name] = li
+        # class attr types + lock attrs (two passes over every method so
+        # `self._cond = Condition(self._mu)` sees `_mu` regardless of
+        # statement order)
+        for ci in mi.classes.values():
+            assigns: List[Tuple[str, ast.expr, int, Dict]] = []
+            for fn in ci.methods.values():
+                params = self._param_types(mi, fn)
+                for node in ast.walk(fn.node):
+                    target: Optional[ast.expr] = None
+                    if isinstance(node, ast.Assign) \
+                            and len(node.targets) == 1:
+                        target = node.targets[0]
+                    elif isinstance(node, ast.AnnAssign) and node.value:
+                        target = node.target
+                    if target is None or node.value is None:
+                        continue
+                    if isinstance(target, ast.Attribute) \
+                            and isinstance(target.value, ast.Name) \
+                            and target.value.id == "self":
+                        assigns.append((target.attr, node.value,
+                                        node.lineno, params))
+            for attr, value, line, _p in assigns:    # plain locks first
+                if isinstance(value, ast.Call):
+                    kind = self._factory_kind(mi, value)
+                    if kind in (MAKE_LOCK, MAKE_CONDITION, RAW_LOCK):
+                        ci.locks[attr] = LockInfo(ci.name, attr, kind,
+                                                  line)
+            for attr, value, line, _p in assigns:    # then conditions
+                if attr in ci.locks:
+                    continue
+                li = self._lock_from_assign(mi, ci.name, attr, value,
+                                            line, ci.locks)
+                if li is not None:
+                    ci.locks[attr] = li
+            for attr, value, line, params in assigns:  # then obj types
+                if attr in ci.locks or attr in ci.attr_types:
+                    continue
+                hit = None
+                if isinstance(value, ast.Call):
+                    target = dotted_expr(value.func)
+                    if target:
+                        hit = self.resolve_class(mi, target)
+                elif isinstance(value, ast.Name):
+                    # self._bc = blockchain  (annotated parameter)
+                    hit = params.get(value.id)
+                if hit is not None:
+                    ci.attr_types[attr] = hit
+            # properties returning a typed attribute: handler.blockchain
+            for name, fn in ci.methods.items():
+                if name in ci.attr_types or not isinstance(
+                        fn.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if not any(isinstance(d, ast.Name) and d.id == "property"
+                           for d in fn.node.decorator_list):
+                    continue
+                for node in walk_body(fn.node):
+                    if isinstance(node, ast.Return) \
+                            and isinstance(node.value, ast.Attribute) \
+                            and isinstance(node.value.value, ast.Name) \
+                            and node.value.value.id == "self":
+                        hit = ci.attr_types.get(node.value.attr)
+                        if hit is not None:
+                            ci.attr_types[name] = hit
+                        break
+
+    def _param_types(self, mi: ModuleInfo, fn: FuncInfo
+                     ) -> Dict[str, "ClassInfo"]:
+        """Annotated parameters whose annotation names a repo class."""
+        out: Dict[str, ClassInfo] = {}
+        node = fn.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return out
+        for arg in (node.args.posonlyargs + node.args.args
+                    + node.args.kwonlyargs):
+            ann = arg.annotation
+            if ann is None:
+                continue
+            d = dotted_expr(ann)
+            if isinstance(ann, ast.Constant) and isinstance(ann.value,
+                                                            str):
+                d = ann.value
+            if d:
+                hit = self.resolve_class(mi, d)
+                if hit is not None:
+                    out[arg.arg] = hit
+        return out
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+    def resolve_dotted(self, mi: ModuleInfo, dotted: str) -> Optional[str]:
+        """Expand a local dotted name through the module's imports into a
+        fully-qualified dotted path (repo or external)."""
+        head, _, rest = dotted.partition(".")
+        if head in mi.imports:
+            base = mi.imports[head]
+            return f"{base}.{rest}" if rest else base
+        if head in mi.classes or head in mi.functions or head in mi.locks:
+            return f"{mi.dotted}.{dotted}"
+        return dotted
+
+    def resolve_class(self, mi: ModuleInfo,
+                      dotted: str) -> Optional[ClassInfo]:
+        full = self.resolve_dotted(mi, dotted)
+        if full is None:
+            return None
+        mod_path, _, name = full.rpartition(".")
+        owner = self.by_dotted.get(mod_path)
+        if owner is not None and name in owner.classes:
+            return owner.classes[name]
+        # unique global name as a fallback (covers re-exports)
+        if "." not in dotted:
+            cands = self._class_by_name.get(dotted, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def mro(self, ci: ClassInfo) -> List[ClassInfo]:
+        out, stack, seen = [], [ci], set()
+        while stack:
+            cur = stack.pop(0)
+            if id(cur) in seen:
+                continue
+            seen.add(id(cur))
+            out.append(cur)
+            mi = self.modules[cur.module]
+            for b in cur.bases:
+                hit = self.resolve_class(mi, b)
+                if hit is not None:
+                    stack.append(hit)
+        return out
+
+    def lookup_method(self, ci: ClassInfo,
+                      name: str) -> Optional[FuncInfo]:
+        for c in self.mro(ci):
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def class_lock(self, ci: ClassInfo, attr: str) -> Optional[LockInfo]:
+        for c in self.mro(ci):
+            if attr in c.locks:
+                return c.locks[attr]
+        return None
+
+    def _local_types(self, fi: FuncInfo) -> Dict[str, ClassInfo]:
+        """var -> ClassInfo for `x = ClassName(...)` and `x = self.attr`
+        assignments inside one function body."""
+        cached = self._local_types_cache.get(fi.id)
+        if cached is not None:
+            return cached
+        assigns = [n for n in walk_body(fi.node)
+                   if isinstance(n, ast.Assign) and len(n.targets) == 1
+                   and isinstance(n.targets[0], ast.Name)]
+        out: Dict[str, ClassInfo] = dict(
+            self._param_types(self.modules[fi.module], fi))
+        # iterate to a small fixpoint: walk order is not source order,
+        # and chains like `r = self._r; bc = r.handler.blockchain` need
+        # the earlier binding resolved first
+        for _ in range(4):
+            changed = False
+            for node in assigns:
+                var = node.targets[0].id
+                if var in out:
+                    continue
+                hit = self.expr_type(fi, node.value, out)
+                if hit is not None:
+                    out[var] = hit
+                    changed = True
+            if not changed:
+                break
+        self._local_types_cache[fi.id] = out
+        return out
+
+    def expr_type(self, fi: FuncInfo, node: ast.AST,
+                  local_types: Dict[str, ClassInfo]
+                  ) -> Optional[ClassInfo]:
+        """Best-effort static type of an expression: `self`, typed
+        locals, attribute chains through inferred/hinted attr types,
+        constructor calls, factory-getter returns, and literal-name
+        `getattr(x, "attr")`."""
+        mi = self.modules[fi.module]
+        if isinstance(node, ast.Name):
+            if node.id == "self" and fi.cls:
+                return mi.classes.get(fi.cls)
+            return local_types.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base_t = self.expr_type(fi, node.value, local_types)
+            if base_t is not None:
+                return self._attr_type_of(base_t, node.attr)
+            return None
+        if isinstance(node, ast.Call):
+            d = dotted_expr(node.func)
+            if d == "getattr" and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                base_t = self.expr_type(fi, node.args[0], local_types)
+                if base_t is not None:
+                    return self._attr_type_of(base_t, node.args[1].value)
+                return None
+            if d:
+                hit = self.resolve_class(mi, d)
+                if hit is not None:
+                    return hit
+                return self._returns.get(self.resolve_dotted(mi, d) or "")
+        return None
+
+    def _attr_type_of(self, owner: ClassInfo,
+                      attr: str) -> Optional[ClassInfo]:
+        """Type of `owner.<attr>`, searching the MRO and — when the
+        static type is an interface — its repo subclasses (sound for
+        typing: any implementation the attr may come from)."""
+        for c in self.mro(owner):
+            if attr in c.attr_types:
+                return c.attr_types[attr]
+        for sub in self.subclasses(owner):
+            if attr in sub.attr_types:
+                return sub.attr_types[attr]
+        return None
+
+    def resolve_func_ref(self, fi: FuncInfo, node: ast.AST,
+                         local_types: Optional[Dict[str, ClassInfo]] = None
+                         ) -> List[FuncInfo]:
+        """Resolve a *function-valued expression* (callee of a call, or a
+        callback argument) to repo FuncInfos. Under-approximate."""
+        mi = self.modules[fi.module]
+        if local_types is None:
+            local_types = self._local_types(fi)
+        if isinstance(node, ast.Lambda):
+            body = node.body
+            if isinstance(body, ast.Call):
+                return self.resolve_func_ref(fi, body.func, local_types)
+            return []
+        if isinstance(node, ast.Name):
+            if node.id in fi.nested:          # closure defined right here
+                return [fi.nested[node.id]]
+            if node.id in local_types:        # x = ClassName(...); x(...)
+                hit = self.lookup_method(local_types[node.id], "__call__")
+                return [hit] if hit else []
+            full = self.resolve_dotted(mi, node.id)
+            return self._by_dotted_func(full)
+        if isinstance(node, ast.Attribute):
+            owner = self.expr_type(fi, node.value, local_types)
+            if owner is not None:
+                hits = []
+                hit = self.lookup_method(owner, node.attr)
+                if hit is not None:
+                    hits.append(hit)
+                # the static type may be an interface: include every
+                # override in repo subclasses (conservative dispatch)
+                for sub in self.subclasses(owner):
+                    if node.attr in sub.methods:
+                        hits.append(sub.methods[node.attr])
+                return hits
+            d = dotted_expr(node)
+            if d:
+                return self._by_dotted_func(self.resolve_dotted(mi, d))
+        return []
+
+    def _by_dotted_func(self, full: Optional[str]) -> List[FuncInfo]:
+        if not full:
+            return []
+        mod_path, _, name = full.rpartition(".")
+        owner = self.by_dotted.get(mod_path)
+        if owner is None:
+            return []
+        if name in owner.functions:
+            return [owner.functions[name]]
+        if name in owner.classes:
+            hit = self.lookup_method(owner.classes[name], "__init__")
+            return [hit] if hit else []
+        return []
+
+    def callees(self, fi: FuncInfo) -> List[Tuple[FuncInfo, int]]:
+        """Resolved (callee, lineno) pairs for every call in `fi`'s own
+        body (nested defs excluded — they are their own nodes)."""
+        cached = self._callees_cache.get(fi.id)
+        if cached is not None:
+            return cached
+        local_types = self._local_types(fi)
+        out: List[Tuple[FuncInfo, int]] = []
+        for node in walk_body(fi.node):
+            if isinstance(node, ast.Call):
+                for hit in self.resolve_func_ref(fi, node.func,
+                                                 local_types):
+                    out.append((hit, node.lineno))
+        self._callees_cache[fi.id] = out
+        return out

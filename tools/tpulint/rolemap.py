@@ -1,0 +1,181 @@
+"""Thread-role seed table — the analyzer's ground truth about which
+code runs where.
+
+Every thread the process creates must have its entry point listed in
+THREAD_ROLES; the thread-role pass fails on a `threading.Thread(target=
+<repo function>)` whose target is missing here (an unseeded thread is
+unanalyzed code — the same loud-failure convention as a renamed
+check_hotpath handler). Roles then propagate through the call graph,
+plus two callback rules:
+
+  * functions registered on the consensus dispatcher (`add_timer`,
+    `register_internal`, `set_external_handler`, `set_admitted_handler`,
+    `set_post_hook`) run with the `dispatcher` role;
+  * health-probe callbacks (`register_probe` / `register_degraded_flag`)
+    run with the `health` role.
+
+API_SEEDS names cross-thread *surfaces* the syntactic call graph cannot
+see through (callables stored into attributes at wiring time): the
+dispatcher's incoming queue is fed by transports, admission workers and
+the execution lane; the admission ingest is fed by transports; the
+client library is driven by arbitrary application threads. Adding a new
+thread entry point = one line here (plus a justification in the commit);
+see docs/OPERATIONS.md "Static analysis & concurrency lint".
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Tuple
+
+FuncId = Tuple[str, str, str]   # (module rel, class name or None, func)
+
+# -- thread entry points (threading.Thread targets) --------------------
+THREAD_ROLES: Dict[FuncId, FrozenSet[str]] = {
+    # consensus planes
+    ("tpubft/consensus/incoming.py", "Dispatcher", "_loop"):
+        frozenset({"dispatcher"}),
+    ("tpubft/consensus/execution.py", "ExecutionLane", "_loop"):
+        frozenset({"exec_lane"}),
+    ("tpubft/consensus/admission.py", "AdmissionPipeline", "_run"):
+        frozenset({"admission"}),
+    ("tpubft/consensus/health.py", "HealthMonitor", "_run"):
+        frozenset({"health"}),
+    # infrastructure
+    ("tpubft/utils/racecheck.py", "StallWatchdog", "_run"):
+        frozenset({"watchdog"}),
+    ("tpubft/utils/batcher.py", "FlushBatcher", "_run"):
+        frozenset({"batcher"}),
+    ("tpubft/utils/metrics.py", "UdpMetricsServer", "_run"):
+        frozenset({"metrics"}),
+    # transports
+    ("tpubft/comm/udp.py", "PlainUdpCommunication", "_recv_loop"):
+        frozenset({"transport"}),
+    ("tpubft/comm/loopback.py", "LoopbackBus", "_pump"):
+        frozenset({"transport"}),
+    ("tpubft/comm/tcp.py", "PlainTcpCommunication", "_accept_loop"):
+        frozenset({"transport"}),
+    ("tpubft/comm/tcp.py", "PlainTcpCommunication", "_connect_loop"):
+        frozenset({"transport"}),
+    ("tpubft/comm/tcp.py", "PlainTcpCommunication",
+     "_connect_loop.dial_one"): frozenset({"transport"}),
+    ("tpubft/comm/tcp.py", "PlainTcpCommunication", "_inbound_handshake"):
+        frozenset({"transport"}),
+    ("tpubft/comm/tcp.py", "_Peer", "_write_loop"):
+        frozenset({"transport"}),
+    ("tpubft/comm/tcp.py", "_Peer", "_read_loop"):
+        frozenset({"transport"}),
+    # serving tiers
+    ("tpubft/diagnostics/server.py", "DiagnosticsServer", "_accept_loop"):
+        frozenset({"diagnostics"}),
+    ("tpubft/diagnostics/server.py", "DiagnosticsServer", "_serve"):
+        frozenset({"diagnostics"}),
+    ("tpubft/thinreplica/server.py", "ThinReplicaServer", "_accept_loop"):
+        frozenset({"thinreplica_srv"}),
+    ("tpubft/thinreplica/server.py", "ThinReplicaServer", "_serve"):
+        frozenset({"thinreplica_srv"}),
+    ("tpubft/thinreplica/client.py", "ThinReplicaClient", "_supervise"):
+        frozenset({"thinreplica_cli"}),
+    ("tpubft/thinreplica/client.py", "ThinReplicaClient", "_data_loop"):
+        frozenset({"thinreplica_cli"}),
+    ("tpubft/thinreplica/client.py", "ThinReplicaClient", "_hash_loop"):
+        frozenset({"thinreplica_cli"}),
+    ("tpubft/client/clientservice.py", "ClientService", "_accept_loop"):
+        frozenset({"client_api"}),
+    ("tpubft/client/clientservice.py", "ClientService", "_serve"):
+        frozenset({"client_api"}),
+    # background snapshot writer (reconfiguration DbCheckpoint)
+    ("tpubft/reconfiguration/dispatcher.py", "DbCheckpointHandler",
+     "_try_checkpoint"):
+        frozenset({"db_checkpoint"}),
+    # client-side poll loop (client reconfiguration engine)
+    ("tpubft/client/cre.py", "ClientReconfigurationEngine", "_loop"):
+        frozenset({"cre"}),
+    # load-generator worker threads (apps/tester_client CLI)
+    ("tpubft/apps/tester_client.py", None, "run_workload.worker"):
+        frozenset({"load_gen"}),
+}
+
+# -- cross-thread API surfaces (callable-attribute seams) --------------
+API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
+    # the dispatcher's incoming queue: transports push raw datagrams,
+    # admission workers push AdmittedMsgs (the pipeline `sink`), the
+    # execution lane and collector completions push internal wakeups
+    ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
+     "push_external"): frozenset({"transport"}),
+    ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
+     "push_external_obj"): frozenset({"transport", "admission"}),
+    ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
+     "push_internal"): frozenset({"transport", "exec_lane",
+                                  "dispatcher"}),
+    ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
+     "push_internal_once"): frozenset({"exec_lane"}),
+    # admission ingest: called from transport receive threads
+    ("tpubft/consensus/admission.py", "AdmissionPipeline", "submit"):
+        frozenset({"transport"}),
+    ("tpubft/consensus/admission.py", "AdmissionPipeline",
+     "submit_burst"): frozenset({"transport"}),
+    # client library: driven by arbitrary application threads AND fed
+    # replies by its transport receive thread
+    ("tpubft/bftclient/client.py", "BftClient", "send_write"):
+        frozenset({"client_api"}),
+    ("tpubft/bftclient/client.py", "BftClient", "send_read"):
+        frozenset({"client_api"}),
+    ("tpubft/bftclient/client.py", "BftClient", "send_write_batch"):
+        frozenset({"client_api"}),
+    ("tpubft/bftclient/client.py", "BftClient", "on_new_message"):
+        frozenset({"transport"}),
+}
+
+# -- callback registrars: arg positions/kwargs that receive a function
+#    which will run on the named role's thread ------------------------
+REGISTRARS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...], str]] = {
+    # name -> (positional callback indices, callback kwarg names, role)
+    "add_timer": ((1,), ("fn",), "dispatcher"),
+    "register_internal": ((1,), ("fn",), "dispatcher"),
+    "set_external_handler": ((0,), ("fn",), "dispatcher"),
+    "set_admitted_handler": ((0,), ("fn",), "dispatcher"),
+    "set_post_hook": ((0,), ("fn",), "dispatcher"),
+    "register_probe": ((2, 3, 4), ("busy_fn", "detail_fn", "last_fn"),
+                       "health"),
+    "register_degraded_flag": ((1,), ("fn",), "health"),
+}
+
+# -- type facts the syntactic inference cannot see --------------------
+# constructor-injected collaborators: {(rel, Class, attr): (rel, Class)}
+ATTR_TYPE_HINTS: Dict[Tuple[str, str, str], Tuple[str, str]] = {
+    # the execution lane holds the replica and reaches its thread-safe
+    # surfaces (ClientsManager, reserved pages, blockchain accumulation)
+    ("tpubft/consensus/execution.py", "ExecutionLane", "_r"):
+        ("tpubft/consensus/replica.py", "Replica"),
+    # admission workers verify through the replica's SigManager and
+    # consult the static topology
+    ("tpubft/consensus/admission.py", "AdmissionPipeline", "_sig"):
+        ("tpubft/consensus/sig_manager.py", "SigManager"),
+    ("tpubft/consensus/admission.py", "AdmissionPipeline", "_info"):
+        ("tpubft/consensus/replicas_info.py", "ReplicasInfo"),
+    # the app handler owns the ledger the exec lane accumulates into
+    ("tpubft/apps/skvbc.py", "SkvbcHandler", "blockchain"):
+        ("tpubft/kvbc/blockchain.py", "KeyValueBlockchain"),
+    ("tpubft/consensus/replica.py", "Replica", "res_pages"):
+        ("tpubft/consensus/reserved_pages.py", "ReservedPages"),
+}
+
+# factory getters: {fully-dotted function: (rel, Class)} — lets
+# `get_breaker(...).record_failure()` chains resolve
+RETURN_TYPE_HINTS: Dict[str, Tuple[str, str]] = {
+    "tpubft.utils.breaker.get_breaker":
+        ("tpubft/utils/breaker.py", "CircuitBreaker"),
+    "tpubft.ops.dispatch.device_breaker":
+        ("tpubft/utils/breaker.py", "CircuitBreaker"),
+    "tpubft.utils.racecheck.get_watchdog":
+        ("tpubft/utils/racecheck.py", "StallWatchdog"),
+    "tpubft.utils.racecheck.get_checker":
+        ("tpubft/utils/racecheck.py", "LockOrderChecker"),
+    "tpubft.utils.tracing.get_tracer":
+        ("tpubft/utils/tracing.py", "Tracer"),
+}
+
+# modules excluded from the concurrency passes (thread-roles,
+# static-race, lock-order, dispatcher-blocking): the test/chaos harness
+# fakes threads and crash drills by design and is not replica code.
+# The legacy passes keep their own historical scopes.
+CONCURRENCY_EXCLUDE: Tuple[str, ...] = ("tpubft/testing/",)

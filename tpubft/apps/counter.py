@@ -10,11 +10,11 @@ Wire format: op byte 'A' (add) + i64 delta | 'R' (read). Replies: i64.
 from __future__ import annotations
 
 import struct
-import threading
 from collections import OrderedDict
 
 from tpubft.consensus.replica import IRequestsHandler
 from tpubft.crypto.digest import digest as sha256
+from tpubft.utils.racecheck import make_lock
 
 _I64 = struct.Struct("<q")
 
@@ -44,7 +44,7 @@ class CounterHandler(IRequestsHandler):
         # not evidence of a replay)
         self._applied: dict = {}        # client_id -> OrderedDict[seq, None]
         self._applied_floor: dict = {}  # client_id -> highest evicted seq
-        self._lock = threading.Lock()
+        self._lock = make_lock("counter_app")
 
     def _was_applied(self, client_id: int, req_seq: int) -> bool:
         return (req_seq in self._applied.get(client_id, ())

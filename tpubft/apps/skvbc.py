@@ -11,7 +11,6 @@ system tests and the linearizability tracker drive.
 """
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -19,6 +18,7 @@ from typing import Dict, List, Optional, Tuple
 from tpubft.consensus.replica import IRequestsHandler
 from tpubft.kvbc import VERSIONED_KV, BlockUpdates, KeyValueBlockchain
 from tpubft.utils import serialize as ser
+from tpubft.utils.racecheck import make_lock
 
 READ_LATEST = 0  # read_version 0 = latest (reference uses 0 the same way)
 
@@ -105,7 +105,7 @@ class SkvbcHandler(IRequestsHandler):
 
     def __init__(self, blockchain: KeyValueBlockchain) -> None:
         self._bc = blockchain
-        self._lock = threading.Lock()
+        self._lock = make_lock("skvbc_app")
 
     @property
     def blockchain(self) -> KeyValueBlockchain:

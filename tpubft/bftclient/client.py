@@ -23,6 +23,7 @@ from tpubft.comm.interfaces import ICommunication, IReceiver
 from tpubft.consensus import messages as m
 from tpubft.consensus.keys import ClusterKeys
 from tpubft.consensus.replicas_info import ReplicasInfo
+from tpubft.utils.racecheck import make_lock
 
 
 class Quorum(enum.Enum):
@@ -72,8 +73,8 @@ class BftClient(IReceiver):
         self.comm = comm
         self._signer = keys.my_signer()
         self._req_seq = int(time.time() * 1e6)  # monotonic across restarts
-        self._lock = threading.Lock()
-        self._batch_lock = threading.Lock()   # one outstanding batch
+        self._lock = make_lock("bftclient")
+        self._batch_lock = make_lock("bftclient.batch")  # one outstanding batch
         self._replies: Dict[int, Dict[int, m.ClientReplyMsg]] = {}
         self._done: Dict[int, threading.Event] = {}
         self._result: Dict[int, m.ClientReplyMsg] = {}

@@ -17,6 +17,7 @@ import queue
 import threading
 from typing import Callable, Dict, Iterable, Optional
 
+from tpubft.utils.racecheck import make_lock
 from tpubft.comm.interfaces import (ConnectionStatus, ICommunication,
                                     IReceiver, NodeNum)
 
@@ -32,7 +33,7 @@ class LoopbackBus:
         self._hooks: list[Hook] = []
         self._q: "queue.Queue" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("loopback_bus")
         self._closed = False
 
     def create(self, node: NodeNum) -> "LoopbackCommunication":

@@ -31,6 +31,7 @@ from __future__ import annotations
 import struct
 from typing import Callable, Dict, Optional
 
+from tpubft.utils.racecheck import make_lock
 from tpubft.comm.interfaces import (ConnectionStatus, ICommunication,
                                     IReceiver, NodeNum)
 
@@ -145,17 +146,29 @@ class MultiplexClientHub:
         self._inner = inner
         self._endpoints: Dict[int, _MuxEndpoint] = {}
         self._started = False
+        # principals start/stop from their own (application) threads:
+        # endpoint registration and the carrier-start claim must be
+        # atomic across them
+        self._mu = make_lock("mux_hub")
 
     def endpoint(self, principal: int) -> "_MuxEndpoint":
-        ep = self._endpoints.get(principal)
-        if ep is None:
-            ep = self._endpoints[principal] = _MuxEndpoint(self, principal)
-        return ep
+        with self._mu:
+            ep = self._endpoints.get(principal)
+            if ep is None:
+                ep = self._endpoints[principal] = _MuxEndpoint(
+                    self, principal)
+            return ep
 
     def _ensure_started(self) -> None:
-        if not self._started:
+        with self._mu:
+            if self._started:
+                return
             self._started = True
-            self._inner.start(_HubReceiver(self))
+        # the carrier start itself runs outside the claim: it spawns the
+        # receive thread, and a racing second principal only needs the
+        # claim decided, not the start completed (sends before the
+        # carrier is up drop, exactly as before)
+        self._inner.start(_HubReceiver(self))
 
     def _route(self, src: int, data: bytes) -> None:
         if len(data) < _EP.size:
@@ -171,7 +184,8 @@ class MultiplexClientHub:
         for ep in list(self._endpoints.values()):
             ep._running = False
         self._inner.stop()
-        self._started = False
+        with self._mu:
+            self._started = False
 
 
 class _HubReceiver(IReceiver):

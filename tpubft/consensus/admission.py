@@ -184,7 +184,12 @@ class AdmissionPipeline:
         # beat tracks the OLDEST stamp so one wedged worker is visible
         self._worker_beats: List[float] = [time.monotonic()] \
             * self._n_workers
-        self._cv = threading.Condition()
+        # ingest handoff Condition: CheckedLock-backed under
+        # TPUBFT_THREADCHECK (racecheck.make_condition) so the
+        # transport->worker handoff feeds the runtime lock-order
+        # graph like every make_lock site
+        from tpubft.utils.racecheck import make_condition, make_lock
+        self._cv = make_condition(f"{name}.cv")
         self._threads: List[threading.Thread] = []
         self._running = False
         self._processed = 0
@@ -193,7 +198,6 @@ class AdmissionPipeline:
         self._clients = frozenset(info.all_client_ids())
         # instrumented under TPUBFT_THREADCHECK: admission worker ⇄
         # dispatcher lock ordering rides the global order graph
-        from tpubft.utils.racecheck import make_lock
         self._stats_mu = make_lock(f"{name}.stats")
 
         self.metrics = Component("admission", aggregator)

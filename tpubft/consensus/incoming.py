@@ -12,13 +12,14 @@ the external bound and have priority, as in the reference.
 """
 from __future__ import annotations
 
-import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
 from tpubft.utils.logging import get_logger, set_mdc
+from tpubft.utils.racecheck import make_condition
 
 log = get_logger("dispatch")
 
@@ -40,15 +41,23 @@ class InternalMsg:
 
 
 class IncomingMsgsStorage:
+    """Bounded external + unbounded internal deques under ONE
+    racecheck-registered Condition (`incoming.cv`): every producer —
+    transport receive threads, admission workers, the execution lane's
+    completed-run wakeups — and the dispatcher's pop ride the same lock,
+    so under TPUBFT_THREADCHECK the queue's ordering edges and hold
+    times are visible to the runtime lock-order graph (queue.Queue's
+    internal Conditions never were)."""
+
     def __init__(self, max_external: int = MAX_EXTERNAL_PENDING):
-        self._external: "queue.Queue[ExternalMsg]" = queue.Queue(max_external)
-        self._internal: "queue.Queue[InternalMsg]" = queue.Queue()
+        self._cv = make_condition("incoming.cv")
+        self._external: "deque[ExternalMsg]" = deque()
+        self._internal: "deque[InternalMsg]" = deque()
+        self._max_external = max_external
         self._dropped_external = 0
         # level-triggered wakeup kinds currently enqueued (see
-        # push_internal_once): guarded by its own lock — producers are
-        # worker/executor threads, the consumer is the dispatcher
+        # push_internal_once)
         self._once_pending: set = set()
-        self._once_mu = threading.Lock()
 
     def push_external(self, sender: int, raw: bytes) -> bool:
         return self.push_external_obj(ExternalMsg(sender, raw))
@@ -57,15 +66,18 @@ class IncomingMsgsStorage:
         """Bounded external-queue entry shared by the raw path and the
         admission plane (already-parsed, already-verified AdmittedMsgs
         ride the same queue and the same drop accounting)."""
-        try:
-            self._external.put_nowait(obj)
-            return True
-        except queue.Full:
-            self._dropped_external += 1
-            return False
+        with self._cv:
+            if len(self._external) >= self._max_external:
+                self._dropped_external += 1
+                return False
+            self._external.append(obj)
+            self._cv.notify()
+        return True
 
     def push_internal(self, kind: str, payload: Any = None) -> None:
-        self._internal.put(InternalMsg(kind, payload))
+        with self._cv:
+            self._internal.append(InternalMsg(kind, payload))
+            self._cv.notify()
 
     def push_internal_once(self, kind: str) -> None:
         """Level-triggered wakeup: enqueue `kind` (payload None) unless an
@@ -73,28 +85,36 @@ class IncomingMsgsStorage:
         results live in their own handoff structure (e.g. the execution
         lane's completed-run queue) signal with this so a fast producer
         can't flood the internal queue with redundant wakeups."""
-        with self._once_mu:
+        with self._cv:
             if kind in self._once_pending:
                 return
             self._once_pending.add(kind)
-        self._internal.put(InternalMsg(kind, None))
+            self._internal.append(InternalMsg(kind, None))
+            self._cv.notify()
 
     def pop(self, timeout: float):
         """Internal msgs first (they unblock consensus progress), then
-        external; returns ExternalMsg | InternalMsg | None on timeout."""
-        try:
-            item = self._internal.get_nowait()
-        except queue.Empty:
-            item = None
-        if item is not None:
-            if self._once_pending:
-                with self._once_mu:
-                    self._once_pending.discard(item.kind)
-            return item
-        try:
-            return self._external.get(timeout=timeout)
-        except queue.Empty:
+        external; returns ExternalMsg | InternalMsg | None on timeout.
+        Single consumer (the dispatcher); a spurious wakeup reads as a
+        timeout, which the dispatch loop already tolerates."""
+        with self._cv:
+            if not self._internal and not self._external:
+                self._cv.wait(timeout)
+            if self._internal:
+                item = self._internal.popleft()
+                self._once_pending.discard(item.kind)
+                return item
+            if self._external:
+                return self._external.popleft()
             return None
+
+    @property
+    def external_depth(self) -> int:
+        return len(self._external)        # racy read is fine for a gauge
+
+    @property
+    def internal_depth(self) -> int:
+        return len(self._internal)
 
     @property
     def dropped_external(self) -> int:

@@ -149,8 +149,8 @@ class Replica(IReceiver):
         self.health.register_probe(
             "dispatcher", cfg.health_stall_ms / 1e3,
             detail_fn=lambda: {
-                "external_q": self.incoming._external.qsize(),
-                "internal_q": self.incoming._internal.qsize()})
+                "external_q": self.incoming.external_depth,
+                "internal_q": self.incoming.internal_depth})
 
         from tpubft.crypto.backend import resolve_backend
         backend = self.crypto_backend = resolve_backend(cfg.crypto_backend)

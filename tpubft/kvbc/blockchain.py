@@ -210,7 +210,7 @@ class BlockStoreMixin:
             view = _StagedReadView(self._db, overlay)
             self._accum = _Accumulation(master=_MirroredBatch(overlay),
                                         base_last=self._last)
-            self._begin_staged_reads(view)
+            self._begin_staged_reads_locked(view)
         except BaseException:
             self._staging_mu.release()
             raise
@@ -239,12 +239,12 @@ class BlockStoreMixin:
                 self._base_db.write(acc.master)
         except BaseException:
             self._accum = None
-            self._end_staged_reads()
+            self._end_staged_reads_locked()
             self._last = acc.base_last
             self._staging_mu.release()
             raise
         self._accum = None
-        self._end_staged_reads()
+        self._end_staged_reads_locked()
         if self._last and self._genesis == 0:
             self._genesis = 1
         self._staging_mu.release()
@@ -260,7 +260,7 @@ class BlockStoreMixin:
             return
         try:
             self._accum = None
-            self._end_staged_reads()
+            self._end_staged_reads_locked()
             self._last = acc.base_last
         finally:
             self._staging_mu.release()
@@ -350,12 +350,15 @@ class BlockStoreMixin:
         return self._db.has(_bid(block_id), self._F_ST)
 
     # hooks for read-your-writes during batched linking; the categorized
-    # engine overrides them to rebind its cached merkle trees too
-    def _begin_staged_reads(self, view: "_StagedReadView") -> None:
+    # engine overrides them to rebind its cached merkle trees too.
+    # `_locked`: every caller holds `kvbc.staging` — lexically
+    # (link_st_chain, add_blocks) or across the accumulation bracket
+    # (begin/end/abort_accumulation)
+    def _begin_staged_reads_locked(self, view: "_StagedReadView") -> None:
         self._base_db = self._db
         self._db = view
 
-    def _end_staged_reads(self) -> None:
+    def _end_staged_reads_locked(self) -> None:
         self._db = self._base_db
 
     def link_st_chain(self) -> int:
@@ -406,7 +409,7 @@ class BlockStoreMixin:
             view = _StagedReadView(base_db, overlay)
             master = WriteBatch()
             adopted: List[Tuple[int, "cat.BlockUpdates"]] = []
-            self._begin_staged_reads(view)
+            self._begin_staged_reads_locked(view)
             try:
                 while len(adopted) < self.LINK_SEGMENT_BLOCKS:
                     raw = base_db.get(_bid(nxt), self._F_ST)
@@ -437,7 +440,7 @@ class BlockStoreMixin:
                     nxt += 1
             finally:
                 try:
-                    self._end_staged_reads()
+                    self._end_staged_reads_locked()
                     commit(master, adopted)   # still under the lock: the
                     # segment's adoption (head + db write) must land
                     # before an accumulation can slot blocks after it
@@ -472,13 +475,13 @@ class KeyValueBlockchain(BlockStoreMixin):
     # batched-link read redirection must cover the cached merkle trees:
     # a block's update reads sibling nodes the previous block in the same
     # batch may have written
-    def _begin_staged_reads(self, view) -> None:
-        super()._begin_staged_reads(view)
+    def _begin_staged_reads_locked(self, view) -> None:
+        super()._begin_staged_reads_locked(view)
         for t in self._trees.values():
             t._db = view
 
-    def _end_staged_reads(self) -> None:
-        super()._end_staged_reads()
+    def _end_staged_reads_locked(self) -> None:
+        super()._end_staged_reads_locked()
         # trees created during staging bound to the view; rebind all
         for t in self._trees.values():
             t._db = self._db
@@ -516,7 +519,7 @@ class KeyValueBlockchain(BlockStoreMixin):
             overlay: Dict[bytes, Optional[bytes]] = {}
             view = _StagedReadView(self._db, overlay)
             master = _MirroredBatch(overlay)
-            self._begin_staged_reads(view)
+            self._begin_staged_reads_locked(view)
             try:
                 # phase 1: all merkle categories, level-synchronous
                 # across the whole run
@@ -562,7 +565,7 @@ class KeyValueBlockchain(BlockStoreMixin):
                 # same no-torn-window rule as end_accumulation
                 self._base_db.write(master)
             finally:
-                self._end_staged_reads()
+                self._end_staged_reads_locked()
             self._last = first + len(updates_list) - 1
             if self._genesis == 0:
                 self._genesis = 1

@@ -41,6 +41,8 @@ import time
 from contextlib import contextmanager
 from typing import Callable, Dict, Optional
 
+from tpubft.utils.racecheck import make_lock
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -69,7 +71,7 @@ class CircuitBreaker:
         self.max_cooldown_s = max_cooldown_s
         self.probe_max = max(1, probe_max)
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = make_lock(f"breaker.{name}")
         self._tl = threading.local()      # nesting depth + probe flag
         self._state = CLOSED
         self._consecutive = 0

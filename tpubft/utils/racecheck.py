@@ -69,7 +69,10 @@ class LockOrderChecker:
             stack.extend(self._edges.get(cur, ()))
         return False
 
-    def on_acquire(self, name: str) -> None:
+    def on_acquire(self, name: str) -> str:
+        """Record the acquisition; returns the formatted site so the
+        caller (CheckedLock) can reuse it for hold-time reports without
+        a second stack capture."""
         held = self._held_set()
         site = "".join(traceback.format_stack(limit=4)[:-1])
         with self._mu:
@@ -89,6 +92,7 @@ class LockOrderChecker:
                     self._edges.setdefault(h, set()).add(name)
                     self._edge_sites[(h, name)] = site
         held.append(name)
+        return site
 
     def on_release(self, name: str) -> None:
         held = self._held_set()
@@ -103,29 +107,90 @@ def get_checker() -> LockOrderChecker:
     return _checker
 
 
+# ---- held-too-long accounting -----------------------------------------
+# Per-lock max-hold-time under TPUBFT_THREADCHECK: a "dispatcher briefly
+# stalled" report becomes named-lock evidence — which lock, held from
+# which acquisition site, for how long. Holders exceeding the threshold
+# (TPUBFT_LOCK_HOLD_MS, default 100ms) are logged with the site.
+_HOLD_ENV = "TPUBFT_LOCK_HOLD_MS"
+_hold_mu = threading.Lock()
+_hold_max: Dict[str, float] = {}          # lock name -> max hold (s)
+_hold_reports = 0
+
+
+def hold_threshold_s() -> float:
+    try:
+        return float(os.environ.get(_HOLD_ENV, "100")) / 1000.0
+    except ValueError:
+        return 0.1
+
+
+def hold_stats() -> Dict[str, float]:
+    """Snapshot of per-lock max hold time (seconds) recorded so far."""
+    with _hold_mu:
+        return dict(_hold_max)
+
+
+def hold_report_count() -> int:
+    with _hold_mu:
+        return _hold_reports
+
+
+def reset_hold_stats() -> None:
+    global _hold_reports
+    with _hold_mu:
+        _hold_max.clear()
+        _hold_reports = 0
+
+
 class CheckedLock:
-    """Drop-in threading.Lock/RLock wrapper feeding the order checker.
-    Zero-cost import path: construct via `make_lock(name)` which returns a
-    plain lock when the check is disabled."""
+    """Drop-in threading.Lock/RLock wrapper feeding the order checker
+    and the per-lock hold-time accounting. Zero-cost import path:
+    construct via `make_lock(name)` which returns a plain lock when the
+    check is disabled."""
 
     def __init__(self, name: str, reentrant: bool = False) -> None:
         self._name = name
         self._lock = threading.RLock() if reentrant else threading.Lock()
+        # holder-only state: written while the underlying lock is held
+        self._depth = 0
+        self._acquired_at = 0.0
+        self._site = ""
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._lock.acquire(blocking, timeout)
         if ok:
             try:
-                _checker.on_acquire(self._name)
+                site = _checker.on_acquire(self._name)
             except LockOrderViolation:
                 # report the POTENTIAL deadlock without creating a real
                 # one: the underlying lock must not stay held by a thread
                 # that unwound past its release
                 self._lock.release()
                 raise
+            self._depth += 1
+            if self._depth == 1:              # outermost acquisition
+                self._acquired_at = time.monotonic()
+                self._site = site
         return ok
 
     def release(self) -> None:
+        global _hold_reports
+        self._depth -= 1
+        if self._depth == 0:
+            held_s = time.monotonic() - self._acquired_at
+            site = self._site
+            over = held_s > hold_threshold_s()
+            with _hold_mu:
+                if held_s > _hold_max.get(self._name, 0.0):
+                    _hold_max[self._name] = held_s
+                if over:
+                    _hold_reports += 1
+            if over:
+                log.warning(
+                    "lock %r held %.1fms (> %.0fms threshold); "
+                    "acquired at:\n%s", self._name, held_s * 1e3,
+                    hold_threshold_s() * 1e3, site)
         _checker.on_release(self._name)
         self._lock.release()
 
@@ -145,6 +210,19 @@ def make_lock(name: str, reentrant: bool = False):
     return threading.RLock() if reentrant else threading.Lock()
 
 
+def make_condition(name: str) -> threading.Condition:
+    """Project-wide Condition constructor: a `threading.Condition` over
+    a `CheckedLock` under TPUBFT_THREADCHECK (every acquire/release —
+    including wait()'s release/re-acquire cycle — feeds the lock-order
+    graph and the hold-time accounting, like any make_lock site), a
+    plain Condition otherwise. Condition's ownership probe
+    (`acquire(False)` try/release) composes with CheckedLock: a failed
+    probe records nothing."""
+    if enabled():
+        return threading.Condition(CheckedLock(name))
+    return threading.Condition()
+
+
 class StallWatchdog:
     """Heartbeat-monitored liveness: critical loops call `beat(name)`;
     a beat older than `threshold_s` triggers one full-process stack dump
@@ -155,7 +233,7 @@ class StallWatchdog:
         self.threshold_s = threshold_s
         self.poll_s = poll_s
         self._beats: Dict[str, float] = {}
-        self._mu = threading.Lock()
+        self._mu = make_lock("racecheck.watchdog")
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._reported: Set[str] = set()

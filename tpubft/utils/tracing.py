@@ -12,10 +12,11 @@ across every replica's logs and span exports. The exporter is pluggable
 from __future__ import annotations
 
 import os
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
+
+from tpubft.utils.racecheck import make_lock
 
 
 @dataclass
@@ -68,7 +69,7 @@ class Tracer:
     RING = 2048
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer")
         self._ring: List[Span] = []
         self._exporters: List[Callable[[Span], None]] = []
         self._counter = 0
