@@ -168,7 +168,10 @@ def run_pair(shape: str, msgs: int, distinct: int, workers: int,
 
 
 def run(msgs: int, distinct: int, samples: int, workers: int,
-        shapes=("distinct", "storm")) -> List[dict]:
+        shapes=("distinct", "storm"), profile: bool = False) -> List[dict]:
+    if profile:
+        from tpubft.utils import flight
+        flight.reset()
     rows = []
     for shape in shapes:
         for s in range(samples):
@@ -179,6 +182,15 @@ def run(msgs: int, distinct: int, samples: int, workers: int,
     # summary: per-shape median speedup over the recorded pairs
     summary = {"bench": "dispatch_flood_summary", "msgs": msgs,
                "workers": workers}
+    if profile:
+        # the backup-flood shape orders no slots, so the interesting
+        # profile here is the ingest plane + kernels; stage_breakdown
+        # is attached for symmetry with bench_e2e --profile (it fills
+        # up when a shape does order traffic)
+        from tpubft.utils import flight
+        summary["recorder_enabled"] = flight.enabled()
+        summary["stage_breakdown"] = flight.stage_summary()
+        summary["kernel_profile"] = flight.kernel_profiler().snapshot()
     for shape in shapes:
         ons = [r["msgs_per_sec"] for r in rows
                if r["shape"] == shape and r["mode"] == "admission"
@@ -343,6 +355,9 @@ def main() -> None:
     ap.add_argument("--workers", type=int, default=1,
                     help="admission_workers for the ON mode")
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--profile", action="store_true",
+                    help="attach the flight recorder's stage breakdown "
+                         "and kernel profile to the summary row")
     ap.add_argument("--device-fault", action="store_true",
                     help="kill-the-device scenario: time-to-degraded / "
                          "time-to-restored through the breaker")
@@ -353,7 +368,8 @@ def main() -> None:
     if args.device_fault:
         print(json.dumps(device_fault()), flush=True)
         return
-    run(args.msgs, args.distinct, args.samples, args.workers)
+    run(args.msgs, args.distinct, args.samples, args.workers,
+        profile=args.profile)
 
 
 if __name__ == "__main__":

@@ -139,7 +139,8 @@ def _drive(make_kv, config: int, backend: str, secs: float,
 def run_config(config: int, backend: str, secs: float,
                clients: int, client_batch: int = 1,
                extra_overrides: dict = None,
-               op_timeout_ms: int = 8000) -> dict:
+               op_timeout_ms: int = 8000,
+               profile: bool = False) -> dict:
     cfg = CONFIGS[config]
     if cfg.get("transport") or cfg.get("storm_period_s"):
         # TLS transport and the VC storm only exist on real processes; an
@@ -154,6 +155,10 @@ def run_config(config: int, backend: str, secs: float,
     overrides.setdefault("client_sig_scheme", "ed25519")
     overrides["crypto_backend"] = backend
     overrides.update(extra_overrides or {})
+    if profile:
+        # fresh recorder so the stage breakdown covers exactly this run
+        from tpubft.utils import flight
+        flight.reset()
     with InProcessCluster(f=cfg["f"], num_clients=clients,
                           handler_factory=_handler_factory,
                           cfg_overrides=overrides) as cluster:
@@ -164,6 +169,13 @@ def run_config(config: int, backend: str, secs: float,
                      op_timeout_ms=op_timeout_ms)
         if extra_overrides:
             row["overrides"] = dict(extra_overrides)
+        if profile:
+            # per-slot stage breakdown (adm_wait/dispatch/prepare/
+            # commit/exec/reply) + kernel profile, folded by the flight
+            # recorder across every replica of the in-process cluster
+            from tpubft.utils import flight
+            row["stage_breakdown"] = flight.stage_summary()
+            row["kernel_profile"] = flight.kernel_profiler().snapshot()
         return row
 
 
@@ -281,6 +293,11 @@ def main() -> None:
                          "lane A/B rows")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fixed shape for CI (lane on vs off)")
+    ap.add_argument("--profile", action="store_true",
+                    help="attach the flight recorder's per-slot stage "
+                         "breakdown (adm_wait/dispatch/prepare/commit/"
+                         "exec/reply) and kernel profile to each row "
+                         "(in-process configs only)")
     ap.add_argument("--timeout-ms", type=int, default=8000,
                     help="per-op client timeout; raise for saturated "
                          "deep-batch shapes so a slow config degrades "
@@ -291,12 +308,23 @@ def main() -> None:
         return
     from tpubft.utils.config import parse_config_overrides
     extra = parse_config_overrides(args.override)
+    if args.profile and args.processes:
+        raise SystemExit("--profile reads the in-process flight "
+                         "recorder; with --processes take per-replica "
+                         "dumps (status get flight) and merge them "
+                         "with tools/tpuprof.py instead")
     for config in [int(x) for x in args.configs.split(",")]:
         for backend in args.backends.split(","):
-            fn = run_config_processes if args.processes else run_config
-            row = fn(config, backend, args.secs, args.clients,
-                     args.client_batch, extra_overrides=extra,
-                     op_timeout_ms=args.timeout_ms)
+            if args.processes:
+                row = run_config_processes(
+                    config, backend, args.secs, args.clients,
+                    args.client_batch, extra_overrides=extra,
+                    op_timeout_ms=args.timeout_ms)
+            else:
+                row = run_config(
+                    config, backend, args.secs, args.clients,
+                    args.client_batch, extra_overrides=extra,
+                    op_timeout_ms=args.timeout_ms, profile=args.profile)
             print(json.dumps(row), flush=True)
 
 

@@ -63,6 +63,38 @@ def test_lint_catches_a_violation(tmp_path):
     assert any("not found" in msg for _, _, msg in violations), violations
 
 
+def test_lint_catches_telemetry_violations(tmp_path):
+    """Seeded defects for the telemetry rule: span allocation
+    (get_tracer/start_span/set_tag) and f-string construction inside a
+    hot-path handler are flagged — hot-path observability may only ride
+    the bounded flight.record() API. A handler that records through
+    flight.record (and logs with %-style lazy formatting) stays clean."""
+    tool = _load_tool()
+    del tool.HOT_PATH[("tpubft/consensus/replica.py", "Replica")]
+    mod_dir = tmp_path / "tpubft" / "consensus"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "incoming.py").write_text(textwrap.dedent("""\
+        class Dispatcher:
+            def _loop_body(self):
+                with get_tracer().start_span("hot") as span:
+                    span.set_tag("msg", f"seq={self.seq}")
+    """))
+    violations = tool.find_violations(str(tmp_path))
+    msgs = [msg for _, _, msg in violations]
+    assert any("start_span" in s and "flight.record" in s for s in msgs), \
+        violations
+    assert any("set_tag" in s for s in msgs), violations
+    assert any("f-string" in s for s in msgs), violations
+    # the sanctioned shape passes clean
+    (mod_dir / "incoming.py").write_text(textwrap.dedent("""\
+        class Dispatcher:
+            def _loop_body(self):
+                flight.record(flight.EV_DISPATCH, seq=self.seq)
+                log.debug("handled %d", self.seq)
+    """))
+    assert tool.find_violations(str(tmp_path)) == []
+
+
 def test_hot_path_list_matches_source():
     """Every listed handler exists in the real tree (find_violations
     reports missing ones; an empty result implies full coverage)."""

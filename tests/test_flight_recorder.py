@@ -1,0 +1,352 @@
+"""Flight recorder: per-thread rings, slot-lifecycle folding, kernel
+profiling, the diagnostics surfaces (`status get slots|kernels|flight`,
+`perf show` snapshot shape), the stalled-health dump artifact +
+tools/tpuprof rendering, and the chaos-campaign red-verdict attachment.
+"""
+import json
+import os
+import threading
+import time
+
+from tpubft.diagnostics import DiagnosticsServer, Registrar, TimeRecorder
+from tpubft.tools import ctl
+from tpubft.utils import flight
+from tpubft.utils.flight import SlotTracker
+
+
+def _slot_events(seq, rid=0, step_ns=1_000_000):
+    """Record one full synthetic slot lifecycle for `seq`."""
+    flight.set_thread_rid(rid)
+    for code in (flight.EV_ADM_ADMIT, flight.EV_PP_DISPATCH,
+                 flight.EV_PP_ACCEPT, flight.EV_PREPARED,
+                 flight.EV_COMMITTED, flight.EV_EXEC_ENQ,
+                 flight.EV_EXEC_APPLY, flight.EV_REPLY):
+        flight.record(code, seq=seq, view=0)
+
+
+# ---------------- rings ----------------
+
+def test_ring_bounded_and_ordered():
+    flight.reset()
+    n = flight.RING_SIZE + 57
+    for i in range(n):
+        flight.record(flight.EV_ADM_INGEST, arg=i)
+    snap = flight.snapshot()
+    me = threading.current_thread().name
+    ring = next(r for r in snap["rings"] if r["thread"] == me)
+    evs = [e for e in ring["events"] if e[1] == flight.EV_ADM_INGEST]
+    assert len(evs) <= flight.RING_SIZE          # bounded
+    # oldest-to-newest, and the newest events survived the wrap
+    ts = [e[0] for e in evs]
+    assert ts == sorted(ts)
+    assert evs[-1][4] == n - 1
+
+
+def test_disabled_recorder_is_a_noop():
+    from tpubft.ops.dispatch import device_section
+    flight.reset()
+    flight._set_enabled(False)
+    try:
+        assert not flight.enabled()
+        flight.record(flight.EV_ADM_INGEST, arg=1)
+        _slot_events(seq=999)
+        # the off switch covers the device seam too: no kernel profile
+        with device_section("disabledkind", batch=2):
+            pass
+        snap = flight.snapshot()
+        assert all(not r["events"] for r in snap["rings"])
+        assert flight.stage_summary()["completed"] == 0
+        assert "disabledkind" not in flight.kernel_profiler().snapshot()
+    finally:
+        flight._set_enabled(True)
+    assert flight.enabled()
+
+
+def test_dead_ring_retention_bounded():
+    flight.reset()
+
+    def emit():
+        flight.record(flight.EV_ADM_INGEST, arg=1)
+
+    for i in range(flight.DEAD_RING_KEEP + 12):
+        t = threading.Thread(target=emit, name=f"churn-{i}")
+        t.start()
+        t.join()
+    # one more live registration triggers the prune pass
+    emit()
+    snap = flight.snapshot()
+    alive = {t.name for t in threading.enumerate()}
+    dead = [r for r in snap["rings"] if r["thread"] not in alive]
+    assert len(dead) <= flight.DEAD_RING_KEEP
+    # the NEWEST dead rings were the ones kept
+    kept = {r["thread"] for r in dead if r["thread"].startswith("churn-")}
+    assert f"churn-{flight.DEAD_RING_KEEP + 11}" in kept
+
+
+def test_thread_rid_attribution():
+    flight.reset()
+    done = threading.Event()
+
+    def other():
+        flight.set_thread_rid(3)
+        flight.record(flight.EV_ADM_DRAIN, arg=8)
+        done.set()
+
+    t = threading.Thread(target=other, name="flight-test-thread")
+    t.start()
+    t.join()
+    assert done.is_set()
+    snap = flight.snapshot()
+    ring = next(r for r in snap["rings"]
+                if r["thread"] == "flight-test-thread")
+    assert ring["rid"] == 3 and ring["events"]
+
+
+# ---------------- slot lifecycle ----------------
+
+def test_fold_stage_math():
+    t0 = 1_000_000_000
+    slot = {"admit": t0, "handler": t0 + 2_000_000,
+            "accept": t0 + 3_000_000, "prepared": t0 + 10_000_000,
+            "committed": t0 + 15_000_000, "applied": t0 + 25_000_000,
+            "replied": t0 + 26_000_000}
+    stages = SlotTracker.fold(slot)
+    assert stages == {"adm_wait": 2.0, "dispatch": 1.0, "prepare": 7.0,
+                      "commit": 5.0, "exec": 10.0, "reply": 1.0}
+    # fast path: no prepare quorum — prepare reads 0, commit runs from
+    # accept; a primary self-proposal has no admit/handler anchors
+    fast = {"accept": t0, "committed": t0 + 4_000_000,
+            "applied": t0 + 5_000_000, "replied": t0 + 5_500_000}
+    stages = SlotTracker.fold(fast)
+    assert stages["adm_wait"] == 0.0 and stages["dispatch"] == 0.0
+    assert stages["prepare"] == 0.0 and stages["commit"] == 4.0
+    assert stages["exec"] == 1.0 and stages["reply"] == 0.5
+
+
+def test_slot_tracker_folds_recorded_lifecycle():
+    flight.reset()
+    for seq in (10, 11, 12):
+        _slot_events(seq, rid=5)
+    s = flight.stage_summary()
+    assert s["completed"] == 3 and s["live"] == 0
+    assert set(s["stages"]) == set(flight.STAGES)
+    recent = flight.slot_tracker().recent(rid=5)
+    assert [r["seq"] for r in recent] == [10, 11, 12]
+    assert all(r["total_ms"] >= 0 for r in recent)
+    # a replay of EV_REPLY for an already-folded slot is ignored
+    flight.record(flight.EV_REPLY, seq=10)
+    assert flight.stage_summary()["completed"] == 3
+
+
+def test_slot_tracker_live_bound():
+    flight.reset()
+    tr = flight.slot_tracker()
+    for seq in range(SlotTracker.MAX_LIVE + 40):
+        flight.record(flight.EV_PP_ACCEPT, seq=seq)
+    assert flight.stage_summary()["live"] <= SlotTracker.MAX_LIVE
+    tr.reset()
+
+
+# ---------------- kernel profiler ----------------
+
+def test_device_section_profiles_kernels():
+    from tpubft.ops.dispatch import device_section
+    flight.reset()
+    for i in range(3):
+        with device_section("flighttest", batch=16 * (i + 1)):
+            time.sleep(0.002)
+    snap = flight.kernel_profiler().snapshot()
+    st = snap["flighttest"]
+    assert st["calls"] == 3
+    assert st["first_call_ms"] >= 1.5            # the "compile" call
+    assert st["warm_avg_ms"] >= 1.5              # the two warm calls
+    assert st["batch_min"] == 16 and st["batch_max"] == 48
+    assert st["breaker_states"].get("closed") == 3
+    # the ring carries the enter/exit annotations too
+    me = threading.current_thread().name
+    ring = next(r for r in flight.snapshot()["rings"]
+                if r["thread"] == me)
+    codes = [e[1] for e in ring["events"]]
+    assert flight.EV_DEV_ENTER in codes and flight.EV_DEV_EXIT in codes
+
+
+# ---------------- diagnostics surfaces ----------------
+
+def test_status_endpoints_empty_recorder():
+    flight.reset()
+    reg = Registrar()
+    flight.install_diagnostics(reg)
+    slots = json.loads(reg.get_status("slots"))
+    assert slots["summary"]["completed"] == 0
+    assert slots["recent"] == []
+    assert set(slots["summary"]["stages"]) == set(flight.STAGES)
+    assert json.loads(reg.get_status("kernels")) == {}
+    snap = json.loads(reg.get_status("flight"))
+    assert snap["enabled"] and snap["ring_size"] == flight.RING_SIZE
+
+
+def test_status_endpoints_over_the_server():
+    flight.reset()
+    _slot_events(seq=42, rid=1)
+    from tpubft.ops.dispatch import device_section
+    with device_section("srvtest", batch=4):
+        pass
+    reg = Registrar()
+    flight.install_diagnostics(reg)
+    with TimeRecorder(reg.histogram("op")):
+        time.sleep(0.001)
+    srv = DiagnosticsServer(reg)
+    srv.start()
+    try:
+        keys = ctl.query(srv.port, "status list").split("\n")
+        assert {"flight", "slots", "kernels"} <= set(keys)
+        slots = json.loads(ctl.query(srv.port, "status get slots"))
+        assert slots["summary"]["completed"] >= 1
+        assert any(r["seq"] == 42 for r in slots["recent"])
+        kernels = json.loads(ctl.query(srv.port, "status get kernels"))
+        assert kernels["srvtest"]["calls"] == 1
+        snap = json.loads(ctl.query(srv.port, "status get flight"))
+        assert snap["rings"] and snap["event_names"]
+        # histogram snapshot shape (`perf show`): the full percentile
+        # contract every stage histogram also serves
+        hist = json.loads(ctl.query(srv.port, "perf show op"))
+        assert set(hist) == {"count", "avg", "max", "p50", "p95", "p99",
+                             "unit"}
+        assert hist["count"] == 1 and hist["unit"] == "us"
+        # the slot stages registered their histograms on the GLOBAL
+        # registrar (process-wide diagnostics)
+        from tpubft.diagnostics import get_registrar
+        gsnap = get_registrar().histogram_snapshot("slot.commit")
+        assert gsnap is not None and gsnap["count"] >= 1
+    finally:
+        srv.stop()
+
+
+# ---------------- dump plane + tpuprof ----------------
+
+def test_stalled_health_transition_writes_dump_tpuprof_renders(tmp_path):
+    from tools import tpuprof
+    from tpubft.consensus.health import HealthMonitor
+    from tpubft.utils.breaker import all_breakers
+    for b in all_breakers().values():
+        b.reset()
+    flight.reset()
+    flight.configure(dump_dir=str(tmp_path))
+    try:
+        _slot_events(seq=77, rid=2)
+        clk = [100.0]
+        hm = HealthMonitor("flighttest", clock=lambda: clk[0])
+        hm.register_probe("dispatcher", 1.0,
+                          detail_fn=lambda: {"external_q": 0})
+        v = hm.poll_once()
+        assert v["verdict"] == "healthy"
+        assert hm.last_flight_dump is None
+        clk[0] = 105.0                      # probe age 5s > 1s threshold
+        v = hm.poll_once()
+        assert v["verdict"] == "stalled"
+        path = hm.last_flight_dump
+        assert path and os.path.exists(path)
+        assert hm.m_flight_dumps.value == 1
+        # same episode: no second artifact
+        clk[0] = 106.0
+        hm.poll_once()
+        assert hm.m_flight_dumps.value == 1
+        dump = json.load(open(path))
+        assert dump["reason"].endswith("stalled")
+        assert dump["extra"]["stalled"] == ["dispatcher"]
+        # the offline analyzer renders a timeline for the recorded slot
+        out = tpuprof.render([path])
+        assert "stage histogram" in out
+        assert "slot timeline" in out
+        assert "    77 " in out             # seq 77's timeline row
+        assert "kernel profile" in out
+        # recovery re-arms: beat + healthy poll, then a fresh stall
+        # writes a NEW artifact
+        hm.beat("dispatcher")
+        assert hm.poll_once()["verdict"] == "healthy"
+        clk[0] = 120.0
+        assert hm.poll_once()["verdict"] == "stalled"
+        assert hm.m_flight_dumps.value == 2
+    finally:
+        flight.configure(dump_dir=flight._default_dump_dir())
+
+
+def test_chaos_red_verdict_attaches_flight_dump(tmp_path):
+    from tpubft.testing.campaign import ChaosCampaign, ScenarioSpec
+    flight.configure(dump_dir=str(tmp_path))
+    try:
+        def red(ctx):
+            raise AssertionError("injected red verdict")
+
+        def green(ctx):
+            return {"fine": True}
+
+        art = ChaosCampaign(seed=7, specs=[
+            ScenarioSpec("seeded-red", red, "inproc", 10.0),
+            ScenarioSpec("seeded-green", green, "inproc", 10.0),
+        ]).run()
+        vr = next(s for s in art["scenarios"] if s["name"] == "seeded-red")
+        vg = next(s for s in art["scenarios"]
+                  if s["name"] == "seeded-green")
+        assert not vr["ok"] and "injected red verdict" in vr["error"]
+        assert vr["flight_dump"] and os.path.exists(vr["flight_dump"])
+        dump = json.load(open(vr["flight_dump"]))
+        assert dump["reason"] == "chaos-red-seeded-red"
+        assert "injected red verdict" in dump["extra"]["error"]
+        assert vg["ok"] and "flight_dump" not in vg
+    finally:
+        flight.configure(dump_dir=flight._default_dump_dir())
+
+
+def test_dump_retention_prunes_oldest(tmp_path, monkeypatch):
+    flight.configure(dump_dir=str(tmp_path))
+    monkeypatch.setattr(flight, "MAX_DUMPS", 3)
+    try:
+        paths = [flight.dump(f"ret{i}") for i in range(7)]
+        assert all(paths)
+        files = sorted(f for f in os.listdir(tmp_path)
+                       if f.endswith(".json"))
+        # prune runs before each write: at most MAX_DUMPS + the fresh one
+        assert len(files) <= 4
+        assert os.path.basename(paths[-1]) in files      # newest kept
+        assert os.path.basename(paths[0]) not in files   # oldest pruned
+    finally:
+        flight.configure(dump_dir=flight._default_dump_dir())
+
+
+def test_health_dump_throttle(tmp_path):
+    from tpubft.consensus.health import HealthMonitor
+    from tpubft.utils.breaker import all_breakers
+    for b in all_breakers().values():
+        b.reset()
+    flight.configure(dump_dir=str(tmp_path))
+    try:
+        clk = [0.0]
+        hm = HealthMonitor("flaptest", clock=lambda: clk[0])
+        hm.register_probe("dispatcher", 1.0)
+
+        def flap(at):
+            clk[0] = at
+            v = hm.poll_once()
+            assert v["verdict"] == "stalled"
+            hm.beat("dispatcher")
+            assert hm.poll_once()["verdict"] == "healthy"
+
+        flap(5.0)
+        assert hm.m_flight_dumps.value == 1
+        flap(8.0)                       # within dump_min_interval_s
+        assert hm.m_flight_dumps.value == 1      # throttled, no artifact
+        flap(30.0)
+        assert hm.m_flight_dumps.value == 2
+    finally:
+        flight.configure(dump_dir=flight._default_dump_dir())
+
+
+def test_dump_survives_unwritable_dir(tmp_path):
+    target = tmp_path / "nope"
+    target.write_text("a file, not a directory")
+    flight.configure(dump_dir=str(target))
+    try:
+        assert flight.dump("unwritable") is None   # never raises
+    finally:
+        flight.configure(dump_dir=flight._default_dump_dir())

@@ -118,9 +118,14 @@ def test_tracing_spans_and_context_propagation():
 def test_tracing_wired_through_live_cluster():
     """The protocol call sites actually emit spans (tracing is product
     code, not a dead module): a client write produces client_send →
-    client_request → consensus_slot spans joined under ONE trace id."""
+    consensus_slot spans joined under ONE trace id. (The per-request
+    client_request span is gone — hot-path handlers emit bounded
+    flight.record events instead, enforced by check_hotpath; the slot
+    span still parents on the request's cid so the trace joins.)"""
     from tpubft.apps import counter
     from tpubft.testing import InProcessCluster
+    from tpubft.utils import flight
+    flight.reset()
     with InProcessCluster(f=1) as cluster:
         cl = cluster.client()
         assert counter.decode_reply(
@@ -129,10 +134,19 @@ def test_tracing_wired_through_live_cluster():
         send = [s for s in spans if s.name == "client_send"][-1]
         joined = {s.name for s in spans
                   if s.context.trace_id == send.context.trace_id}
-        assert {"client_send", "client_request", "consensus_slot"} <= joined
+        assert {"client_send", "consensus_slot"} <= joined
         slot = next(s for s in spans if s.name == "consensus_slot"
                     and s.context.trace_id == send.context.trace_id)
         assert slot.end is not None and slot.tags.get("committed_path")
+        # monotonic span timing: duration is non-negative and the span
+        # carries its one wall-clock epoch tag for cross-replica merge
+        assert slot.duration_s is not None and slot.duration_s >= 0
+        assert slot.epoch > 0
+        # the hot path emitted flight events for the same slot: the
+        # recorder folded a completed lifecycle with stage timings
+        summary = flight.stage_summary()
+        assert summary["completed"] >= 1
+        assert set(summary["stages"]) == set(flight.STAGES)
 
 
 # ---------------- slowdown ----------------

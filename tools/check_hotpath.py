@@ -33,11 +33,13 @@ from tools.tpulint.passes import hotpath as _impl  # noqa: E402
 # without touching the shared pass configuration
 HOT_PATH = {k: set(v) for k, v in _impl.HOT_PATH.items()}
 FORBIDDEN_CALLS = set(_impl.FORBIDDEN_CALLS)
+FORBIDDEN_TELEMETRY = set(_impl.FORBIDDEN_TELEMETRY)
 
 
 def find_violations(root: str):
     return _impl.find_violations(root, hot_path=HOT_PATH,
-                                 forbidden=FORBIDDEN_CALLS)
+                                 forbidden=FORBIDDEN_CALLS,
+                                 telemetry=FORBIDDEN_TELEMETRY)
 
 
 def main(argv: List[str]) -> int:
@@ -48,7 +50,8 @@ def main(argv: List[str]) -> int:
     if violations:
         return 1
     n = sum(len(v) for v in HOT_PATH.values())
-    print(f"OK: no unpack/verify call sites in {n} hot-path handlers")
+    print(f"OK: no unpack/verify/span/f-string sites in {n} hot-path "
+          f"handlers (telemetry rides flight.record only)")
     return 0
 
 

@@ -5,6 +5,15 @@ synchronously on the consensus dispatcher — must contain no direct
 `unpack()` / `.verify()` / `.verify_batch()` call sites: parse and
 signature checks belong to the admission plane (or to the explicitly
 named `_verify_*` fallback seams for the admission_workers=0 path).
+
+They must also emit telemetry ONLY through the bounded flight-recorder
+API (`flight.record(...)` — tpubft/utils/flight.py): span allocation
+(`get_tracer`/`start_span`/`set_tag`) and f-string construction are
+per-message heap work the hot path must not pay — the recorder exists
+precisely so hot-seam observability costs one tuple into a
+preallocated ring. (Logging through %-style lazy formatting stays
+allowed: it only formats when the level is live.)
+
 A handler disappearing from the source is itself a violation — the
 list must track the code. tools/check_hotpath.py remains the CLI shim.
 """
@@ -45,6 +54,11 @@ HOT_PATH: Dict[Tuple[str, str], Set[str]] = {
 
 FORBIDDEN_CALLS = {"unpack", "verify", "verify_batch"}
 
+# span-allocation observability: per-message heap work the flight
+# recorder replaces on the hot path (flight.record is the ONE allowed
+# telemetry call in the handlers above)
+FORBIDDEN_TELEMETRY = {"get_tracer", "start_span", "set_tag"}
+
 
 def _call_name(node: ast.Call) -> str:
     f = node.func
@@ -63,10 +77,11 @@ def _functions(tree: ast.Module, class_name: str):
                     yield item
 
 
-def find_violations(root: str, hot_path=None,
-                    forbidden=None) -> List[Tuple[str, int, str]]:
+def find_violations(root: str, hot_path=None, forbidden=None,
+                    telemetry=None) -> List[Tuple[str, int, str]]:
     hot_path = HOT_PATH if hot_path is None else hot_path
     forbidden = FORBIDDEN_CALLS if forbidden is None else forbidden
+    telemetry = FORBIDDEN_TELEMETRY if telemetry is None else telemetry
     out: List[Tuple[str, int, str]] = []
     for (rel, cls), fn_names in sorted(hot_path.items()):
         path = os.path.join(root, rel)
@@ -86,6 +101,22 @@ def find_violations(root: str, hot_path=None,
                         f"{cls}.{fn.name} calls {_call_name(node)}() — "
                         f"hot-path handlers must consult the admission "
                         f"verdict / route through a _verify_* seam"))
+                elif isinstance(node, ast.Call) \
+                        and _call_name(node) in telemetry:
+                    out.append((
+                        os.path.join(rel),
+                        node.lineno,
+                        f"{cls}.{fn.name} calls {_call_name(node)}() — "
+                        f"hot-path handlers may only emit telemetry "
+                        f"through the bounded flight.record() API"))
+                elif isinstance(node, ast.JoinedStr):
+                    out.append((
+                        os.path.join(rel),
+                        node.lineno,
+                        f"{cls}.{fn.name} builds an f-string — "
+                        f"per-message string formatting is forbidden on "
+                        f"the hot path; emit flight.record() events or "
+                        f"%-style lazy log formatting"))
         for missing in sorted(fn_names - found):
             # a renamed handler silently leaving the lint's coverage is
             # itself a violation: the list must track the code

@@ -172,6 +172,16 @@ RETURN_TYPE_HINTS: Dict[str, Tuple[str, str]] = {
         ("tpubft/utils/racecheck.py", "LockOrderChecker"),
     "tpubft.utils.tracing.get_tracer":
         ("tpubft/utils/tracing.py", "Tracer"),
+    # flight recorder: no threads of its own (per-thread rings are
+    # written by their OWNING thread; dump artifacts ride the health
+    # monitor's already-seeded thread and chaos-campaign callers) —
+    # these factories let `slot_tracker().on_event()` /
+    # `kernel_profiler().record()` chains resolve so the static-race
+    # pass covers the fold/profile state they guard with make_lock
+    "tpubft.utils.flight.slot_tracker":
+        ("tpubft/utils/flight.py", "SlotTracker"),
+    "tpubft.utils.flight.kernel_profiler":
+        ("tpubft/utils/flight.py", "KernelProfiler"),
 }
 
 # modules excluded from the concurrency passes (thread-roles,

@@ -63,6 +63,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from tpubft.consensus import messages as m
 from tpubft.consensus.incoming import MAX_EXTERNAL_PENDING
+from tpubft.utils import flight
 from tpubft.utils.logging import get_logger
 from tpubft.utils.metrics import Aggregator, Component
 
@@ -146,10 +147,14 @@ class AdmissionPipeline:
                  aggregator: Optional[Aggregator] = None,
                  name: str = "admission", ckpt_window: int = 0,
                  high_watermark: int = 0, low_watermark: int = 0,
-                 beat_fn: Optional[Callable[[], None]] = None):
+                 beat_fn: Optional[Callable[[], None]] = None,
+                 rid: int = -1):
         self._sig = sig
         self._info = info
         self._sink = sink
+        # replica id for flight-recorder attribution (multi-replica
+        # processes: the in-process test cluster)
+        self._rid = rid
         self._epoch_fn = epoch_fn
         self._view_fn = view_fn
         self._stable_fn = stable_fn
@@ -300,6 +305,7 @@ class AdmissionPipeline:
         return "ok"
 
     def submit(self, sender: int, raw: bytes) -> bool:
+        flight.record(flight.EV_ADM_INGEST, arg=1)
         cls = self._class_of(raw)
         with self._cv:
             d = self._ingest_locked(sender, raw, cls)
@@ -320,6 +326,7 @@ class AdmissionPipeline:
         # one-lock-round handoff recvmmsg bought
         classed = [(sender, raw, self._class_of(raw))
                    for sender, raw in msgs]
+        flight.record(flight.EV_ADM_INGEST, arg=len(classed))
         taken = shed = full = 0
         with self._cv:
             for sender, raw, cls in classed:
@@ -398,6 +405,7 @@ class AdmissionPipeline:
                 pass           # kill a worker
 
     def _run(self, idx: int = 0) -> None:
+        flight.set_thread_rid(self._rid)
         while self._running:
             self._stamp_beat(idx)     # health probe: a worker wedged
             # inside _drain stops stamping; once it is the stalest, the
@@ -569,6 +577,7 @@ class AdmissionPipeline:
 
     def _drain(self, batch: List[Tuple[int, bytes]]) -> None:
         from tpubft.utils.tracing import get_tracer
+        flight.record(flight.EV_ADM_DRAIN, arg=len(batch))
         view, stable, epoch = (self._view_fn(), self._stable_fn(),
                                self._epoch_fn())
         with get_tracer().start_span("adm_drain") as span:
@@ -703,6 +712,11 @@ class AdmissionPipeline:
                                 if not r.flags \
                                         & m.RequestFlag.HAS_PRE_PROCESSED:
                                     r._adm_verified = True
+                if isinstance(msg, m.PrePrepareMsg):
+                    # slot-lifecycle anchor: the adm_wait stage runs
+                    # from here to the dispatcher's PP handler entry
+                    flight.record(flight.EV_ADM_ADMIT, seq=msg.seq_num,
+                                  view=msg.view)
                 self._sink(AdmittedMsg(sender, msg))
                 admitted += 1
 

@@ -43,6 +43,7 @@ from typing import List, Optional, Tuple
 
 from tpubft.storage.interfaces import WriteBatch
 from tpubft.testing.crashpoints import crashpoint
+from tpubft.utils import flight
 from tpubft.utils.logging import get_logger, mdc_scope
 from tpubft.utils.racecheck import get_watchdog, make_lock
 
@@ -177,6 +178,7 @@ class ExecutionLane:
         # a run stuck behind a dead DB, or a held lane), even while this
         # thread is alive and waiting
         health = getattr(self._r, "health", None)
+        flight.set_thread_rid(self._r.id)
         with mdc_scope(r=self._r.id):
             while True:
                 watchdog.beat(self._name)
@@ -288,6 +290,12 @@ class ExecutionLane:
                                   result.first, result.last)
             crashpoint("exec.post_apply", rid=r.id)
             commit_ms = (time.perf_counter() - t0) * 1e3
+            # durable-apply flight events, one per slot (the `exec`
+            # stage's end anchor; `reply` runs from here to the
+            # dispatcher's integration)
+            for seq, _pp in run:
+                flight.record(flight.EV_EXEC_APPLY, seq=seq,
+                              arg=len(run))
             # the run is durable: NOW the at-most-once/reply-cache
             # records become visible (crash before this point replays
             # the suffix; the persisted ring deduplicates it)

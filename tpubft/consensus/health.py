@@ -46,6 +46,7 @@ import traceback
 from typing import Callable, Dict, List, Optional
 
 from tpubft.utils import breaker as breaker_mod
+from tpubft.utils import flight
 from tpubft.utils.logging import get_logger
 from tpubft.utils.metrics import Aggregator, Component
 
@@ -96,7 +97,19 @@ class HealthMonitor:
         self.m_breakers = self.metrics.register_status("breakers", "")
         self.m_stall_dumps = self.metrics.register_counter("stall_dumps")
         self.m_stalled_probes = self.metrics.register_gauge("stalled_probes")
+        self.m_flight_dumps = self.metrics.register_counter("flight_dumps")
         self._age_gauges: Dict[str, object] = {}
+        # flight-dump plane: the verdict seen by the LAST poll, so a
+        # transition into degraded/stalled writes exactly one artifact
+        # per episode (re-armed when the verdict recovers). A flapping
+        # source (e.g. a breaker cycling through half-open probes)
+        # oscillates the verdict every few seconds — the min-interval
+        # throttle keeps that from writing an artifact per flap, while
+        # flight.MAX_DUMPS bounds total disk either way.
+        self._last_verdict = HEALTHY
+        self.last_flight_dump: Optional[str] = None
+        self.dump_min_interval_s = 10.0
+        self._last_dump_at: Optional[float] = None
 
     # ------------------------------------------------------------------
     # registration + beats (any thread)
@@ -227,6 +240,32 @@ class HealthMonitor:
         v = self.verdict()
         self.m_verdict.set(v["verdict"])
         self.m_stalled_probes.set(len(v["stalled"]))
+        # flight-dump plane: every transition INTO a non-healthy
+        # verdict captures the timeline that led there (rings + kernel
+        # profile + lock hold stats + queue depths ride the artifact)
+        if v["verdict"] != self._last_verdict:
+            flight.record(flight.EV_HEALTH,
+                          arg={HEALTHY: 0, DEGRADED: 1,
+                               STALLED: 2}.get(v["verdict"], 0))
+            now = self._clock()
+            throttled = (self._last_dump_at is not None
+                         and now - self._last_dump_at
+                         < self.dump_min_interval_s)
+            if v["verdict"] in (DEGRADED, STALLED) and not throttled:
+                self._last_dump_at = now
+                path = flight.dump(
+                    reason=f"{self._name}-{v['verdict']}",
+                    extra={"probes": v["probes"],
+                           "breakers": v["breakers"],
+                           "degraded": v["degraded"],
+                           "stalled": v["stalled"]})
+                if path is not None:
+                    self.last_flight_dump = path
+                    self.m_flight_dumps.inc()
+                    log.warning("%s: verdict %s -> %s; flight dump "
+                                "written to %s", self._name,
+                                self._last_verdict, v["verdict"], path)
+            self._last_verdict = v["verdict"]
         self.m_breakers.set(json.dumps(
             {n: b["state"] for n, b in v["breakers"].items()},
             sort_keys=True))
@@ -253,6 +292,8 @@ class HealthMonitor:
                  "probes: " + json.dumps(v["probes"]),
                  "breakers: " + json.dumps(v["breakers"]),
                  "degraded: " + json.dumps(v["degraded"])]
+        if self.last_flight_dump:
+            lines.append(f"flight dump: {self.last_flight_dump}")
         frames = sys._current_frames()
         names = {t.ident: t.name for t in threading.enumerate()}
         for ident, frame in frames.items():

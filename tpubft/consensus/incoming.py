@@ -199,6 +199,13 @@ class Dispatcher:
 
     def _loop_body(self) -> None:
         set_mdc(**self._thread_mdc)
+        # flight-recorder attribution: this thread's events carry the
+        # replica id (from the same MDC that labels its log lines)
+        from tpubft.utils import flight
+        try:
+            flight.set_thread_rid(int(self._thread_mdc.get("r", -1)))
+        except (TypeError, ValueError):
+            pass
         # liveness heartbeat: a wedged dispatcher (deadlock, hung handler)
         # gets a full-process stack dump from the watchdog (§5.2 role)
         from tpubft.utils.racecheck import get_watchdog

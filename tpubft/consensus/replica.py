@@ -58,6 +58,7 @@ from tpubft.crypto.digest import digest as sha256
 from tpubft.diagnostics import TimeRecorder
 from tpubft.testing.crashpoints import crashpoint
 from tpubft.testing.slowdown import PHASE_EXECUTE
+from tpubft.utils import flight
 from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.logging import get_logger, mdc_scope
 from tpubft.utils.metrics import Aggregator, Component
@@ -331,7 +332,8 @@ class Replica(IReceiver):
                 ckpt_window=cfg.checkpoint_window_size,
                 high_watermark=cfg.admission_high_watermark,
                 low_watermark=cfg.admission_low_watermark,
-                beat_fn=lambda: self.health.beat("admission"))
+                beat_fn=lambda: self.health.beat("admission"),
+                rid=cfg.replica_id)
             self.dispatcher.set_admitted_handler(self._on_admitted)
             self.health.register_probe(
                 "admission", cfg.health_stall_ms / 1e3,
@@ -473,6 +475,8 @@ class Replica(IReceiver):
         self._diag.register_status(f"replica{self.id}.health",
                                    self.health.render)
         self._diag.register_status("health", self.health.render)
+        # flight recorder surfaces (`status get flight|slots|kernels`)
+        flight.install_diagnostics(self._diag)
         from tpubft.testing.slowdown import get_slowdown_manager
         self._slowdown = get_slowdown_manager()
 
@@ -764,6 +768,12 @@ class Replica(IReceiver):
         return share_digest(kind, self.epoch, view, seq_num, pp_digest)
 
     def _dispatch_external(self, sender: int, msg) -> None:
+        # flight recorder: handler-entry event — the bounded, fixed-size
+        # telemetry the hot path is allowed (check_hotpath forbids
+        # span/f-string observability here)
+        flight.record(flight.EV_DISPATCH,
+                      seq=getattr(msg, "seq_num", 0) or 0,
+                      view=self.view, arg=int(getattr(msg, "CODE", 0)))
         # era gate (reference: per-message epochNum checks, e.g.
         # PrePrepareMsg.cpp:91, ReplicaImp.cpp:2313): traffic from an
         # older reconfiguration era is dead — drop it before any handler.
@@ -957,16 +967,14 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     def _on_client_request(self, req: m.ClientRequestMsg,
                            relay: bool = True) -> None:
-        """Traced entry (reference: child span per message handler,
-        ReplicaImp.cpp:409-413 — the span context rides the cid field,
-        MessageBase::spanContext<T>())."""
-        from tpubft.utils.tracing import SpanContext, get_tracer
-        with get_tracer().start_span(
-                "client_request",
-                parent=SpanContext.parse(req.cid or "")) as span:
-            span.set_tag("r", self.id).set_tag("client", req.sender_id) \
-                .set_tag("req_seq", req.req_seq_num)
-            self._handle_client_request(req, relay=relay)
+        """Recorded entry. The per-request span this used to allocate
+        is gone — a span per message is exactly the hot-path telemetry
+        the flight recorder replaces (check_hotpath now forbids it);
+        the trace still joins end-to-end because _accept_pre_prepare's
+        consensus_slot span parents on the first request's cid."""
+        flight.record(flight.EV_CLIENT_REQ, seq=req.req_seq_num,
+                      arg=req.sender_id)
+        self._handle_client_request(req, relay=relay)
 
     def _handle_client_request(self, req: m.ClientRequestMsg,
                                relay: bool = True) -> None:
@@ -1177,6 +1185,9 @@ class Replica(IReceiver):
         return restr is None or pp.requests_digest == restr.requests_digest
 
     def _on_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
+        # slot-stage anchor: adm_wait ends / dispatch begins here
+        flight.record(flight.EV_PP_DISPATCH, seq=pp.seq_num,
+                      view=pp.view)
         if pp.view == self.view and pp.sender_id == self.primary \
                 and self.window.in_window(pp.seq_num):
             # receipt ack, duplicates included (retransmission tracking
@@ -1316,6 +1327,7 @@ class Replica(IReceiver):
         self._accept_pre_prepare(pp)
 
     def _accept_pre_prepare(self, pp: m.PrePrepareMsg) -> None:
+        flight.record(flight.EV_PP_ACCEPT, seq=pp.seq_num, view=pp.view)
         info = self.window.get(pp.seq_num)
         info.pre_prepare = pp
         info.commit_path = pp.first_path
@@ -1631,6 +1643,7 @@ class Replica(IReceiver):
         info = self.window.get(msg.seq_num)
         if info.prepared:
             return
+        flight.record(flight.EV_PREPARED, seq=msg.seq_num, view=msg.view)
         info.prepare_full = msg
         info.prepared = True
         with self._tran() as st:
@@ -1644,6 +1657,8 @@ class Replica(IReceiver):
         info = self.window.get(msg.seq_num)
         if info.committed:
             return
+        flight.record(flight.EV_COMMITTED, seq=msg.seq_num,
+                      view=msg.view, arg=0)
         info.commit_full = msg
         info.committed = True
         self.m_slow_commits.inc()
@@ -1666,6 +1681,8 @@ class Replica(IReceiver):
         info = self.window.get(msg.seq_num)
         if info.committed:
             return
+        flight.record(flight.EV_COMMITTED, seq=msg.seq_num,
+                      view=msg.view, arg=1)
         info.full_commit_proof = msg
         info.committed = True
         self.m_fast_commits.inc()
@@ -1774,6 +1791,10 @@ class Replica(IReceiver):
         self._last_progress = time.monotonic()
         with self._tran() as st:
             st.last_executed_seq = nxt
+        # inline path: apply and reply complete together on the
+        # dispatcher — both slot-stage anchors land here
+        flight.record(flight.EV_EXEC_APPLY, seq=nxt, arg=1)
+        flight.record(flight.EV_REPLY, seq=nxt)
         if nxt % self.cfg.checkpoint_window_size == 0:
             self._send_checkpoint(nxt)
         # a slot just left the pipeline: the primary proposes the
@@ -1843,6 +1864,7 @@ class Replica(IReceiver):
                 self._execute_one_slot(nxt, info)
                 continue
             info.exec_submitted = True
+            flight.record(flight.EV_EXEC_ENQ, seq=nxt, view=self.view)
             try:
                 self.exec_lane.submit(nxt, info.pre_prepare)
             except BaseException:
@@ -1920,6 +1942,11 @@ class Replica(IReceiver):
                 st.last_executed_seq = self.last_executed
             crashpoint("meta.watermark", rid=self.id)
             self._last_progress = time.monotonic()
+            # slot integrated + replies on the wire: the `reply` stage
+            # ends here (the lane recorded EV_EXEC_APPLY at its durable
+            # commit), finalizing each slot's lifecycle record
+            for seq in range(run.first, run.last + 1):
+                flight.record(flight.EV_REPLY, seq=seq)
             if run.checkpoint is not None:
                 seq, state_digest, pages_digest = run.checkpoint
                 self._send_checkpoint(seq, state_digest=state_digest,

@@ -92,7 +92,7 @@ def msm(points: Sequence, scalars: Sequence[int]):
     px, py = cv.affine_to_device(pts)
     bits = _bits_msb_batch(ks)
     from tpubft.ops.dispatch import device_section
-    with device_section("bls_msm"):
+    with device_section("bls_msm", batch=len(pts)):
         x, y, z = msm_kernel(jnp.asarray(bits), jnp.asarray(px),
                              jnp.asarray(py), jnp.asarray(infinity))
         x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
@@ -135,7 +135,7 @@ def batch_scalar_mul(points: Sequence, scalars: Sequence[int]) -> List:
         return acc.x, acc.y, acc.z
 
     from tpubft.ops.dispatch import device_section
-    with device_section("bls_mul"):
+    with device_section("bls_mul", batch=n):
         x, y, z = kern(jnp.asarray(bits), jnp.asarray(px), jnp.asarray(py),
                        jnp.asarray(infinity))
         x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)

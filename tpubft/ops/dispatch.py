@@ -29,6 +29,7 @@ from __future__ import annotations
 import threading
 import time
 
+from tpubft.utils import flight
 from tpubft.utils.breaker import BreakerOpen, get_breaker  # noqa: F401
 # re-exported: callers catching the fast-fail import it from here so the
 # ops layer stays the only crypto↔breaker coupling point
@@ -57,14 +58,26 @@ def device_dispatch():
 
 
 class _Section:
-    """`with device_section(kind):` — breaker admission/classification
-    around the serialized device gate. Raises BreakerOpen without
-    touching the device when tripped."""
+    """`with device_section(kind, batch):` — breaker
+    admission/classification around the serialized device gate, plus
+    flight-recorder/kernel-profiler annotation (kind, batch size, wall
+    time, breaker state). Raises BreakerOpen without touching the
+    device when tripped."""
 
-    __slots__ = ("_attempt",)
+    __slots__ = ("_attempt", "_kind", "_batch", "_kid", "_t0", "_rec")
 
-    def __init__(self, kind: str) -> None:
+    def __init__(self, kind: str, batch: int) -> None:
         self._attempt = _breaker.attempt(kind)
+        self._kind = kind
+        self._batch = batch
+        # the TPUBFT_FLIGHT=0 off switch covers the kernel profiler
+        # too: a disabled recorder must cost this seam nothing beyond
+        # the enabled() check (decided once per section — consistent
+        # even if the test hook flips mid-call)
+        self._rec = flight.enabled()
+        self._kid = flight.kernel_profiler().kind_id(kind) \
+            if self._rec else 0
+        self._t0 = 0
 
     def __enter__(self):
         self._attempt.__enter__()
@@ -76,12 +89,28 @@ class _Section:
         t = time.monotonic()
         _gate.acquire()
         _breaker.exclude_wait(time.monotonic() - t)
+        if self._rec:
+            flight.record(flight.EV_DEV_ENTER, view=self._kid,
+                          arg=self._batch)
+            self._t0 = time.monotonic_ns()
         return self
 
     def __exit__(self, *exc) -> bool:
+        elapsed_ns = (time.monotonic_ns() - self._t0) if self._rec else 0
         _gate.release()
-        return bool(self._attempt.__exit__(*exc))
+        suppressed = bool(self._attempt.__exit__(*exc))
+        if self._rec:
+            # profile AFTER the breaker's verdict so the recorded state
+            # is the post-call one (a call that just tripped the
+            # breaker shows up as such in the kernel profile)
+            flight.record(flight.EV_DEV_EXIT, view=self._kid,
+                          arg=int(elapsed_ns // 1000))
+            flight.kernel_profiler().record(self._kind, self._batch,
+                                            elapsed_ns, _breaker.state)
+        return suppressed
 
 
-def device_section(kind: str) -> _Section:
-    return _Section(kind)
+def device_section(kind: str, batch: int = 0) -> _Section:
+    """Guarded device seam. `batch` annotates the kernel profile /
+    flight ring with the call's batch size (0 = not reported)."""
+    return _Section(kind, batch)

@@ -125,7 +125,7 @@ def verify_batch(curve_name: str,
         _KERNELS[curve_name] = make_verify_kernel(curve_name)
     prep = prepare_batch(curve_name, items)
     from tpubft.ops.dispatch import device_section
-    with device_section("ecdsa"):
+    with device_section("ecdsa", batch=len(items)):
         out = _KERNELS[curve_name](prep.u1_bits, prep.u2_bits,
                                    prep.qx, prep.qy,
                                    prep.r_raw, prep.r_plus_n_raw)

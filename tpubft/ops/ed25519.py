@@ -417,7 +417,7 @@ def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
         return np.pad(a, width)
 
     from tpubft.ops.dispatch import device_section
-    with device_section("ed25519"):
+    with device_section("ed25519", batch=n):
         dev = kernel(pad(prep.s_win, 1), pad(prep.h_win, 1),
                      pad(prep.a_y, 1), pad(prep.a_sign, 0),
                      pad(prep.r_y, 1), pad(prep.r_sign, 0))

@@ -134,7 +134,7 @@ def sha256_batch(messages: Sequence[bytes]) -> List[bytes]:
     padded = list(messages) + [messages[0]] * (padded_n - n)
     words = prepare(padded)
     from tpubft.ops.dispatch import device_section
-    with device_section("sha256"):
+    with device_section("sha256", batch=padded_n):
         out = digest_words_to_bytes(sha256_kernel(jnp.asarray(words)))
     return out[:n]
 
@@ -191,7 +191,7 @@ def sha256_batch_mixed(messages: Sequence[bytes]) -> List[bytes]:
     padded = list(messages) + [messages[0]] * (padded_n - n)
     words, nblocks = prepare_mixed(padded)
     from tpubft.ops.dispatch import device_section
-    with device_section("sha256"):
+    with device_section("sha256", batch=padded_n):
         out = digest_words_to_bytes(
             sha256_kernel_masked(jnp.asarray(words), jnp.asarray(nblocks)))
     return out[:n]

@@ -147,12 +147,19 @@ class ScenarioVerdict:
     time_budget_s: float
     stats: dict = field(default_factory=dict)
     error: str = ""
+    # flight-recorder artifact captured at the moment of a red verdict
+    # (rings + kernel profile + slot timings): the timeline that led to
+    # the failure rides the bug report, not just the assertion text
+    flight_dump: str = ""
 
     def as_dict(self) -> dict:
-        return {"name": self.name, "ok": self.ok,
-                "duration_s": round(self.duration_s, 3),
-                "time_budget_s": self.time_budget_s,
-                "stats": self.stats, "error": self.error}
+        out = {"name": self.name, "ok": self.ok,
+               "duration_s": round(self.duration_s, 3),
+               "time_budget_s": self.time_budget_s,
+               "stats": self.stats, "error": self.error}
+        if self.flight_dump:
+            out["flight_dump"] = self.flight_dump
+        return out
 
 
 class ChaosCampaign:
@@ -185,8 +192,18 @@ class ChaosCampaign:
                     err = f"{type(e).__name__}: {e}"
                 finally:
                     self._cleanup_globals()
+                fdump = ""
+                if not ok:
+                    # red verdict: capture the flight recorder BEFORE
+                    # the next scenario overwrites the rings (the dump
+                    # is measurement, not schedule — never digested)
+                    from tpubft.utils import flight
+                    fdump = flight.dump(
+                        reason=f"chaos-red-{spec.name}",
+                        extra={"error": err}) or ""
                 verdicts.append(ScenarioVerdict(
-                    spec.name, ok, dt, spec.time_budget_s, stats, err))
+                    spec.name, ok, dt, spec.time_budget_s, stats, err,
+                    flight_dump=fdump))
         finally:
             if not self.keep_tmp:
                 shutil.rmtree(tmp_root, ignore_errors=True)

@@ -15,6 +15,8 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
+from tpubft.utils.racecheck import make_lock
+
 
 class Meter:
     """Trailing-window rate estimator behind throughput gauges
@@ -28,7 +30,10 @@ class Meter:
     def __init__(self, window_s: float = 5.0) -> None:
         self._window = window_s
         self._events: deque = deque()        # (monotonic ts, n)
-        self._lock = threading.Lock()
+        # make_lock (not a raw threading.Lock) so the tpulint
+        # static-race pass and the runtime lock-order graph both see
+        # it; a leaf lock — nothing is acquired while it is held
+        self._lock = make_lock("metrics.meter")
 
     def _trim(self, now: float) -> None:
         horizon = now - self._window
@@ -59,7 +64,7 @@ class Counter:
 
     def __init__(self) -> None:
         self.value = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.counter")   # leaf lock (see Meter)
 
     def inc(self, n: int = 1) -> None:
         with self._lock:

@@ -40,8 +40,13 @@ class Span:
     name: str
     context: SpanContext
     parent_span_id: Optional[str]
-    start: float = field(default_factory=time.time)
+    # durations are timed on the MONOTONIC clock: a wall-clock step
+    # (NTP slew, operator date set) must never yield negative/garbage
+    # span durations. `epoch` is the one wall-clock tag per span, taken
+    # at start, for cross-replica alignment of exported traces.
+    start: float = field(default_factory=time.monotonic)
     end: Optional[float] = None
+    epoch: float = field(default_factory=time.time)
     tags: Dict[str, str] = field(default_factory=dict)
     _tracer: Optional["Tracer"] = field(default=None, repr=False,
                                         compare=False)
@@ -50,8 +55,12 @@ class Span:
         self.tags[k] = str(v)
         return self
 
+    @property
+    def duration_s(self) -> Optional[float]:
+        return None if self.end is None else self.end - self.start
+
     def finish(self) -> None:
-        self.end = time.time()
+        self.end = time.monotonic()
         if self._tracer is not None:
             self._tracer._export(self)
 
@@ -94,14 +103,19 @@ class Tracer:
         return span
 
     def add_exporter(self, fn: Callable[[Span], None]) -> None:
-        self._exporters.append(fn)
+        with self._lock:
+            self._exporters.append(fn)
 
     def _export(self, span: Span) -> None:
+        # exporters snapshotted under the same lock that add_exporter
+        # appends under: a concurrent registration must never race the
+        # list while a finishing span iterates it
         with self._lock:
             self._ring.append(span)
             if len(self._ring) > self.RING:
                 del self._ring[:len(self._ring) - self.RING]
-        for fn in self._exporters:
+            exporters = list(self._exporters)
+        for fn in exporters:
             try:
                 fn(span)
             except Exception:  # noqa: BLE001 — exporters must not crash
