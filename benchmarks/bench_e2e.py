@@ -254,16 +254,22 @@ def run_config_processes(config: int, backend: str, secs: float,
 
 def smoke(secs: float = 2.0, clients: int = 2) -> dict:
     """Tier-1 shape (mirrors bench_st --smoke): order real traffic
-    through config 1 with the execution lane ON and OFF, so the ordering
-    path — including the dispatcher↔executor handoff — has a
-    collection-time + runtime guard in CI. Run it under
-    TPUBFT_THREADCHECK=1 to arm the lock-order checker across the
-    handoff (tests/test_bench_e2e_smoke.py does)."""
+    through config 1 with the execution lane ON (speculative — the
+    default), the lane on with speculation OFF, and the legacy inline
+    path, so the ordering path — including the dispatcher↔executor
+    handoff and the speculative seal protocol — has a collection-time +
+    runtime guard in CI. Run it under TPUBFT_THREADCHECK=1 to arm the
+    lock-order checker across the handoff
+    (tests/test_bench_e2e_smoke.py does)."""
     from tpubft.utils.racecheck import get_watchdog
     out = {}
-    for label, lane in (("lane", True), ("inline", False)):
+    for label, overrides in (
+            ("lane", {"execution_lane": True}),
+            ("nospec", {"execution_lane": True,
+                        "speculative_execution": False}),
+            ("inline", {"execution_lane": False})):
         row = run_config(1, "cpu", secs, clients,
-                         extra_overrides={"execution_lane": lane})
+                         extra_overrides=overrides)
         out[label] = {"ok": row["ops"] > 0,
                       "ops": row["ops"],
                       "ops_per_sec": row["ops_per_sec"]}

@@ -112,7 +112,8 @@ def test_fold_stage_math():
             "replied": t0 + 26_000_000}
     stages = SlotTracker.fold(slot)
     assert stages == {"adm_wait": 2.0, "dispatch": 1.0, "prepare": 7.0,
-                      "commit": 5.0, "exec": 10.0, "reply": 1.0}
+                      "commit": 5.0, "exec": 10.0, "reply": 1.0,
+                      "spec_overlap": 0.0}
     # fast path: no prepare quorum — prepare reads 0, commit runs from
     # accept; a primary self-proposal has no admit/handler anchors
     fast = {"accept": t0, "committed": t0 + 4_000_000,
@@ -121,6 +122,37 @@ def test_fold_stage_math():
     assert stages["adm_wait"] == 0.0 and stages["dispatch"] == 0.0
     assert stages["prepare"] == 0.0 and stages["commit"] == 4.0
     assert stages["exec"] == 1.0 and stages["reply"] == 0.5
+    assert stages["spec_overlap"] == 0.0
+    # speculation: spec_overlap = spec enqueue -> commit quorum, but
+    # ONLY when the run sealed; an unsealed record reclaims nothing
+    spec = dict(fast, spec_enq=t0 + 1_000_000,
+                spec_seal=t0 + 4_500_000)
+    stages = SlotTracker.fold(spec)
+    assert stages["spec_overlap"] == 3.0
+    assert stages["commit"] == 4.0          # overlay, not a partition
+    unsealed = dict(fast, spec_enq=t0 + 1_000_000)
+    assert SlotTracker.fold(unsealed)["spec_overlap"] == 0.0
+
+
+def test_spec_abort_clears_overlap():
+    """EV_SPEC_ABORT wipes the slot's speculative anchors: a slot that
+    speculated, aborted, and re-executed post-commit folds with
+    spec_overlap 0 (the combine window was NOT reclaimed)."""
+    flight.reset()
+    tr = flight.slot_tracker()
+    t0 = 1_000_000_000
+    tr.on_event(7, flight.EV_PP_ACCEPT, 5, 0, 0, t0)
+    tr.on_event(7, flight.EV_SPEC_ENQ, 5, 0, 0, t0 + 1_000_000)
+    tr.on_event(7, flight.EV_SPEC_ABORT, 5, 0, 0, t0 + 2_000_000)
+    tr.on_event(7, flight.EV_COMMITTED, 5, 0, 0, t0 + 8_000_000)
+    tr.on_event(7, flight.EV_EXEC_APPLY, 5, 0, 1, t0 + 9_000_000)
+    tr.on_event(7, flight.EV_REPLY, 5, 0, 0, t0 + 9_500_000)
+    rec = tr.recent(rid=7)[-1]
+    assert rec["seq"] == 5 and rec["spec"] is False
+    assert rec["stages_ms"]["spec_overlap"] == 0.0
+    # an abort for an already-folded (or unknown) slot is ignored
+    tr.on_event(7, flight.EV_SPEC_ABORT, 5, 0, 0, t0 + 10_000_000)
+    assert tr.summary(rid=7)["completed"] == 1
 
 
 def test_slot_tracker_folds_recorded_lifecycle():

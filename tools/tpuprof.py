@@ -118,7 +118,10 @@ def timeline(dumps: List[Dict], seq_filter: Optional[int] = None,
             t0 = ""
             if ts and base_epoch is not None:
                 t0 = f"{_epoch_of(dump, min(ts)) - base_epoch:+.3f}s"
-            total = sum(stages.values())
+            # spec_overlap is an OVERLAY of commit (it ran concurrently)
+            # — summing it would overstate the slot's wall clock and
+            # disagree with the recorded total_ms
+            total = sum(stages[s] for s in flight.PIPELINE_STAGES)
             out.append(
                 f"{seq:>6} {label:<28} {t0:>10} "
                 + " ".join(f"{stages[s]:>9.3f}" for s in STAGES)

@@ -387,6 +387,17 @@ class Replica(IReceiver):
         self.m_exec_runs = self.metrics.register_counter("exec_runs")
         self.m_exec_run_slots = self.metrics.register_counter(
             "exec_run_slots")
+        # speculative execution: sealed runs (executed ahead of their
+        # commit certificate and made durable at commit), abort events
+        # (view change / barrier / digest surprise — the overlay was
+        # discarded and the slots re-executed post-commit), and the last
+        # sealed run's reclaimed combine-window overlap
+        self.m_exec_spec_runs = self.metrics.register_counter(
+            "exec_spec_runs")
+        self.m_exec_spec_aborts = self.metrics.register_counter(
+            "exec_spec_aborts")
+        self.m_exec_spec_overlap = self.metrics.register_gauge(
+            "exec_spec_overlap_ms")
         # external-queue backpressure drops (IncomingMsgsStorage bound),
         # refreshed by the status timer — paired with the admission
         # component's counters for the full ingest picture
@@ -462,6 +473,9 @@ class Replica(IReceiver):
             f"replica{self.id}.exec_run_len")
         self._h_exec_commit_ms = self._diag.histogram(
             f"replica{self.id}.exec_commit_ms")
+        # per-sealed-run reclaimed overlap (ms → recorded in µs)
+        self._h_spec_overlap = self._diag.histogram(
+            f"replica{self.id}.exec_spec_overlap_ms")
         self._diag.register_status(
             f"replica{self.id}",
             lambda: (f"view={self.view} last_executed={self.last_executed} "
@@ -486,6 +500,19 @@ class Replica(IReceiver):
         # highest seq handed to the lane (or executed inline via the
         # lane's barrier path); dispatcher-thread only
         self._exec_enqueued = self.last_executed
+        # speculatively-submitted slots whose commit certificate has not
+        # confirmed yet, in seq order; dispatcher-thread only
+        self._spec_inflight: List[int] = []
+        # speculation needs a rollback substrate: the lane, an
+        # accumulation-capable ledger behind the handler (handlers
+        # without one — e.g. the counter app — apply irreversibly during
+        # execution), and the time service off (its agreed-time page
+        # writes bypass the staged pages batch)
+        _bc = getattr(handler, "blockchain", None)
+        self._spec_enabled = bool(
+            cfg.speculative_execution and cfg.execution_lane
+            and not cfg.time_service_enabled
+            and _bc is not None and hasattr(_bc, "begin_accumulation"))
         if cfg.execution_lane:
             from tpubft.consensus.execution import ExecutionLane
             self.exec_lane = ExecutionLane(
@@ -1355,6 +1382,12 @@ class Replica(IReceiver):
             self._send_partial_commit_proof(info)
         self._drain_early_shares(info)
         self._drain_early_certs(info)
+        # fast-path proposals have no prepare round: their combine
+        # window opens HERE, so speculation starts at acceptance (the
+        # slow path waits for prepare-quorum — _accept_prepare_full).
+        # After the early-evidence drains: a slot that just committed
+        # from buffered certs takes the normal path instead.
+        self._pump_speculation()
 
     # ------------------------------------------------------------------
     # slow path: shares → collectors (ReplicaImp.cpp:1373,1399)
@@ -1649,6 +1682,10 @@ class Replica(IReceiver):
         with self._tran() as st:
             st.seq(msg.seq_num).prepare_full = msg.pack()
         self._send_commit_partial(info)
+        # prepare-quorum: 2f+c+1 replicas vouch for this batch while the
+        # commit shares are still combining — the speculation window the
+        # ROADMAP item names (slow path)
+        self._pump_speculation()
 
     def _on_commit_full(self, msg: m.CommitFullMsg) -> None:
         self._handle_full_cert(msg, "commit")
@@ -1841,26 +1878,57 @@ class Replica(IReceiver):
 
     def _pump_execution_lane(self) -> None:
         """Hand every next consecutive committed slot to the lane (or
-        execute barrier batches inline after draining it)."""
+        execute barrier batches inline after draining it). Speculatively
+        submitted slots whose commit just landed are CONFIRMED instead
+        of resubmitted — the lane seals their already-executed run."""
+        # phase 0: confirm commits for speculative submissions, strictly
+        # in seq order (the lane's seal requires the whole run)
+        while self._spec_inflight:
+            nxt = self._spec_inflight[0]
+            info = self.window.peek(nxt)
+            if info is None or info.pre_prepare is None:
+                # the slot vanished without a view-change abort —
+                # defensive: discard the speculation and fall through to
+                # the committed path
+                self._abort_speculation("window-moved")
+                break
+            if not info.committed:
+                break
+            if self.exec_lane.confirm(nxt, info.pre_prepare.digest()):
+                self._spec_inflight.pop(0)
+                info.spec_submitted = False
+                info.exec_submitted = True    # now normal lane work
+            else:
+                # speculated digest is not the committed one (or the
+                # lane lost the slot): discard everything speculative;
+                # the loop below resubmits the committed slots in order
+                self._abort_speculation("digest-mismatch")
+                break
         while True:
             nxt = max(self._exec_enqueued, self.last_executed) + 1
             if not self.window.in_window(nxt):
-                return
+                break
             if self.control.blocks_ordering(nxt):
                 # wedged: the announcement fires once the lane's applied
                 # runs bring last_executed to the stop point (the applier
                 # re-checks); calling here covers the already-drained case
                 self._maybe_announce_restart_ready()
-                return
+                break
             info = self.window.peek(nxt)
             if info is None or not info.committed or info.executed \
-                    or info.exec_submitted:
-                return
+                    or info.exec_submitted or info.spec_submitted:
+                break
             if self._batch_needs_dispatcher(info.pre_prepare):
+                if self._spec_inflight:
+                    # speculative slots ahead of the barrier are still
+                    # awaiting their commits: the barrier cannot run yet
+                    # anyway (last_executed lags) — draining now would
+                    # only waste their speculation
+                    break
                 if not self._drain_exec_lane():
-                    return              # lane stuck; retried on next event
+                    break               # lane stuck; retried on next event
                 if self.last_executed != nxt - 1:
-                    return              # world moved during the drain
+                    break               # world moved during the drain
                 self._execute_one_slot(nxt, info)
                 continue
             info.exec_submitted = True
@@ -1874,6 +1942,72 @@ class Replica(IReceiver):
                 info.exec_submitted = False
                 raise
             self._exec_enqueued = nxt
+        # newly-consecutive prepared/accepted slots may speculate now
+        self._pump_speculation()
+
+    def _pump_speculation(self) -> None:
+        """Hand every next consecutive NOT-yet-committed slot with
+        enough evidence to the lane as SPECULATIVE: prepare-quorum on
+        the slow path, PrePrepare acceptance on the fast paths (whose
+        combine window opens at acceptance). The lane executes it into
+        a never-durable overlay while the threshold shares combine;
+        replies and last_executed stay strictly post-commit (the seal).
+        Barrier batches (INTERNAL/RECONFIG) never speculate."""
+        if not self._spec_enabled or self.exec_lane is None \
+                or not self._running or self.in_view_change:
+            return
+        while True:
+            nxt = max(self._exec_enqueued, self.last_executed) + 1
+            if not self.window.in_window(nxt) \
+                    or self.control.blocks_ordering(nxt):
+                return
+            info = self.window.peek(nxt)
+            if info is None or info.pre_prepare is None or info.executed \
+                    or info.committed or info.exec_submitted \
+                    or info.spec_submitted:
+                return
+            pp = info.pre_prepare
+            if not info.prepared \
+                    and pp.first_path == int(m.CommitPath.SLOW):
+                return              # slow path: wait for prepare-quorum
+            if self._batch_needs_dispatcher(pp):
+                return
+            info.spec_submitted = True
+            flight.record(flight.EV_SPEC_ENQ, seq=nxt, view=self.view)
+            try:
+                self.exec_lane.submit(nxt, pp, speculative=True)
+            except BaseException:
+                info.spec_submitted = False
+                raise
+            self._spec_inflight.append(nxt)
+            self._exec_enqueued = nxt
+
+    def _abort_speculation(self, cause: str) -> None:
+        """Discard all speculative work (dispatcher thread): the lane
+        aborts its open overlay, pending speculative entries (and any
+        committed entries queued BEHIND them — order must hold) come
+        back, and the submission bookkeeping rolls back so the normal
+        committed path re-executes each slot from its committed
+        PrePrepare once the certificate is in hand."""
+        if self.exec_lane is None:
+            return
+        if not self._spec_inflight and not self.exec_lane.speculating:
+            return
+        removed = set(self.exec_lane.abort_speculation())
+        removed.update(self._spec_inflight)
+        self._spec_inflight = []
+        if not removed:
+            return
+        self.m_exec_spec_aborts.inc()
+        log.info("speculation aborted (%s): slots %s re-execute from "
+                 "their committed bodies", cause, sorted(removed))
+        for seq in sorted(removed):
+            flight.record(flight.EV_SPEC_ABORT, seq=seq)
+            info = self.window.peek(seq)
+            if info is not None and not info.executed:
+                info.exec_submitted = False
+                info.spec_submitted = False
+        self._exec_enqueued = min(self._exec_enqueued, min(removed) - 1)
 
     def _drain_exec_lane(self, timeout: Optional[float] = None) -> bool:
         """Dispatcher-side barrier: wait until the lane applied every
@@ -1886,6 +2020,11 @@ class Replica(IReceiver):
         that would time out is independently reported as a stall."""
         if self.exec_lane is None:
             return True
+        # speculative work cannot drain (it waits on commit certificates
+        # only this thread can confirm, and the barrier callers are
+        # about to invalidate it anyway): abort it first — the slots
+        # re-execute from their committed bodies through the normal path
+        self._abort_speculation("drain")
         if timeout is None:
             timeout = self.cfg.execution_drain_timeout_ms / 1e3
         ok = self.exec_lane.drain(timeout)
@@ -1908,6 +2047,14 @@ class Replica(IReceiver):
         self._h_exec_run_len.record(run_len)
         self._h_exec_commit_ms.record(commit_ms)
 
+    def record_spec_seal(self, run_len: int, overlap_ms: float) -> None:
+        """Lane-thread metrics hook: one SPECULATIVE run of `run_len`
+        slots sealed at commit after overlapping `overlap_ms` of its
+        threshold-combine window with execution."""
+        self.m_exec_spec_runs.inc()
+        self.m_exec_spec_overlap.set(int(overlap_ms))
+        self._h_spec_overlap.record(overlap_ms)
+
     def _apply_exec_runs(self, _payload=None, repump: bool = True) -> None:
         """Integrate durably-applied runs (dispatcher thread): advance
         last_executed (only now — after the durable apply), persist the
@@ -1926,6 +2073,7 @@ class Replica(IReceiver):
                     continue
                 info.executed = True
                 info.exec_submitted = False
+                info.spec_submitted = False
                 if getattr(info, "span", None) is not None:
                     info.span.set_tag("committed_path", info.commit_path)
                     info.span.finish()

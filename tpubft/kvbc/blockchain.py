@@ -12,6 +12,8 @@ out of order and link them with integrity checks.
 from __future__ import annotations
 
 import hashlib
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -104,6 +106,36 @@ class _StagedReadView(IDBClient):
         pass
 
 
+class _SpecOverlayView(IDBClient):
+    """Thread-routed staged-read view for SPECULATIVE accumulations: the
+    executor thread that owns the speculation reads its own staged
+    writes through the overlay (read-your-writes), while every OTHER
+    thread — read-only queries on the dispatcher, proof serving, status
+    handlers — keeps reading the committed base. A speculative run may
+    abort; its overlay must never be observable outside the thread that
+    can roll it back."""
+
+    def __init__(self, base: IDBClient, view: "_StagedReadView",
+                 owner_ident: int) -> None:
+        self._base = base
+        self._view = view
+        self._owner = owner_ident
+
+    def get(self, key: bytes, family: bytes = b"default"):
+        if threading.get_ident() == self._owner:
+            return self._view.get(key, family)
+        return self._base.get(key, family)
+
+    def write(self, batch: WriteBatch) -> None:
+        raise BlockchainError("staged read view is read-only")
+
+    def range_iter(self, family: bytes = b"default", start=None, end=None):
+        return self._base.range_iter(family, start, end)
+
+    def close(self) -> None:  # pragma: no cover - never owned
+        pass
+
+
 @dataclass
 class _Accumulation:
     """In-flight execution-run accumulation: the shared mirrored batch
@@ -112,6 +144,13 @@ class _Accumulation:
     base_last: int
     notifications: List[Tuple[int, "cat.BlockUpdates"]] = field(
         default_factory=list)
+    # speculative accumulations stay open across the commit-combine
+    # window: their staged reads are visible only to `owner` (the
+    # executor thread), and link_st_chain DEFERS instead of blocking on
+    # the staging lock they hold (the dispatcher must stay free to
+    # seal or abort them)
+    speculative: bool = False
+    owner: int = 0
 
 
 class BlockStoreMixin:
@@ -151,7 +190,20 @@ class BlockStoreMixin:
     # ---- properties ----
     @property
     def last_block_id(self) -> int:
+        # a SPECULATIVE accumulation's head bump is private to its
+        # executor thread, exactly like its staged reads: every other
+        # thread sees the committed head (a non-owner observing the
+        # speculative head would try to read blocks that may abort)
+        acc = self._accum
+        if acc is not None and acc.speculative \
+                and threading.get_ident() != acc.owner:
+            return acc.base_last
         return self._last
+
+    @property
+    def speculation_open(self) -> bool:
+        acc = self._accum
+        return acc is not None and acc.speculative
 
     @property
     def genesis_block_id(self) -> int:
@@ -195,23 +247,37 @@ class BlockStoreMixin:
         return block_id
 
     # ---- block accumulation (execution-lane run commit) ----
-    def begin_accumulation(self) -> None:
+    def begin_accumulation(self, speculative: bool = False) -> None:
         """Enter accumulation mode: subsequent add_block calls stage into
         ONE shared WriteBatch (committed by end_accumulation) instead of
         one DB write per block. Reads issued while accumulating — the
         handler's read-your-writes during execution, read-only queries —
         observe the staged blocks through the overlay view. Takes the
-        staging lock; the caller MUST reach end/abort_accumulation."""
+        staging lock; the caller MUST reach end/abort_accumulation.
+
+        `speculative=True` (the execution lane's pre-commit runs): the
+        overlay + head bump are visible ONLY to the calling thread — a
+        speculative run may abort, so other threads (read-only queries,
+        proof serving) keep reading the committed base until
+        end_accumulation makes the run durable; link_st_chain defers
+        instead of blocking while the speculation holds the lock."""
         self._staging_mu.acquire()
         try:
             if self._accum is not None:
                 raise BlockchainError("accumulation already active")
             overlay: Dict[bytes, Optional[bytes]] = {}
             view = _StagedReadView(self._db, overlay)
+            install = view
+            if speculative:
+                install = _SpecOverlayView(self._db, view,
+                                           threading.get_ident())
             self._accum = _Accumulation(master=_MirroredBatch(overlay),
-                                        base_last=self._last)
-            self._begin_staged_reads_locked(view)
+                                        base_last=self._last,
+                                        speculative=speculative,
+                                        owner=threading.get_ident())
+            self._begin_staged_reads_locked(install)
         except BaseException:
+            self._accum = None
             self._staging_mu.release()
             raise
 
@@ -305,8 +371,11 @@ class BlockStoreMixin:
 
     def state_digest(self) -> bytes:
         """Digest of the whole chain head — what checkpoint certificates
-        sign (reference: kv_blockchain state hash)."""
-        return self.block_digest(self._last) if self._last else b"\x00" * 32
+        sign (reference: kv_blockchain state hash). Routed head: a
+        non-owner thread asking during an open speculation digests the
+        committed chain, not the private overlay."""
+        last = self.last_block_id
+        return self.block_digest(last) if last else b"\x00" * 32
 
     # ---- pruning (reference: deleteBlocksUntil / pruning_handler) ----
     def delete_blocks_until(self, until_block_id: int) -> int:
@@ -326,10 +395,25 @@ class BlockStoreMixin:
         return self._genesis
 
     # ---- state-transfer staging (reference v4 st_chain) ----
+    # comparisons use the routed `last_block_id`, not `self._last`: the
+    # ST plane runs on the dispatcher, which must not observe a
+    # speculative head bump (it would silently skip staging real blocks
+    # in the speculated range)
+    def _durable_db(self) -> IDBClient:
+        """The writable committed-base DB. While an accumulation is open
+        `self._db` is a read-only staged view; direct writes that are
+        NOT part of the accumulation (ST staging rows — a disjoint
+        keyspace) must target the base. Racy read of `_db` is safe:
+        both branches point at a valid writable base."""
+        db = self._db
+        if isinstance(db, (_StagedReadView, _SpecOverlayView)):
+            return self._base_db
+        return db
+
     def add_raw_st_block(self, block_id: int, raw: bytes) -> None:
-        if block_id <= self._last:
+        if block_id <= self.last_block_id:
             return
-        self._db.put(_bid(block_id), raw, self._F_ST)
+        self._durable_db().put(_bid(block_id), raw, self._F_ST)
 
     def add_raw_st_blocks(self, blocks: Dict[int, bytes]) -> int:
         """Stage a whole verified window of raw blocks in ONE WriteBatch
@@ -337,13 +421,14 @@ class BlockStoreMixin:
         transfer. Returns the number of blocks actually staged."""
         wb = WriteBatch()
         n = 0
+        head = self.last_block_id
         for block_id in sorted(blocks):
-            if block_id <= self._last:
+            if block_id <= head:
                 continue
             wb.put(_bid(block_id), blocks[block_id], self._F_ST)
             n += 1
         if n:
-            self._db.write(wb)
+            self._durable_db().write(wb)
         return n
 
     def has_st_block(self, block_id: int) -> bool:
@@ -361,6 +446,22 @@ class BlockStoreMixin:
     def _end_staged_reads_locked(self) -> None:
         self._db = self._base_db
 
+    def _acquire_staging_for_link(self, timeout: float = 5.0) -> bool:
+        """Take the staging lock for a link segment — or DEFER when the
+        current holder is a speculative accumulation (only the caller's
+        own thread can resolve it; see link_st_chain docstring). A
+        non-speculative holder (a normal execution run mid-commit) is
+        brief: wait it out within `timeout`."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self._staging_mu.acquire(timeout=0.05):
+                return True
+            acc = self._accum       # racy read; deferring is always safe
+            if acc is not None and acc.speculative:
+                return False
+            if time.monotonic() >= deadline:
+                return False
+
     def link_st_chain(self) -> int:
         """Adopt ALL contiguous staged blocks after the head in one
         atomic WriteBatch, re-executing their updates and verifying
@@ -374,7 +475,15 @@ class BlockStoreMixin:
         suffixes). On a bad staged block the verified prefix before it
         still commits, the bad row is dropped (so retries can re-fetch
         from another source instead of wedging on the same bytes), and
-        the error propagates. Returns the new head."""
+        the error propagates. Returns the new head.
+
+        SPECULATION COMPOSITION: a speculative accumulation holds the
+        staging lock for the whole commit-combine window, and only the
+        dispatcher — the thread calling THIS function — can seal or
+        abort it. Blocking here would deadlock, so the lock acquisition
+        defers (returns the current head, nothing linked) whenever the
+        holder is speculative; the ST manager retries on its next
+        tick/window, after the speculation resolved."""
         nxt: Optional[int] = None
         prev_digest = b""
         bad: Optional[int] = None
@@ -399,7 +508,8 @@ class BlockStoreMixin:
             # redirect and must never interleave with linking. The head
             # snapshot happens under the lock too — an accumulation in
             # another thread moves self._db and self._last.
-            self._staging_mu.acquire()
+            if not self._acquire_staging_for_link():
+                break                 # speculation open: defer, no link
             base_db = self._db
             if nxt is None:
                 nxt = self._last + 1
@@ -450,7 +560,8 @@ class BlockStoreMixin:
                 break               # ran out of staged blocks (or hit bad)
         if error is not None:
             raise error
-        return self._last
+        return self.last_block_id   # routed: a deferred link must not
+        # leak the speculation's private head bump to the ST caller
 
 
 class KeyValueBlockchain(BlockStoreMixin):

@@ -427,6 +427,70 @@ def scenario_crash_restart_replay(ctx: ScenarioContext) -> dict:
     return {"recovery_s": round(recovery, 3)}
 
 
+def scenario_spec_abort_equivocation(ctx: ScenarioContext) -> dict:
+    """Equivocating primary vs speculative execution: replica 0 sends
+    two validly-signed forks of every PrePrepare, so honest backups
+    accept (and SPECULATE on) conflicting bodies that can never reach a
+    commit quorum. The view change must abort every speculative run —
+    the overlay is discarded, nothing speculative becomes durable — and
+    each slot re-executes from the body committed in the new view:
+    exactly one write lands in the ledger, the reply ring holds only
+    the committed execution's reply, and the honest replicas converge
+    byte-identically."""
+    from tpubft.apps import skvbc
+    from tpubft.kvbc import KeyValueBlockchain
+    from tpubft.storage.memorydb import MemoryDB
+    from tpubft.testing.cluster import InProcessCluster
+    dbs: dict = {}
+
+    def handler_factory(r):
+        db = dbs.setdefault(r, MemoryDB())
+        return skvbc.SkvbcHandler(
+            KeyValueBlockchain(db, use_device_hashing=False))
+
+    ctx.event("byzantine", replica=0, strategy="equivocate")
+    key = b"spec-%d" % ctx.randint("key", 1, 999)
+    with InProcessCluster(f=1, seed=ctx.cluster_seed(),
+                          cfg_overrides=dict(_FAST_VC),
+                          handler_factory=handler_factory,
+                          byzantine={0: "equivocate"}) as cluster:
+        kv = skvbc.SkvbcClient(cluster.client(0))
+        t0 = time.monotonic()
+        r = kv.write([(key, b"committed")], timeout_ms=60000)
+        recovery = time.monotonic() - t0
+        assert r.success, "cluster never committed past the equivocation"
+        aborts = sum(cluster.metric(i, "counters", "exec_spec_aborts")
+                     for i in (1, 2, 3))
+        assert aborts >= 1, (
+            "no honest replica aborted a speculative run — the "
+            "equivocation either never induced speculation or the "
+            "forked overlay was sealed")
+        for i in (1, 2, 3):
+            assert cluster.replicas[i].view >= 1, \
+                f"replica {i} never left the equivocating primary's view"
+        # no speculative write reached the ledger: each honest chain is
+        # exactly the committed history (1 block for the 1 committed
+        # write — an aborted overlay that leaked would add a block or
+        # skew the digest), and they are byte-identical
+        ctx.wait_until(
+            lambda: len({cluster.handlers[i].blockchain.state_digest()
+                         for i in (1, 2, 3)}) == 1
+            and all(cluster.handlers[i].blockchain.last_block_id == 1
+                    for i in (1, 2, 3)),
+            20, what="honest ledgers converge on the committed fork")
+        # the reply ring holds only the committed execution's reply
+        cid = cluster.client(0).cfg.client_id
+        for i in (1, 2, 3):
+            rep = cluster.replicas[i]
+            info = rep.clients._clients[cid]
+            assert info.replies, f"replica {i} lost the reply record"
+            assert all(rep.clients.was_executed(cid, s)
+                       for s in info.replies)
+        val = kv.read([key])
+        assert val == {key: b"committed"}, val
+    return {"recovery_s": round(recovery, 3), "spec_aborts": aborts}
+
+
 def scenario_crashpoint_exec_post_apply(ctx: ScenarioContext) -> dict:
     """Crashpoint drill 1 — exec.post_apply: a replica dies after the
     run's durable apply but before watermark/bookkeeping. Recovery from
@@ -582,6 +646,10 @@ def smoke_matrix() -> List[ScenarioSpec]:
         ScenarioSpec("breaker-viewchange", scenario_breaker_viewchange,
                      "inproc", 60, tags=("compound", "degraded",
                                          "view-change")),
+        ScenarioSpec("spec-abort-equivocation",
+                     scenario_spec_abort_equivocation,
+                     "inproc", 90, tags=("byzantine", "view-change",
+                                         "speculation")),
         ScenarioSpec("crash-restart-replay", scenario_crash_restart_replay,
                      "inproc", 60, tags=("recovery",)),
         ScenarioSpec("crashpoint-exec-post-apply",

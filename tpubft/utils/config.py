@@ -200,6 +200,21 @@ class ReplicaConfig:
     # the lane's stall threshold, so a drain that would time out is
     # reported (stack dump + verdict) instead of silently eaten.
     execution_drain_timeout_ms: int = 30000
+    # speculative execution ahead of the threshold combine: the
+    # dispatcher hands a slot to the execution lane as SPECULATIVE at
+    # prepare-quorum (slow path) or PrePrepare acceptance (fast paths,
+    # which have no prepare round), so the lane executes it inside an
+    # open, never-durable accumulation while the commit shares are
+    # still combining; the run is sealed (one durable apply) only when
+    # the commit certificate lands with the same digest, and replies +
+    # last_executed stay strictly post-commit. View change, barrier
+    # batches, and state-transfer adoption abort the overlay and the
+    # slot re-executes from its committed body. Requires the execution
+    # lane, an accumulation-capable ledger handler, and the time
+    # service off (its page writes bypass the rollback substrate) —
+    # silently inactive otherwise. False = legacy strictly-post-commit
+    # execution.
+    speculative_execution: bool = True
 
     # retransmissions
     retransmissions_enabled: bool = True

@@ -78,6 +78,9 @@ EV_REPLY = 12           # slot integrated + replies sent (dispatcher)
 EV_DEV_ENTER = 13       # device_section entry (view=kind id, arg=batch)
 EV_DEV_EXIT = 14        # device_section exit (view=kind id, arg=us)
 EV_HEALTH = 15          # health verdict transition (arg=verdict id)
+EV_SPEC_ENQ = 16        # slot handed to the lane SPECULATIVELY
+EV_SPEC_SEAL = 17       # speculative run sealed at commit (arg=run len)
+EV_SPEC_ABORT = 18      # speculation aborted; slot re-executes committed
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -87,15 +90,24 @@ EV_NAMES = {
     EV_COMMITTED: "committed", EV_EXEC_ENQ: "exec_enq",
     EV_EXEC_APPLY: "exec_apply", EV_REPLY: "reply",
     EV_DEV_ENTER: "dev_enter", EV_DEV_EXIT: "dev_exit",
-    EV_HEALTH: "health",
+    EV_HEALTH: "health", EV_SPEC_ENQ: "spec_enqueue",
+    EV_SPEC_SEAL: "spec_seal", EV_SPEC_ABORT: "spec_abort",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
 _SLOT_CODES = frozenset((EV_ADM_ADMIT, EV_PP_DISPATCH, EV_PP_ACCEPT,
                          EV_PREPARED, EV_COMMITTED, EV_EXEC_ENQ,
-                         EV_EXEC_APPLY, EV_REPLY))
+                         EV_EXEC_APPLY, EV_REPLY, EV_SPEC_ENQ,
+                         EV_SPEC_SEAL, EV_SPEC_ABORT))
 
-STAGES = ("adm_wait", "dispatch", "prepare", "commit", "exec", "reply")
+# the six PIPELINE stages partition a slot's lifetime (they sum to the
+# slot total); spec_overlap is an OVERLAY — the slice of the commit
+# window reclaimed by speculative execution — and is excluded from the
+# total (it runs concurrently with `commit`, > 0 only on slots whose
+# speculative run actually sealed)
+PIPELINE_STAGES = ("adm_wait", "dispatch", "prepare", "commit", "exec",
+                   "reply")
+STAGES = PIPELINE_STAGES + ("spec_overlap",)
 
 RING_SIZE = max(64, int(os.environ.get("TPUBFT_FLIGHT_RING", "4096")
                         or 4096))
@@ -238,6 +250,15 @@ class SlotTracker:
         exec      commit -> durable apply (lane thread)
         reply     durable apply -> slot integrated + replies sent
 
+    Plus one OVERLAY stage that runs concurrently with ``commit`` and
+    is excluded from the slot total:
+
+        spec_overlap  speculative enqueue -> commit quorum: the slice
+                  of the combine window the execution lane reclaimed
+                  by running the slot ahead of its commit certificate
+                  (> 0 only when the speculative run sealed; aborted
+                  speculations fold to 0)
+
     A slot finalizes on EV_REPLY (the dispatcher records it for every
     integrated slot, replies or not): its stage durations feed the
     process-wide ``slot.<stage>`` diagnostics histograms and a bounded
@@ -264,7 +285,8 @@ class SlotTracker:
     _FIELD = {EV_ADM_ADMIT: "admit", EV_PP_DISPATCH: "handler",
               EV_PP_ACCEPT: "accept", EV_PREPARED: "prepared",
               EV_COMMITTED: "committed", EV_EXEC_ENQ: "enqueued",
-              EV_EXEC_APPLY: "applied", EV_REPLY: "replied"}
+              EV_EXEC_APPLY: "applied", EV_REPLY: "replied",
+              EV_SPEC_ENQ: "spec_enq", EV_SPEC_SEAL: "spec_seal"}
 
     def on_event(self, rid: int, code: int, seq: int, view: int,
                  arg: int, t_ns: int) -> None:
@@ -272,7 +294,7 @@ class SlotTracker:
         with self._mu:
             slot = self._live.get(key)
             if slot is None:
-                if code == EV_REPLY:
+                if code in (EV_REPLY, EV_SPEC_ABORT):
                     return              # replay of an already-folded slot
                 if len(self._live) >= self.MAX_LIVE:
                     # bounded: evict the oldest live entry (a wedged or
@@ -280,6 +302,13 @@ class SlotTracker:
                     self._live.pop(next(iter(self._live)))
                 slot = self._live[key] = {"rid": rid, "seq": seq,
                                           "view": view}
+            if code == EV_SPEC_ABORT:
+                # the speculation was discarded: this slot re-executes
+                # from its committed body, so no combine window was
+                # reclaimed — spec_overlap must fold to 0
+                slot.pop("spec_enq", None)
+                slot.pop("spec_seal", None)
+                return
             field = self._FIELD[code]
             slot.setdefault(field, t_ns)
             if code == EV_COMMITTED:
@@ -307,6 +336,13 @@ class SlotTracker:
                          slot.get("committed")),
             "exec": ms(slot.get("committed"), slot.get("applied")),
             "reply": ms(slot.get("applied"), slot.get("replied")),
+            # combine-window slice reclaimed by speculation: counted
+            # only when the speculative run actually SEALED (an aborted
+            # or commit-first speculation reclaimed nothing)
+            "spec_overlap": (ms(slot.get("spec_enq"),
+                                slot.get("committed"))
+                             if slot.get("spec_seal") is not None
+                             else 0.0),
         }
 
     def _finalize(self, slot: Dict) -> None:
@@ -314,7 +350,9 @@ class SlotTracker:
         rec = {"rid": slot["rid"], "seq": slot["seq"],
                "view": slot.get("view", 0),
                "path": slot.get("path", "?"),
-               "total_ms": round(sum(stages.values()), 3),
+               "spec": slot.get("spec_seal") is not None,
+               "total_ms": round(sum(stages[s]
+                                     for s in PIPELINE_STAGES), 3),
                "stages_ms": {k: round(v, 3) for k, v in stages.items()}}
         for stage, v_ms in stages.items():
             self._hist(stage).record(v_ms * 1e3)      # histograms in us
