@@ -1,0 +1,297 @@
+"""Fused cross-slot combine plane + adaptive certificate scheme (ISSUE 11).
+
+Covers: verdict equivalence between the fused `combine_batch` overrides
+and the per-job reference loop (BLS and the Ed25519 multisig vector,
+including bad-share identification), the CombineBatcher draining
+collectors across seqnums/kinds with per-slot fault isolation, the
+CertBatchVerifier stable-identity grouping, configure-time resolution of
+the "adaptive" certificate scheme, and cluster-level ledger equivalence
+(fused on vs off, multisig vs BLS threshold)."""
+import time
+
+import pytest
+
+from tpubft.consensus.collectors import (CertBatchVerifier, CombineBatcher,
+                                         CombineResult, ShareCollector)
+from tpubft.crypto.interfaces import Cryptosystem, IThresholdVerifier
+from tpubft.crypto.systems import resolve_threshold_scheme
+
+
+def _jobs(cs, k, digests, bad=()):
+    """Per-digest share dicts from signers 1..k; (digest_idx, signer)
+    pairs in `bad` sign over a wrong digest instead."""
+    signers = {i: cs.create_threshold_signer(i) for i in range(1, k + 1)}
+    jobs = []
+    for j, d in enumerate(digests):
+        shares = {}
+        for i in range(1, k + 1):
+            msg = b"wrong" * 6 + b"xx" if (j, i) in bad else d
+            shares[i] = signers[i].sign_share(msg)
+        jobs.append((d, shares))
+    return jobs
+
+
+# ---------------------------------------------------------------------
+# verdict equivalence: fused combine_batch vs the per-job reference loop
+# ---------------------------------------------------------------------
+
+def test_bls_fused_combine_batch_matches_loop():
+    """The BLS override (segmented combine + one RLC pairing check for
+    the flush + tree identification on failing jobs only) must be
+    verdict- and byte-identical to the per-job loop — including the
+    bad-share list and an undecodable-share job that still clears the
+    threshold."""
+    cs = Cryptosystem("threshold-bls", threshold=3, num_signers=4,
+                      seed=b"fused-bls")
+    v = cs.create_threshold_verifier()
+    digests = [bytes([i]) * 32 for i in range(4)]
+    jobs = _jobs(cs, 3, digests, bad={(2, 2)})
+    # job 3: one undecodable share on top of a full honest quorum —
+    # silently dropped, the job still combines and verifies
+    jobs[3][1][4] = b"\x00" * 48
+    fused = v.combine_batch(jobs)
+    loop = IThresholdVerifier.combine_batch(v, jobs)
+    assert fused == loop
+    oks = [ok for ok, _, _ in fused]
+    assert oks == [True, True, False, True]
+    assert fused[2][2] == [2]          # only the guilty share identified
+    # clean fast path: all jobs verify through the single RLC check
+    clean = _jobs(cs, 3, digests)
+    assert v.combine_batch(clean) == \
+        IThresholdVerifier.combine_batch(v, clean)
+
+
+def test_multisig_tpu_fused_combine_batch_matches_loop():
+    """The device multisig-vector override (every job's shares in one
+    batched ed25519 verify) against the loop, including the dict-order
+    bad-share listing."""
+    from tpubft.crypto.tpu import make_threshold_verifier
+    cs = Cryptosystem("multisig-ed25519", threshold=3, num_signers=4,
+                      seed=b"fused-ms")
+    v = make_threshold_verifier("multisig-ed25519", 3, 4, cs.public_key,
+                                cs.share_public_keys)
+    digests = [bytes([i + 16]) * 32 for i in range(3)]
+    jobs = _jobs(cs, 3, digests, bad={(1, 1), (1, 3)})
+    fused = v.combine_batch(jobs)
+    loop = IThresholdVerifier.combine_batch(v, jobs)
+    assert fused == loop
+    assert [ok for ok, _, _ in fused] == [True, False, True]
+    assert fused[1][2] == [1, 3]
+    # a good job's combined signature is the sorted (signer, sig) vector
+    # and verifies as a certificate
+    assert v.verify(digests[0], fused[0][1])
+    # cross-cert batching: the whole flush in one call, forgery isolated
+    certs = [(digests[0], fused[0][1]), (digests[2], fused[2][1]),
+             (digests[1], fused[0][1])]
+    assert v.verify_batch_certs(certs) == [True, True, False]
+    # verdict-iterator alignment: a MULTI-bad-share cert FIRST in the
+    # flush must not shift its unconsumed verdicts onto later certs
+    # (short-circuiting all() left the shared iterator mid-cert)
+    two_bad = bytearray(fused[0][1])
+    two_bad[10] ^= 0xFF                 # corrupt share 1's sig bytes
+    two_bad[80] ^= 0xFF                 # corrupt share 2's sig bytes
+    first_bad = [(digests[0], bytes(two_bad)), (digests[2], fused[2][1]),
+                 (digests[0], fused[0][1])]
+    assert v.verify_batch_certs(first_bad) == [False, True, True]
+
+
+# ---------------------------------------------------------------------
+# CombineBatcher: cross-slot drain, per-slot fault isolation
+# ---------------------------------------------------------------------
+
+def test_combine_batcher_drains_across_slots_and_kinds():
+    """One flush combines collectors from different seqnums AND kinds;
+    a byzantine share fails only its own CombineResult — sibling slots
+    in the same batch still produce certificates."""
+    cs = Cryptosystem("multisig-ed25519", threshold=3, num_signers=4,
+                      seed=b"batcher")
+    v = cs.create_threshold_verifier()
+    results = []
+    flushes = []
+    cb = CombineBatcher(results.append, flush_us=20000, max_batch=64,
+                        on_flush=flushes.append)
+    try:
+        cols = []
+        for seq, kind in ((1, "prepare"), (1, "commit"), (2, "prepare"),
+                          (3, "prepare")):
+            d = bytes([seq]) * 16 + kind.encode().ljust(16, b".")
+            col = ShareCollector(0, seq, kind, d, v)
+            for r in range(3):             # 0-based replica ids
+                col.add_share(r, cs.create_threshold_signer(r + 1)
+                              .sign_share(d))
+            cols.append(col)
+        # poison ONE share of seq 2's collector
+        cols[2].shares[2] = b"\x11" * 64
+        for col in cols:
+            cb.submit(col, dict(col.shares))
+        deadline = time.monotonic() + 10
+        while len(results) < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        cb.stop()
+    assert len(results) == 4
+    by_key = {(r.seq_num, r.kind): r for r in results}
+    assert by_key[(1, "prepare")].ok and by_key[(1, "commit")].ok \
+        and by_key[(3, "prepare")].ok
+    guilty = by_key[(2, "prepare")]
+    assert not guilty.ok and guilty.bad_shares == [2]
+    for r in results:
+        assert r.collector is not None
+        if r.ok:
+            assert v.verify(r.collector.digest, r.combined_sig)
+    # the whole submission drained as one flush (metrics sensor)
+    assert flushes and flushes[0] == 4
+
+
+def test_combine_batcher_stop_resolves_pending():
+    """A stopped batcher must resolve queued jobs as combine failures
+    (carrying the collector) so the dispatcher-side state flip can
+    still clear job_launched."""
+    cs = Cryptosystem("multisig-ed25519", threshold=2, num_signers=3,
+                      seed=b"drop")
+    v = cs.create_threshold_verifier()
+    results = []
+    cb = CombineBatcher(results.append, flush_us=10_000_000,
+                        max_batch=1024)
+    col = ShareCollector(0, 9, "commit", b"d" * 32, v)
+    cb.stop()
+    cb.submit(col, {})
+    assert len(results) == 1
+    res = results[0]
+    assert not res.ok and res.collector is col
+    col.job_launched = True
+    col.on_result(res)
+    assert not col.job_launched and col.combined is None
+
+
+def test_cert_batcher_never_comingles_verifiers():
+    """Two verifier objects in one flush: each cert verifies against
+    its own verifier (the stable object key), so cluster A's cert must
+    fail under cluster B even when batched together."""
+    a = Cryptosystem("multisig-ed25519", 2, 3, seed=b"A")
+    b = Cryptosystem("multisig-ed25519", 2, 3, seed=b"B")
+    va, vb = a.create_threshold_verifier(), b.create_threshold_verifier()
+    d = b"c" * 32
+
+    def cert(cs, v):
+        acc = v.new_accumulator(False)
+        acc.set_expected_digest(d)
+        for i in (1, 2):
+            acc.add(i, cs.create_threshold_signer(i).sign_share(d))
+        return acc.get_full_signed_data()
+
+    ca, cb_ = cert(a, va), cert(b, vb)
+    verdicts = {}
+    bv = CertBatchVerifier(lambda cookie, ok: verdicts.update({cookie: ok}),
+                           flush_us=1)
+    try:
+        bv._drain([(va, d, ca, "a-own"), (vb, d, cb_, "b-own"),
+                   (vb, d, ca, "a-under-b")])
+    finally:
+        bv.stop()
+    assert verdicts == {"a-own": True, "b-own": True, "a-under-b": False}
+
+
+# ---------------------------------------------------------------------
+# adaptive certificate scheme (configure-time resolution)
+# ---------------------------------------------------------------------
+
+def test_adaptive_scheme_resolves_by_cluster_size():
+    assert resolve_threshold_scheme("adaptive", 4) == "multisig-ed25519"
+    assert resolve_threshold_scheme("adaptive", 7) == "multisig-ed25519"
+    assert resolve_threshold_scheme("adaptive", 16) == "threshold-bls"
+    assert resolve_threshold_scheme("adaptive", 31) == "threshold-bls"
+    # explicit crossover knob wins over the measured default
+    assert resolve_threshold_scheme("adaptive", 4, crossover_n=2) \
+        == "threshold-bls"
+    # concrete schemes pass through untouched
+    assert resolve_threshold_scheme("threshold-bls", 4) == "threshold-bls"
+    assert resolve_threshold_scheme("multisig-ed25519", 100) \
+        == "multisig-ed25519"
+    # "adaptive" must never reach the cryptosystem registry unresolved
+    with pytest.raises(ValueError):
+        Cryptosystem("adaptive", 3, 4, seed=b"x")
+
+
+def test_cluster_keys_resolve_adaptive_at_keygen():
+    from tpubft.consensus.keys import ClusterKeys
+    from tpubft.utils.config import ReplicaConfig
+    cfg = ReplicaConfig(f_val=1, threshold_scheme="adaptive")
+    ck = ClusterKeys.generate(cfg, num_clients=1, seed=b"adapt")
+    assert ck.threshold_scheme == "multisig-ed25519"        # n=4
+    assert ck.slow_path_system.type_name == "multisig-ed25519"
+    cfg2 = ReplicaConfig(f_val=1, threshold_scheme="adaptive",
+                         threshold_scheme_crossover_n=4)
+    ck2 = ClusterKeys.generate(cfg2, num_clients=1, seed=b"adapt")
+    assert ck2.threshold_scheme == "threshold-bls"
+    assert ck2.optimistic_system.type_name == "threshold-bls"
+
+
+# ---------------------------------------------------------------------
+# cluster-level equivalence (the ISSUE 11 acceptance bars)
+# ---------------------------------------------------------------------
+
+def _wait(pred, timeout=25.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _run_workload(scheme: str, fused: bool, n_writes: int = 5):
+    from tpubft.apps import skvbc
+    from tpubft.kvbc import KeyValueBlockchain
+    from tpubft.storage.memorydb import MemoryDB
+    from tpubft.testing.cluster import InProcessCluster
+
+    def handler_factory(_r):
+        return skvbc.SkvbcHandler(
+            KeyValueBlockchain(MemoryDB(), use_device_hashing=False))
+
+    overrides = dict(threshold_scheme=scheme, fused_combine=fused)
+    with InProcessCluster(f=1, handler_factory=handler_factory,
+                          cfg_overrides=overrides) as cluster:
+        cl = cluster.client(0)
+        cl._req_seq = 1_000_000        # comparable reply-ring pages
+        kv = skvbc.SkvbcClient(cl)
+        for i in range(n_writes):
+            assert kv.write([(b"k%d" % i, b"v%d" % i)],
+                            timeout_ms=30000).success
+        assert _wait(lambda: all(
+            cluster.handlers[r].blockchain.last_block_id == n_writes
+            for r in range(4)))
+        bc = cluster.handlers[0].blockchain
+        return {
+            "state_digest": bc.state_digest(),
+            "blocks": [bc.get_raw_block(i)
+                       for i in range(1, n_writes + 1)],
+            "combine_batches":
+                cluster.metric(0, "counters", "combine_batches"),
+        }
+
+
+def test_fused_on_off_ledger_equivalence():
+    """The same workload with the fused combine plane on vs off ends in
+    byte-identical ledgers, and the fused run actually used the
+    batcher."""
+    on = _run_workload("multisig-ed25519", fused=True)
+    off = _run_workload("multisig-ed25519", fused=False)
+    assert on["state_digest"] == off["state_digest"]
+    assert on["blocks"] == off["blocks"]
+    assert on["combine_batches"] > 0
+    assert off["combine_batches"] == 0
+
+
+@pytest.mark.slow
+def test_scheme_equivalence_byte_identical_ledgers():
+    """A cluster certifying with the Ed25519 multisig vector and one
+    with BLS threshold order the same workload into byte-identical
+    ledgers — certificates are consensus metadata, never ledger state,
+    so the adaptive scheme can flip per deployment without a state
+    migration."""
+    ms = _run_workload("multisig-ed25519", fused=True)
+    bls = _run_workload("threshold-bls", fused=True)
+    assert ms["state_digest"] == bls["state_digest"]
+    assert ms["blocks"] == bls["blocks"]

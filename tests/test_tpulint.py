@@ -152,6 +152,60 @@ _RACE_SEEDS = {
 }
 
 
+_FOREIGN = """\
+class Collector:
+    def __init__(self):
+        self.launched = False
+        self.shares = {}
+
+    def arm(self):
+        self.launched = True       # line 7: dispatcher-side writer
+
+class Pool:
+    def launch(self, c: Collector):
+        c.arm()
+
+    def _job(self, c: Collector):
+        c.launched = False         # line 14: worker-side foreign store
+"""
+
+_FOREIGN_SEEDS = {
+    ("tpubft/fix.py", "Pool", "launch"): frozenset({"dispatcher"}),
+    ("tpubft/fix.py", "Pool", "_job"): frozenset({"sig_combine"}),
+}
+
+
+def test_foreign_store_fixture_caught(fixture_tree):
+    """The CollectorPool._run seam: a worker-role function storing
+    through a class-annotated parameter whose attribute the dispatcher
+    role also writes. Neither function alone is multi-role, so the
+    self-store check is blind to it — the foreign-store check must
+    catch it."""
+    root = fixture_tree(_FOREIGN, _FOREIGN_SEEDS)
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles", "static-race"])
+    race = [f for f in findings if f.pass_id == "static-race"]
+    assert len(race) == 1, [f.render() for f in findings]
+    f = race[0]
+    assert (f.path, f.line) == ("tpubft/fix.py", 14), f.render()
+    assert f.key == "tpubft/fix.py:Pool._job:c.launched:foreign"
+    assert "dispatcher" in f.message and "sig_combine" in f.message
+
+
+def test_foreign_store_single_writer_role_clean(fixture_tree):
+    """Same shape but the store routes through the owning role (the
+    worker only reads; the dispatcher flips state on verdict re-entry):
+    all writers share one role, so no finding."""
+    src = _FOREIGN.replace("c.launched = False         # line 14: "
+                           "worker-side foreign store",
+                           "_ = c.launched             # read-only")
+    root = fixture_tree(src, _FOREIGN_SEEDS)
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles", "static-race"])
+    assert [f for f in findings if f.pass_id == "static-race"] == [], \
+        [f.render() for f in findings]
+
+
 def test_race_fixture_reports_file_line_roles(fixture_tree):
     root = fixture_tree(_RACY, _RACE_SEEDS)
     findings, _, _ = analyze(root,

@@ -5,13 +5,25 @@ thread roles must be lexically enclosed in a `with self.<lock>:` region
 whose lock attribute was constructed by `racecheck.make_lock` /
 `make_condition` (lock attribution is by AST region — the static
 counterpart of the lock-discipline property TSan approximates with
-happens-before at runtime). Two finding shapes:
+happens-before at runtime). Three finding shapes:
 
-  * unguarded  — no lock region encloses the store at all;
-  * raw-lock   — a region encloses it, but the lock is a bare
+  * unguarded     — no lock region encloses the store at all;
+  * raw-lock      — a region encloses it, but the lock is a bare
     `threading.Lock/RLock/Condition`, invisible to the runtime
     lock-order graph (`TPUBFT_THREADCHECK`): migrate it to
-    `make_lock`/`make_condition`.
+    `make_lock`/`make_condition`;
+  * foreign-store — an unguarded store THROUGH A PARAMETER annotated
+    with a repo class (`def _run(self, collector: ShareCollector): ...
+    collector.attr = v`) where the WRITERS of that class attribute —
+    its own methods' self-stores plus every annotated-parameter store,
+    across the whole program — span two or more thread roles. The
+    self-store check cannot see these (the store isn't on `self`, and
+    each writing function may be single-role), but two single-role
+    writers on different threads are exactly the CollectorPool._run
+    seam: the sig-combine worker flipped `collector.job_launched` while
+    the dispatcher (the attribute's other writer) owned it. Stores into
+    another role's object must route through the owning role (post a
+    message back) or take a registered lock.
 
 Deliberate under-approximations (documented in docs/OPERATIONS.md):
 stores in `__init__`/`__post_init__` precede thread start
@@ -26,7 +38,7 @@ case).
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.tpulint.core import Finding
 from tools.tpulint.program import (ClassInfo, FuncInfo, LockInfo,
@@ -123,10 +135,104 @@ def _scan(prog: Program, mi: ModuleInfo, ci: ClassInfo, fi: FuncInfo,
         _scan(prog, mi, ci, fi, roles, child, held, findings)
 
 
+def _attr_writer_roles(prog: Program, roles_map
+                       ) -> Dict[Tuple[str, str, str], Set[str]]:
+    """(class module, class name, attr) -> union of thread roles that
+    STORE the attribute anywhere in the program: the class's own
+    methods' self-stores plus stores through class-annotated parameters.
+    Lifecycle methods (EXEMPT_METHODS, `*_locked`) don't count — their
+    writes happen-before/behind the threading they bracket."""
+    from tools.tpulint.program import walk_body
+    out: Dict[Tuple[str, str, str], Set[str]] = {}
+    for fid, fi in prog.funcs.items():
+        roles_f = roles_map.get(fid, set())
+        if not roles_f:
+            continue
+        leaf = fi.name.rsplit(".", 1)[-1]
+        if leaf in EXEMPT_METHODS or leaf.endswith("_locked"):
+            continue
+        mi = prog.modules[fi.module]
+        ptypes = prog._param_types(mi, fi)
+        for node in walk_body(fi.node):
+            for t in _store_targets(node):
+                if fi.cls is not None:
+                    out.setdefault((fi.module, fi.cls, t.attr),
+                                   set()).update(roles_f)
+            for t in _param_store_targets(node, ptypes):
+                owner = ptypes[t.value.id]
+                out.setdefault((owner.module, owner.name, t.attr),
+                               set()).update(roles_f)
+    return out
+
+
+def _foreign_scan(prog: Program, mi: ModuleInfo, fi: FuncInfo,
+                  roles_f: Set[str], ptypes: Dict[str, ClassInfo],
+                  writers, node: ast.AST,
+                  held: List[LockInfo], findings: List[Finding]) -> None:
+    ci = mi.classes.get(fi.cls) if fi.cls else None
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(child, ast.With):
+            locks = _with_locks(prog, mi, ci, child)
+            held.extend(locks)
+            _foreign_scan(prog, mi, fi, roles_f, ptypes, writers,
+                          child, held, findings)
+            del held[len(held) - len(locks):]
+            continue
+        for t in _param_store_targets(child, ptypes):
+            base, attr = t.value.id, t.attr
+            owner = ptypes[base]
+            combined = roles_f | writers.get(
+                (owner.module, owner.name, attr), set())
+            if len(combined) < 2:
+                continue
+            if any(li.registered for li in held):
+                continue               # guarded by an instrumented lock
+            findings.append(Finding(
+                PASS_ID, fi.module, child.lineno,
+                f"{fi.module}:{fi.qualname}:{base}.{attr}:foreign",
+                f"{_roles_label(sorted(combined))} {base}.{attr} — "
+                f"foreign store in {fi.qualname}: {owner.name}.{attr} "
+                f"has writers on roles {sorted(combined)}; route the "
+                f"write through the owning role (post a message back) "
+                f"or guard every writer with a racecheck.make_lock "
+                f"region"))
+        _foreign_scan(prog, mi, fi, roles_f, ptypes, writers,
+                      child, held, findings)
+
+
+def _param_store_targets(node: ast.AST, ptypes: Dict[str, ClassInfo]
+                         ) -> List[ast.Attribute]:
+    """`<param>.<attr>` targets of an assignment where <param> has a
+    repo-class annotation."""
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if isinstance(node, ast.AnnAssign) and node.value is None:
+            return []
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    out: List[ast.Attribute] = []
+    stack = targets
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id != "self" and t.value.id in ptypes:
+            out.append(t)
+    return out
+
+
 def run(ctx) -> List[Finding]:
     prog: Program = ctx.program
     roles_map, _ = ctx.ensure_roles()
     findings: List[Finding] = []
+    writers = _attr_writer_roles(prog, roles_map)
     for fid in sorted(roles_map, key=fid_key):
         roles = roles_map[fid]
         if len(roles) < 2:
@@ -142,4 +248,17 @@ def run(ctx) -> List[Finding]:
         if ci is None:
             continue
         _scan(prog, mi, ci, fi, sorted(roles), fi.node, [], findings)
+    # foreign-store sweep: every function (roled or not) storing
+    # through a class-annotated parameter
+    for fid in sorted(prog.funcs, key=fid_key):
+        fi = prog.funcs[fid]
+        leaf = fi.name.rsplit(".", 1)[-1]
+        if leaf in EXEMPT_METHODS or leaf.endswith("_locked"):
+            continue
+        mi = prog.modules[fi.module]
+        ptypes = prog._param_types(mi, fi)
+        if not ptypes:
+            continue
+        _foreign_scan(prog, mi, fi, roles_map.get(fid, set()), ptypes,
+                      writers, fi.node, [], findings)
     return findings

@@ -44,6 +44,17 @@ THREAD_ROLES: Dict[FuncId, FrozenSet[str]] = {
         frozenset({"watchdog"}),
     ("tpubft/utils/batcher.py", "FlushBatcher", "_run"):
         frozenset({"batcher"}),
+    # sig-combine worker pool (ThreadPoolExecutor — invisible to the
+    # threading.Thread audit, seeded directly) and the FlushBatcher
+    # drain callbacks it hands off to (callable-attribute seam like
+    # API_SEEDS): the combine plane's cross-thread surface against the
+    # dispatcher-owned ShareCollector state
+    ("tpubft/consensus/collectors.py", "CollectorPool", "_run"):
+        frozenset({"sig_combine"}),
+    ("tpubft/consensus/collectors.py", "CombineBatcher", "_drain"):
+        frozenset({"batcher"}),
+    ("tpubft/consensus/collectors.py", "CertBatchVerifier", "_drain"):
+        frozenset({"batcher"}),
     ("tpubft/utils/metrics.py", "UdpMetricsServer", "_run"):
         frozenset({"metrics"}),
     # transports

@@ -8,19 +8,34 @@ on a worker pool; the verdict re-enters the dispatcher as an internal msg.
 On combined-verification failure the job re-verifies share-by-share to
 identify bad shares (:363-401 strategy: optimistic accumulate first).
 
-TPU-first delta: the worker drains *all* due collectors in one go, so share
-verification across collectors lands in one `verify_batch` call — with the
-BLS backend that is one Lagrange+MSM kernel dispatch per combine and one
-vmapped pairing batch per identification pass.
+TPU-first delta — the fused combine plane: the reference launches one
+combine job per slot, so a pipelined replica pays one Lagrange+MSM
+device dispatch per seqnum ("The Latency Price of Threshold
+Cryptosystems", arXiv 2407.12172, is exactly this tax). Here due
+collectors drain through a `FlushBatcher` (the same discipline as
+CertBatchVerifier) into ONE `IThresholdVerifier.combine_batch` call per
+verifier per flush — with the BLS backend that is one segmented
+multi-MSM kernel launch for every slot's combine plus one RLC'd pairing
+check for every combined signature of the flush; with the Ed25519
+multisig vector it is one batched verify kernel call. One slot's bad
+share fails only its own CombineResult; sibling slots in the same flush
+still land.
+
+Thread discipline (tpulint static-race pass, sig_combine/batcher roles):
+ShareCollector state is SINGLE-WRITER from the dispatcher. `maybe_launch`
+snapshots the share set dispatcher-side; combine workers and the flush
+batcher only read their snapshot and post a CombineResult carrying the
+collector; the dispatcher applies the verdict's state flip
+(`ShareCollector.on_result`) when the internal msg re-enters.
 """
 from __future__ import annotations
 
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpubft.crypto.interfaces import IThresholdVerifier
+from tpubft.utils import flight
 
 
 @dataclass
@@ -31,6 +46,11 @@ class CombineResult:
     ok: bool
     combined_sig: bytes = b""
     bad_shares: List[int] = field(default_factory=list)
+    # the collector this verdict belongs to: the dispatcher flips its
+    # job_launched/combined state on re-entry (workers must not — the
+    # dispatcher reads those fields in ready_for_job)
+    collector: Optional["ShareCollector"] = field(default=None,
+                                                 compare=False, repr=False)
 
 
 class ShareCollector:
@@ -67,23 +87,30 @@ class ShareCollector:
                 and self.combined is None
                 and frozenset(self.shares) != self.last_attempt)
 
+    def on_result(self, res: CombineResult) -> None:
+        """Dispatcher-side verdict application: the ONLY place collector
+        state flips after launch (the combine ran on a worker/batcher
+        thread over a snapshot; writing here keeps every field
+        single-writer from the dispatcher)."""
+        self.job_launched = False
+        if res.ok:
+            self.combined = res.combined_sig
+
     def combine_and_verify(self, shares: Dict[int, bytes]) -> CombineResult:
         """The background job body (reference SignaturesProcessingJob
         ::execute) over a SNAPSHOT of the shares (the dispatcher thread
         keeps mutating self.shares): accumulate WITHOUT share
         verification, combine, verify the combined signature; on failure
-        verify shares individually."""
-        acc = self.verifier.new_accumulator(with_share_verification=False)
-        acc.set_expected_digest(self.digest)
-        for sid, share in shares.items():
-            acc.add(sid, share)
-        combined = acc.get_full_signed_data()
-        if self.verifier.verify(self.digest, combined):
+        verify shares individually. Delegates to the verifier's
+        combine_batch so the per-slot and fused paths share one
+        verdict-producing code path."""
+        ((ok, combined, bad),) = self.verifier.combine_batch(
+            [(self.digest, shares)])
+        if ok:
             return CombineResult(self.view, self.seq_num, self.kind, True,
-                                 combined)
-        bad = acc.identify_bad_shares()
+                                 combined, collector=self)
         return CombineResult(self.view, self.seq_num, self.kind, False,
-                             bad_shares=bad)
+                             bad_shares=bad, collector=self)
 
 
 class CertBatchVerifier:
@@ -111,11 +138,13 @@ class CertBatchVerifier:
         self._batcher.submit((verifier, digest, sig, cookie))
 
     def _drain(self, batch) -> None:
-        by_verifier: Dict[int, List[int]] = {}
+        # keyed by the verifier OBJECT, not id(): the dict key holds the
+        # verifier alive for the drain, so a GC'd-and-recycled id can
+        # never co-mingle two verifiers' certs in one aggregated check
+        by_verifier: Dict[object, List[int]] = {}
         for i, (v, _, _, _) in enumerate(batch):
-            by_verifier.setdefault(id(v), []).append(i)
-        for idxs in by_verifier.values():
-            verifier = batch[idxs[0]][0]
+            by_verifier.setdefault(v, []).append(i)
+        for verifier, idxs in by_verifier.items():
             items = [(batch[i][1], batch[i][2]) for i in idxs]
             try:
                 verdicts = verifier.verify_batch_certs(items)
@@ -138,17 +167,108 @@ class CertBatchVerifier:
         self._batcher.stop()
 
 
+class CombineBatcher:
+    """Cross-slot fused combine plane: due collectors from ALL seqnums
+    and kinds flush together, one `combine_batch` call per verifier per
+    flush (BLS: one segmented multi-MSM launch + one RLC pairing check
+    for the whole batch). Same FlushBatcher wake discipline as
+    CertBatchVerifier, so pipelined slots arriving within the flush
+    window amortize the device dispatch instead of paying it per slot."""
+
+    def __init__(self, post: Callable[[CombineResult], None],
+                 flush_us: int = 300, max_batch: int = 64,
+                 on_flush: Optional[Callable[[int], None]] = None,
+                 rid: int = -1):
+        from tpubft.utils.batcher import FlushBatcher
+        self._post = post              # CombineResult -> None
+        self._on_flush = on_flush      # batch-size metrics sink
+        self._rid = rid                # flight attribution (multi-replica
+        self._rid_seeded = False       # processes share one recorder)
+        self._batcher = FlushBatcher(
+            self._drain, batch_size=max_batch, flush_us=flush_us,
+            on_drop=self._drop, name="combine-batch")
+
+    def submit(self, collector: ShareCollector,
+               snapshot: Dict[int, bytes]) -> None:
+        """Dispatcher-side: `snapshot` was taken under the dispatcher's
+        ownership of collector.shares; the drain only reads it."""
+        self._batcher.submit((collector, snapshot))
+
+    def _drop(self, item: Tuple[ShareCollector, Dict[int, bytes]]) -> None:
+        # stopped batcher: resolve as a combine failure so the
+        # dispatcher-side state flip still happens and no collector is
+        # wedged with job_launched forever
+        c, _ = item
+        self._post(CombineResult(c.view, c.seq_num, c.kind, False,
+                                 collector=c))
+
+    def _drain(self, batch) -> None:
+        if not self._rid_seeded:
+            # the drain owns its FlushBatcher thread: seed the replica id
+            # once so combine_flush events attribute correctly (same
+            # convention as the dispatcher/exec/admission loop entries)
+            flight.set_thread_rid(self._rid)
+            self._rid_seeded = True
+        flight.record(flight.EV_COMBINE_FLUSH, arg=len(batch))
+        # group by verifier object (stable identity — see
+        # CertBatchVerifier._drain): slow-path prepare/commit share one
+        # verifier, fast paths their own, so one flush usually makes
+        # 1-2 combine_batch calls
+        by_verifier: Dict[object, List[int]] = {}
+        for i, (c, _snap) in enumerate(batch):
+            by_verifier.setdefault(c.verifier, []).append(i)
+        for verifier, idxs in by_verifier.items():
+            jobs = [(batch[i][0].digest, batch[i][1]) for i in idxs]
+            try:
+                results = verifier.combine_batch(jobs)
+                if len(results) != len(jobs):
+                    # contract violation must fail LOUD into the per-job
+                    # failure path — a silently zip-truncated tail would
+                    # leave collectors with job_launched wedged True
+                    raise ValueError(
+                        f"combine_batch returned {len(results)} results "
+                        f"for {len(jobs)} jobs")
+            except Exception:  # noqa: BLE001 — whole-group failure =
+                # per-job combine failure (no bad-share knowledge)
+                from tpubft.utils.logging import get_logger
+                get_logger("collectors").exception(
+                    "fused combine raised (%d jobs)", len(jobs))
+                results = [(False, b"", [])] * len(jobs)
+            for i, (ok, sig, bad) in zip(idxs, results):
+                c = batch[i][0]
+                self._post(CombineResult(c.view, c.seq_num, c.kind,
+                                         bool(ok), sig if ok else b"",
+                                         list(bad), collector=c))
+        if self._on_flush is not None:
+            try:
+                self._on_flush(len(batch))
+            except Exception:  # noqa: BLE001 — metrics must not kill
+                pass           # the combine plane
+
+    def stop(self) -> None:
+        self._batcher.stop()
+
+
 class CollectorPool:
-    """Owns the worker pool; launches combine jobs and posts results back
-    via `post_result` (the replica wires this to push_internal). The
-    reference's SimpleThreadPool + internal-msg round trip."""
+    """Owns the combine plane; launches combine work and posts results
+    back via `post_result` (the replica wires this to push_internal).
+    The reference's SimpleThreadPool + internal-msg round trip, with the
+    per-slot jobs replaced by the fused CombineBatcher (fused=False
+    keeps the one-job-per-collector control path for A/B runs)."""
 
     def __init__(self, post_result: Callable[[CombineResult], None],
-                 workers: int = 2):
+                 workers: int = 2, fused: bool = True,
+                 flush_us: int = 300, max_batch: int = 64,
+                 on_flush: Optional[Callable[[int], None]] = None,
+                 rid: int = -1):
         self._post = post_result
         self._pool = ThreadPoolExecutor(max_workers=workers,
                                         thread_name_prefix="sig-combine")
         self._closed = False
+        self._combiner = (CombineBatcher(post_result, flush_us=flush_us,
+                                         max_batch=max_batch,
+                                         on_flush=on_flush, rid=rid)
+                          if fused else None)
 
     def submit(self, fn: Callable[[], None]) -> bool:
         """Run an arbitrary background verification job on the pool (the
@@ -161,13 +281,18 @@ class CollectorPool:
 
     def maybe_launch(self, collector: ShareCollector) -> bool:
         """Called on the dispatcher thread only; snapshots the share set
-        so the job never races dispatcher-side mutations."""
+        so the job never races dispatcher-side mutations. The result's
+        state flip happens dispatcher-side in ShareCollector.on_result
+        when the verdict re-enters as an internal msg."""
         if self._closed or not collector.ready_for_job():
             return False
         collector.job_launched = True
         snapshot = dict(collector.shares)
         collector.last_attempt = frozenset(snapshot)
-        self._pool.submit(self._run, collector, snapshot)
+        if self._combiner is not None:
+            self._combiner.submit(collector, snapshot)
+        else:
+            self._pool.submit(self._run, collector, snapshot)
         return True
 
     def _run(self, collector: ShareCollector, shares) -> None:
@@ -179,12 +304,12 @@ class CollectorPool:
                 "combine job raised (kind=%s seq=%d)", collector.kind,
                 collector.seq_num)
             result = CombineResult(collector.view, collector.seq_num,
-                                   collector.kind, False)
-        if result.ok:
-            collector.combined = result.combined_sig
-        collector.job_launched = False
+                                   collector.kind, False,
+                                   collector=collector)
         self._post(result)
 
     def shutdown(self) -> None:
         self._closed = True
+        if self._combiner is not None:
+            self._combiner.stop()
         self._pool.shutdown(wait=False)

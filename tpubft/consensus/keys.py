@@ -64,7 +64,15 @@ class ClusterKeys:
         """Generate the full cluster's material (test/keygen-tool path —
         the reference's GenerateConcordKeys writes one file per replica)."""
         n, f, c = cfg.n_val, cfg.f_val, cfg.c_val
-        ck = cls(n=n, f=f, c=c, threshold_scheme=cfg.threshold_scheme,
+        # "adaptive" resolves HERE, once, from cluster size: the scheme
+        # is baked into the generated key material, so every replica and
+        # every carried certificate (view change, state transfer) agrees
+        # by construction (crypto/systems.resolve_threshold_scheme)
+        from tpubft.crypto.systems import resolve_threshold_scheme
+        scheme = resolve_threshold_scheme(
+            cfg.threshold_scheme, n,
+            getattr(cfg, "threshold_scheme_crossover_n", 0))
+        ck = cls(n=n, f=f, c=c, threshold_scheme=scheme,
                  replica_sig_scheme=cfg.replica_sig_scheme,
                  client_sig_scheme=cfg.client_sig_scheme)
         for r in range(n):
@@ -86,7 +94,6 @@ class ClusterKeys:
                         seed=_derive_seed(seed, "operator", operator_id))
         ck.client_pubkeys[operator_id] = s.public_bytes()
         ck.operator_id = operator_id
-        scheme = cfg.threshold_scheme
         ck.slow_path_system = Cryptosystem(
             scheme, 2 * f + c + 1, n, seed=_derive_seed(seed, "slow"))
         ck.commit_path_system = Cryptosystem(

@@ -285,8 +285,17 @@ class Replica(IReceiver):
         # beat age is the consensus thread's tick age
         self.dispatcher.add_timer(0.2,
                                   lambda: self.health.beat("dispatcher"))
+        # fused cross-slot combine plane: due collectors across seqnums
+        # and kinds drain into ONE combine_batch call per flush (BLS:
+        # one segmented multi-MSM launch + one RLC pairing check for
+        # the whole batch) instead of one combine job per slot
         self.collector_pool = CollectorPool(
-            lambda res: self.incoming.push_internal("combine", res))
+            lambda res: self.incoming.push_internal("combine", res),
+            fused=cfg.fused_combine,
+            flush_us=cfg.combine_flush_us,
+            max_batch=cfg.combine_batch_max,
+            on_flush=self._on_combine_flush,
+            rid=self.id)
         # cross-seqnum combined-cert verification batcher: certs arriving
         # within a flush window verify in ONE aggregated check per
         # verifier (BLS: single RLC'd pairing check)
@@ -398,6 +407,14 @@ class Replica(IReceiver):
             "exec_spec_aborts")
         self.m_exec_spec_overlap = self.metrics.register_gauge(
             "exec_spec_overlap_ms")
+        # fused combine plane: flushes drained and slots combined —
+        # combined_slots / combine_batches is the amortization factor
+        # (the `status get kernels` bls_msm batch stats show the same
+        # win device-side); the ROADMAP-8 autotuner's flush-window sensor
+        self.m_combine_batches = self.metrics.register_counter(
+            "combine_batches")
+        self.m_combined_slots = self.metrics.register_counter(
+            "combined_slots")
         # external-queue backpressure drops (IncomingMsgsStorage bound),
         # refreshed by the status timer — paired with the admission
         # component's counters for the full ingest picture
@@ -476,6 +493,9 @@ class Replica(IReceiver):
         # per-sealed-run reclaimed overlap (ms → recorded in µs)
         self._h_spec_overlap = self._diag.histogram(
             f"replica{self.id}.exec_spec_overlap_ms")
+        # slots per fused combine flush (1 = no cross-slot amortization)
+        self._h_combine_batch = self._diag.histogram(
+            f"replica{self.id}.combine_batch_size", unit="slots")
         self._diag.register_status(
             f"replica{self.id}",
             lambda: (f"view={self.view} last_executed={self.last_executed} "
@@ -1498,7 +1518,22 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     # combine results (internal msg; reference onInternalMsg :1517)
     # ------------------------------------------------------------------
+    def _on_combine_flush(self, n_slots: int) -> None:
+        """Fused combine flush drained (combine-batch thread): batch
+        stats only — locked counters/histogram, no protocol state."""
+        self.m_combine_batches.inc()
+        self.m_combined_slots.inc(n_slots)
+        self._h_combine_batch.record(n_slots)
+
     def _on_combine_result(self, res: CombineResult) -> None:
+        # the verdict's state flip happens HERE, dispatcher-side, on the
+        # exact collector the job ran for — combine workers/batchers
+        # never write collector state (it would race ready_for_job on
+        # this thread). Unconditional: even a stale verdict (view
+        # changed, window slid) must clear its own collector's
+        # job_launched, or an outlived collector could wedge.
+        if res.collector is not None:
+            res.collector.on_result(res)
         if res.view != self.view or not self.window.in_window(res.seq_num) \
                 or self.in_view_change:
             return

@@ -96,6 +96,31 @@ class IThresholdVerifier(abc.ABC):
         batch) override this; the default is the per-cert loop."""
         return [self.verify(d, s) for d, s in items]
 
+    def combine_batch(self, jobs: Sequence[Tuple[bytes, Dict[int, bytes]]]
+                      ) -> List[Tuple[bool, bytes, List[int]]]:
+        """Fused cross-slot combine: jobs of (digest, {share_id: share})
+        -> one (ok, combined_sig, bad_share_ids) per job. The default is
+        the reference SignaturesProcessingJob strategy per job —
+        accumulate WITHOUT share verification, combine, verify the
+        combined signature, and only on failure identify bad shares.
+        Batch-capable backends override this to fold every job's
+        combine into one device call and every job's combined-signature
+        check into one aggregated verification; overrides MUST return
+        verdicts identical to this loop (a bad share fails only its own
+        job), which the fused-combine equivalence tests pin down."""
+        out: List[Tuple[bool, bytes, List[int]]] = []
+        for digest, shares in jobs:
+            acc = self.new_accumulator(with_share_verification=False)
+            acc.set_expected_digest(digest)
+            for sid, share in shares.items():
+                acc.add(sid, share)
+            combined = acc.get_full_signed_data()
+            if self.verify(digest, combined):
+                out.append((True, combined, []))
+            else:
+                out.append((False, b"", acc.identify_bad_shares()))
+        return out
+
     @property
     @abc.abstractmethod
     def threshold(self) -> int: ...

@@ -31,6 +31,19 @@ from tpubft.crypto.interfaces import (Cryptosystem, IThresholdAccumulator,
 
 # ---------------- multisig-ed25519 ----------------
 
+def pack_multisig_vector(ids: Sequence[int],
+                         shares: Dict[int, bytes]) -> bytes:
+    """THE multisig-vector certificate encoding: <H count, then per
+    signer <H id + 64-byte ed25519 sig, ids in the given order. The one
+    serializer for both the accumulator and the fused combine paths —
+    their byte-identity is a pinned correctness invariant."""
+    out = bytearray(struct.pack("<H", len(ids)))
+    for i in ids:
+        out += struct.pack("<H", i)
+        out += shares[i]
+    return bytes(out)
+
+
 class MultisigEd25519Signer(IThresholdSigner):
     def __init__(self, signer_id: int, seed_or_sk: bytes):
         self._signer = Ed25519Signer(seed_or_sk)
@@ -66,11 +79,7 @@ class MultisigEd25519Accumulator(IThresholdAccumulator):
 
     def get_full_signed_data(self) -> bytes:
         ids = sorted(self._shares)[: self._verifier.threshold]
-        out = bytearray(struct.pack("<H", len(ids)))
-        for i in ids:
-            out += struct.pack("<H", i)
-            out += self._shares[i]
-        return bytes(out)
+        return pack_multisig_vector(ids, self._shares)
 
     def identify_bad_shares(self) -> List[int]:
         assert self._digest is not None
@@ -193,14 +202,11 @@ class BlsThresholdAccumulator(IThresholdAccumulator):
     def identify_bad_shares(self) -> List[int]:
         """Aggregation-tree isolation: O(b·log n) pairing checks for b bad
         shares (reference BlsBatchVerifier.cpp:44,84) instead of the naive
-        O(n) one-pairing-per-share sweep."""
+        O(n) one-pairing-per-share sweep. One implementation shared with
+        the fused path (verifier._identify_bad) so per-slot and fused
+        bad-share verdicts can never diverge."""
         assert self._digest is not None
-        h = bls.hash_to_g1(self._digest)
-        ids = sorted(self._shares)
-        tree = bls.BlsBatchVerifier(
-            [self._verifier.share_pk(i) for i in ids], h)
-        verdicts = tree.batch_verify([self._shares[i] for i in ids])
-        return [i for i, ok in zip(ids, verdicts) if not ok]
+        return self._verifier._identify_bad(self._digest, self._shares)
 
 
 class BlsThresholdVerifier(IThresholdVerifier):
@@ -281,6 +287,70 @@ class BlsThresholdVerifier(IThresholdVerifier):
                                         (h, self._master_pk)])
         return out
 
+    # ---- fused cross-slot combine (the per-slot combine tax killer) ----
+
+    def _decode_job_shares(self, shares: Dict[int, bytes]) -> Dict[int, object]:
+        """Accumulator `add` semantics over a raw share dict: out-of-range
+        ids and undecodable/infinity points are silently dropped — the
+        job combines over what remains, exactly as the per-slot path."""
+        pts: Dict[int, object] = {}
+        for sid, share in shares.items():
+            if not 1 <= sid <= self._total:
+                continue
+            try:
+                pt = bls.g1_decompress(share)
+            except ValueError:
+                continue
+            if pt is None:
+                continue
+            pts[sid] = pt
+        return pts
+
+    def _combine_segments(self, segments) -> List:
+        """[(ids, [share points])] -> one combined G1 point per segment.
+        Host path: per-segment Lagrange + MSM; the TPU subclass folds
+        every segment into ONE segmented multi-MSM device launch."""
+        return [bls.combine_shares(ids, pts) if ids else None
+                for ids, pts in segments]
+
+    def combine_batch(self, jobs) -> List[Tuple[bool, bytes, List[int]]]:
+        """Fused combine across slots: all jobs' Lagrange+MSM combines in
+        one pass (one device launch on the TPU subclass), then ONE
+        RLC-aggregated pairing check for every combined signature of the
+        flush (`verify_batch_certs`). On aggregate failure the batcher
+        isolates per job, and only failing jobs pay bad-share
+        identification — one slot's byzantine share fails only its own
+        job, sibling slots in the same flush still land. Verdicts are
+        identical to the per-job default (interfaces.combine_batch)."""
+        decoded = [(digest, self._decode_job_shares(shares))
+                   for digest, shares in jobs]
+        segments = []
+        for _digest, pts in decoded:
+            ids = sorted(pts)[: self._threshold]
+            segments.append((ids, [pts[i] for i in ids]))
+        combined = self._combine_segments(segments)
+        sigs = [bls.g1_compress(pt) for pt in combined]
+        verdicts = self.verify_batch_certs(
+            [(digest, sig) for (digest, _), sig in zip(decoded, sigs)])
+        out: List[Tuple[bool, bytes, List[int]]] = []
+        for (digest, pts), sig, ok in zip(decoded, sigs, verdicts):
+            if ok:
+                out.append((True, sig, []))
+                continue
+            out.append((False, b"", self._identify_bad(digest, pts)))
+        return out
+
+    def _identify_bad(self, digest: bytes, pts: Dict[int, object]
+                      ) -> List[int]:
+        """Aggregation-tree isolation over one failing job's decoded
+        shares — the same BlsBatchVerifier walk the accumulator path
+        runs (O(b·log n) pairing checks for b bad shares)."""
+        h = bls.hash_to_g1(digest)
+        ids = sorted(pts)
+        tree = bls.BlsBatchVerifier([self.share_pk(i) for i in ids], h)
+        verdicts = tree.batch_verify([pts[i] for i in ids])
+        return [i for i, good in zip(ids, verdicts) if not good]
+
     @property
     def threshold(self) -> int:
         return self._threshold
@@ -308,4 +378,34 @@ def register_builtin(type_name: str) -> None:
     elif type_name in ("threshold-bls", "multisig-bls"):
         Cryptosystem.register_type(type_name, BlsThresholdFactory())
     else:
-        raise ValueError(f"unknown cryptosystem type {type_name}")
+        raise ValueError(f"unknown cryptosystem type {type_name}"
+                         + (" ('adaptive' must be resolved by "
+                            "resolve_threshold_scheme before key "
+                            "generation)" if type_name == "adaptive"
+                            else ""))
+
+
+# Default n-crossover for the "adaptive" certificate scheme. Below it a
+# cluster certifies with the Ed25519 multisig vector (k constant-time
+# EdDSA verifies, batch-friendly, zero G1 ladder math); at or above it
+# with compact BLS threshold certificates (48 bytes on the wire and in
+# every carried proof, vs 66·k for the vector). The EdDSA-vs-BLS
+# committee measurements (arXiv 2302.00418) put per-share threshold math
+# far above EdDSA cost at committee sizes this small; the default is
+# picked by `python -m benchmarks.bench_combine --crossover`
+# (benchmarks/RESULTS.md) and overridable per cluster via
+# ReplicaConfig.threshold_scheme_crossover_n.
+ADAPTIVE_SCHEME_CROSSOVER_N = 16
+
+
+def resolve_threshold_scheme(scheme: str, n: int,
+                             crossover_n: int = 0) -> str:
+    """Configure-time resolution of the certificate scheme: "adaptive"
+    becomes a concrete cryptosystem type from the cluster size, anything
+    else passes through. Every replica must resolve identically (same n,
+    same crossover) — the scheme is part of the cluster's key material,
+    so it is resolved once at keygen, never re-negotiated on the wire."""
+    if scheme != "adaptive":
+        return scheme
+    cx = crossover_n or ADAPTIVE_SCHEME_CROSSOVER_N
+    return "multisig-ed25519" if n < cx else "threshold-bls"

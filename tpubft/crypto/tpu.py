@@ -28,7 +28,7 @@ branches on the backend.
 from __future__ import annotations
 
 import struct
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpubft.crypto import bls12381 as bls
 from tpubft.crypto.interfaces import IVerifier
@@ -129,25 +129,8 @@ class TpuMultisigEd25519Verifier(MultisigEd25519Verifier):
     def verify(self, data: bytes, sig: bytes) -> bool:
         if self.threshold < self.min_device_batch:
             return super().verify(data, sig)
-        try:
-            (k,) = struct.unpack_from("<H", sig, 0)
-            if k < self.threshold:
-                return False
-            off = 2
-            entries = []
-            seen = set()
-            for _ in range(k):
-                (i,) = struct.unpack_from("<H", sig, off)
-                off += 2
-                share = sig[off:off + 64]
-                off += 64
-                if i in seen or not 1 <= i <= self.total_signers:
-                    return False
-                seen.add(i)
-                entries.append((self._share_pk_bytes[i - 1], data, share))
-            if off != len(sig):
-                return False
-        except (struct.error, IndexError):
+        entries = self._parse_vector(data, sig)
+        if entries is None:
             return False
         try:
             return all(verify_batch_items(entries))
@@ -174,6 +157,107 @@ class TpuMultisigEd25519Verifier(MultisigEd25519Verifier):
         except Exception:  # noqa: BLE001 — degrade to per-share host
             return [self.verify_share(i, d, s) for i, d, s in items]
         return [next(verdicts) if shaped else False for shaped in ok_shape]
+
+    def verify_batch_certs(self, items) -> List[bool]:
+        """Cross-cert batching for the multisig vector: every cert's
+        share signatures across the whole flush verify in ONE ed25519
+        device batch (k_1+...+k_m sigs, one dispatch) instead of m
+        sequential k-verify loops. Presence of this override routes
+        multisig certs through the replica's CertBatchVerifier."""
+        parsed: List[Optional[List[Tuple[bytes, bytes, bytes]]]] = []
+        entries: List[Tuple[bytes, bytes, bytes]] = []
+        for data, sig in items:
+            one = self._parse_vector(data, sig)
+            parsed.append(one)
+            if one is not None:
+                entries.extend(one)
+        if not entries:
+            return [False] * len(items)
+        if len(entries) < self.min_device_batch:
+            # a near-empty flush is latency-critical and too small to
+            # amortize a dispatch: host loop (same doctrine as verify)
+            return [self.verify(d, s) for d, s in items]
+        try:
+            verdicts = iter(verify_batch_items(entries))
+        except Exception:  # noqa: BLE001 — device loss: serial host check
+            return [self.verify(d, s) for d, s in items]
+        out = []
+        for one in parsed:
+            if one is None:
+                out.append(False)
+            else:
+                # materialize BEFORE all(): a short-circuit would leave
+                # this cert's unconsumed verdicts on the shared iterator
+                # and misattribute them to every later cert in the flush
+                vs = [next(verdicts) for _ in one]
+                out.append(all(vs))
+        return out
+
+    def _parse_vector(self, data: bytes, sig: bytes
+                      ) -> Optional[List[Tuple[bytes, bytes, bytes]]]:
+        """Structural multisig-vector checks (threshold met, unique
+        in-range signers, exact length) -> (pk, data, share) entries,
+        or None when the vector can't be valid. Mirrors
+        MultisigEd25519Verifier.verify's parse exactly."""
+        try:
+            (k,) = struct.unpack_from("<H", sig, 0)
+            if k < self.threshold:
+                return None
+            off = 2
+            entries = []
+            seen = set()
+            for _ in range(k):
+                (i,) = struct.unpack_from("<H", sig, off)
+                off += 2
+                share = sig[off:off + 64]
+                off += 64
+                if i in seen or not 1 <= i <= self.total_signers:
+                    return None
+                seen.add(i)
+                entries.append((self._share_pk_bytes[i - 1], data, share))
+            if off != len(sig):
+                return None
+            return entries
+        except (struct.error, IndexError):
+            return None
+
+    def combine_batch(self, jobs) -> List[Tuple[bool, bytes, List[int]]]:
+        """Fused cross-slot combine for the multisig vector: combining
+        is concatenation (host, trivial) — the cost is verification, so
+        every job's shares across the flush ride ONE ed25519 device
+        batch. Verdicts (including bad-share identification and its
+        dict-order listing) are identical to the per-job loop."""
+        entries = []
+        index = []                     # (job, sid) per entry
+        for j, (digest, shares) in enumerate(jobs):
+            for sid in shares:         # dict order, like the accumulator
+                if 1 <= sid <= self.total_signers:
+                    entries.append((self._share_pk_bytes[sid - 1], digest,
+                                    shares[sid]))
+                    index.append((j, sid))
+        if len(entries) < self.min_device_batch:
+            return super().combine_batch(jobs)   # host loop (see verify)
+        try:
+            flat = verify_batch_items(entries) if entries else []
+        except Exception:  # noqa: BLE001 — device loss: per-job host loop
+            return super().combine_batch(jobs)
+        ok_by_job: List[Dict[int, bool]] = [{} for _ in jobs]
+        for (j, sid), good in zip(index, flat):
+            ok_by_job[j][sid] = bool(good)
+        out: List[Tuple[bool, bytes, List[int]]] = []
+        for j, (digest, shares) in enumerate(jobs):
+            verdicts = ok_by_job[j]
+            chosen = sorted(shares)[: self.threshold]
+            ok = (len(chosen) >= self.threshold
+                  and all(verdicts.get(sid, False) for sid in chosen))
+            if ok:
+                from tpubft.crypto.systems import pack_multisig_vector
+                out.append((True, pack_multisig_vector(chosen, shares),
+                            []))
+            else:
+                out.append((False, b"", [sid for sid in shares
+                                         if not verdicts.get(sid, False)]))
+        return out
 
 
 class TpuBlsThresholdAccumulator(BlsThresholdAccumulator):
@@ -209,6 +293,27 @@ class TpuBlsThresholdVerifier(BlsThresholdVerifier):
     def new_accumulator(self, with_share_verification: bool
                         ) -> TpuBlsThresholdAccumulator:
         return TpuBlsThresholdAccumulator(self, with_share_verification)
+
+    def _combine_segments(self, segments) -> List:
+        """Fused-combine device path: every slot's Lagrange-weighted MSM
+        in ONE segmented `msm_batch_kernel` launch (combine_batch's
+        whole flush pays one `bls_msm` dispatch instead of one per
+        slot). Below the measured crossover the host Pippenger path
+        wins even fused — same knob as the per-slot accumulator."""
+        import os
+        total = sum(len(ids) for ids, _ in segments)
+        crossover = int(os.environ.get("TPUBFT_MSM_CROSSOVER_K", "128"))
+        # a fused flush amortizes the dispatch across all segments, so
+        # it clears the crossover on the SUM of shares, not per slot
+        if total < crossover or not any(ids for ids, _ in segments):
+            return super()._combine_segments(segments)
+        try:
+            from tpubft.ops import bls12_381 as dev
+            return dev.combine_shares_batch(
+                [(ids, pts) for ids, pts in segments])
+        except Exception:  # noqa: BLE001 — device loss: the host
+            # per-segment combine produces identical signatures
+            return super()._combine_segments(segments)
 
 
 def make_threshold_verifier(type_name: str, threshold: int, total: int,

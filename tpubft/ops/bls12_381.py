@@ -116,6 +116,75 @@ def combine_shares(ids: Sequence[int], shares_g1: Sequence) -> object:
     return msm(list(shares_g1), coeffs)
 
 
+@functools.partial(jax.jit, static_argnums=())
+def msm_batch_kernel(bits: jnp.ndarray, px: jnp.ndarray, py: jnp.ndarray,
+                     infinity: jnp.ndarray
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Segmented multi-MSM: S independent sum_i [k_ij] P_ij in ONE
+    launch. bits (255, S, K), px/py (NL, S, K) Montgomery, infinity
+    (S, K) marks padding/identity slots; K is the per-segment share
+    width (padded to a power of two). Ladders all S·K points in
+    parallel, then tree-reduces only the K axis — one projective
+    result (NL, S, 1) per segment, never mixing segments."""
+    cv = g1_curve()
+    pts = cv.from_affine(px, py)
+    pts = cv.select(infinity, cv.identity(px.shape[1:]), pts)
+    acc = cv.scalar_mul_bits(bits, pts)
+    out = cv.msm_reduce(acc)
+    return out.x, out.y, out.z
+
+
+def msm_batch(segments: Sequence[Tuple[Sequence, Sequence[int]]]) -> List:
+    """Cross-slot fused MSM: each segment is (points, scalars) and the
+    whole batch rides ONE `msm_kernel`-shaped device launch instead of
+    one launch per segment (the per-slot combine tax the fused
+    combine plane removes). Returns one affine point (or None for the
+    identity) per segment. Segment count and width are padded to
+    powers of two so the jit cache stays at O(log² sizes) programs."""
+    cv = g1_curve()
+    s = len(segments)
+    if s == 0:
+        return []
+    kmax = _pad_pow2(max(1, max(len(p) for p, _ in segments)))
+    smax = _pad_pow2(s)
+    infinity = np.ones((smax, kmax), bool)
+    pts: List[Tuple[int, int]] = []
+    ks: List[int] = []
+    total = 0
+    for j in range(smax):
+        points, scalars = segments[j] if j < s else ((), ())
+        total += len(points)
+        for i in range(kmax):
+            if i < len(points) and points[i] is not None:
+                pts.append(points[i])
+                ks.append(scalars[i] % ref.R)
+                infinity[j, i] = False
+            else:
+                pts.append((0, 0))
+                ks.append(0)
+    px, py = cv.affine_to_device(pts)           # (NL, smax*kmax)
+    px = px.reshape(px.shape[0], smax, kmax)
+    py = py.reshape(py.shape[0], smax, kmax)
+    bits = _bits_msb_batch(ks).reshape(SCALAR_BITS, smax, kmax)
+    from tpubft.ops.dispatch import device_section
+    with device_section("bls_msm", batch=total):
+        x, y, z = msm_batch_kernel(jnp.asarray(bits), jnp.asarray(px),
+                                   jnp.asarray(py), jnp.asarray(infinity))
+        x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
+    return [_to_affine_host(x[:, j, 0], y[:, j, 0], z[:, j, 0])
+            for j in range(s)]
+
+
+def combine_shares_batch(jobs: Sequence[Tuple[Sequence[int], Sequence]]
+                         ) -> List:
+    """Fused threshold combine across slots: jobs of (ids, shares_g1)
+    — Lagrange coefficients per job on host (tiny), then ONE segmented
+    MSM device call for every job together. Element-wise identical to
+    per-job `combine_shares`."""
+    return msm_batch([(list(shares), ref.lagrange_coeffs_at_zero(ids))
+                      for ids, shares in jobs])
+
+
 def batch_scalar_mul(points: Sequence, scalars: Sequence[int]) -> List:
     """[k_i]P_i for each i (no reduction) — used by batched share verify."""
     cv = g1_curve()

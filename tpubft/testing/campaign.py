@@ -394,6 +394,66 @@ def scenario_breaker_viewchange(ctx: ScenarioContext) -> dict:
                            "(%d consecutive failures)" % b.failure_threshold}
 
 
+def scenario_fused_flush_bad_share(ctx: ScenarioContext) -> dict:
+    """Byzantine shares inside fused combine flushes (ISSUE 11): a
+    backup corrupts every threshold share it sends while pipelined load
+    keeps several slots per flush. Each poisoned combine must fail ONLY
+    its own slot (bad-share identification drops the byzantine share
+    and the honest 2f+c+1 re-combine lands); sibling slots in the same
+    batch commit on schedule, no view change, no divergence."""
+    from tpubft.apps import counter
+    byz = ctx.choice("byz", (1, 2, 3))
+    ctx.event("byzantine", replica=byz, strategy="corrupt-shares")
+    n_per_client = 4
+    deltas = [[ctx.randint(f"add{c}_{i}", 1, 50)
+               for i in range(n_per_client)] for c in (0, 1)]
+    with _counter_cluster(ctx, byzantine={byz: "corrupt-shares"},
+                          num_clients=2) as cluster:
+        # pipelined writers: two clients in parallel so combine flushes
+        # carry sibling slots alongside the poisoned shares
+        errs = []
+
+        def drive(idx: int) -> None:
+            cl = cluster.client(idx)
+            try:
+                for d in deltas[idx]:
+                    cl.send_write(counter.encode_add(d),
+                                  timeout_ms=30000)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=drive, args=(c,))
+                   for c in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, f"writes failed under byzantine shares: {errs}"
+        total = sum(sum(ds) for ds in deltas)
+        recovery = time.monotonic() - t0
+        _wait_converged(ctx, cluster, total,
+                        [r for r in range(cluster.n) if r != byz], 20,
+                        "honest replicas converge despite poisoned "
+                        "shares in every flush")
+        # sibling-slot schedule: ordering never needed a view change —
+        # bad-share identification isolated the byzantine share per
+        # slot instead of stalling the pipeline into the VC timer
+        for r in range(cluster.n):
+            if r != byz:
+                assert cluster.replicas[r].view == 0, \
+                    f"replica {r} view-changed away under isolated " \
+                    f"bad shares"
+        # the fused plane was actually exercised on some honest replica
+        # (collector roles rotate; at least one honest collector
+        # drained flushes)
+        batches = sum(cluster.metric(r, "counters", "combine_batches")
+                      for r in range(cluster.n) if r != byz)
+        assert batches > 0, "fused combine batcher never drained"
+    return {"recovery_s": round(recovery, 3),
+            "combine_batches": batches}
+
+
 def scenario_crash_restart_replay(ctx: ScenarioContext) -> dict:
     """Plain crash recovery: a backup restarts from its WAL and replays
     to the cluster's state exactly once."""
@@ -650,6 +710,8 @@ def smoke_matrix() -> List[ScenarioSpec]:
                      scenario_spec_abort_equivocation,
                      "inproc", 90, tags=("byzantine", "view-change",
                                          "speculation")),
+        ScenarioSpec("fused-flush-bad-share", scenario_fused_flush_bad_share,
+                     "inproc", 90, tags=("byzantine", "combine")),
         ScenarioSpec("crash-restart-replay", scenario_crash_restart_replay,
                      "inproc", 60, tags=("recovery",)),
         ScenarioSpec("crashpoint-exec-post-apply",

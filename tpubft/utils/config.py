@@ -116,12 +116,36 @@ class ReplicaConfig:
     db_sync_metadata: bool = True
     replica_sig_scheme: str = "ed25519"  # per-message replica signatures
     client_sig_scheme: str = "ed25519"
-    threshold_scheme: str = "multisig-ed25519"  # or "threshold-bls"
+    # certificate (threshold) scheme: "multisig-ed25519", "threshold-bls",
+    # or "adaptive" — resolved ONCE at key generation by cluster size:
+    # below the crossover the Ed25519 multisig vector (no G1 ladder math
+    # at all), at/above it compact BLS threshold certificates
+    # (crypto/systems.resolve_threshold_scheme; the EdDSA-vs-BLS
+    # committee measurements, arXiv 2302.00418, quantify the tradeoff)
+    threshold_scheme: str = "adaptive"
+    # n-crossover for "adaptive" (0 = the built-in default measured by
+    # benchmarks/bench_combine.py --crossover). Every replica of a
+    # cluster must configure the same value — the resolved scheme is
+    # part of the cluster key material
+    threshold_scheme_crossover_n: int = 0
     client_transaction_signing_enabled: bool = True
 
     # crypto batch dispatch (TPU seam)
     verify_batch_size: int = 256
     verify_batch_flush_us: int = 200
+    # fused cross-slot combine plane (consensus/collectors.CombineBatcher):
+    # due collectors across seqnums and kinds drain into ONE
+    # combine_batch call per flush (BLS: one segmented multi-MSM launch
+    # + one RLC pairing check for the whole batch) instead of one
+    # combine job per slot. False = the legacy per-collector job path
+    # (A/B control for bench_combine / bench_e2e pairing runs).
+    fused_combine: bool = True
+    # flush window / max slots per fused combine flush. The window
+    # bounds added commit latency on an idle replica; under pipelined
+    # load the batch fills first (see docs/OPERATIONS.md "Certificate
+    # schemes & combine batching" for tuning)
+    combine_flush_us: int = 300
+    combine_batch_max: int = 64
     # below this many signatures a batch verifies on the CPU verifiers
     # instead of paying a device dispatch (latency-critical singletons)
     device_min_verify_batch: int = 32
@@ -288,6 +312,11 @@ class ReplicaConfig:
             raise ValueError("breaker_failure_threshold must be >= 1")
         if self.health_poll_ms < 1 or self.health_stall_ms < 1:
             raise ValueError("health_poll_ms/health_stall_ms must be >= 1")
+        if self.threshold_scheme_crossover_n < 0:
+            raise ValueError("threshold_scheme_crossover_n must be >= 0")
+        if self.combine_batch_max < 1 or self.combine_flush_us < 0:
+            raise ValueError("combine_batch_max must be >= 1 and "
+                             "combine_flush_us >= 0")
 
     # ---- serialization ----
     def to_json(self) -> str:

@@ -81,6 +81,7 @@ EV_HEALTH = 15          # health verdict transition (arg=verdict id)
 EV_SPEC_ENQ = 16        # slot handed to the lane SPECULATIVELY
 EV_SPEC_SEAL = 17       # speculative run sealed at commit (arg=run len)
 EV_SPEC_ABORT = 18      # speculation aborted; slot re-executes committed
+EV_COMBINE_FLUSH = 19   # fused combine flush (batcher; arg=slots drained)
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -92,6 +93,7 @@ EV_NAMES = {
     EV_DEV_ENTER: "dev_enter", EV_DEV_EXIT: "dev_exit",
     EV_HEALTH: "health", EV_SPEC_ENQ: "spec_enqueue",
     EV_SPEC_SEAL: "spec_seal", EV_SPEC_ABORT: "spec_abort",
+    EV_COMBINE_FLUSH: "combine_flush",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
