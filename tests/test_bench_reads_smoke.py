@@ -1,0 +1,38 @@
+"""Tier-1 wiring for benchmarks/bench_reads.py (--smoke shape),
+mirroring test_bench_e2e_smoke: the read-scaling serving plane — the
+thin-replica tier fed from the coalesced commit stream, checkpoint-
+anchored verified reads, and the pre-execution write path — gets a
+collection-time guard (the bench module must import) and a runtime
+guard (both read modes must serve real traffic while writes order).
+
+TPUBFT_THREADCHECK=1 arms utils/racecheck across the run: the
+commit-stream hop (exec lane → trs.subs lock), the anchor snapshot
+(dispatcher → trs.anchor lock), and the preexec pool handoff all
+become CheckedLock edges in the global lock-order graph, so an
+inversion raises here instead of deadlocking a serving tier."""
+import pytest
+
+
+@pytest.fixture
+def threadcheck(monkeypatch):
+    monkeypatch.setenv("TPUBFT_THREADCHECK", "1")
+    from tpubft.utils import racecheck
+    assert racecheck.enabled()
+    yield
+
+
+def test_bench_reads_smoke(threadcheck):
+    from benchmarks.bench_reads import smoke
+    out = smoke(secs=2.0)
+    # both rows served real traffic (degraded rows carry probe_error —
+    # the PR 4 artifact convention — and fail this gate loudly)
+    assert out["thin"]["ok"], out
+    assert out["consensus"]["ok"], out
+    # EVERY thin read verified its inclusion proof against the
+    # f+1-signed checkpoint anchor
+    assert out["thin"]["all_verified"], out
+    # a corrupting server is DETECTED, never served as data
+    assert out["corrupt_server_detected"], out
+    assert out["honest_read_ok"], out
+    # no dispatcher/executor/serving-tier stall during the run
+    assert out["stall_reports"] == 0, out

@@ -168,3 +168,22 @@ def test_bench_chaos_replay_red_second_pass_fails(capsys):
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["replay_match"] is True        # digests DID match
     assert line["replay_failed"] == ["flaky"]  # but the rerun went red
+
+
+def test_thin_replica_failover_scenario_replays_identically():
+    """The read-tier chaos scenario (ISSUE 12): a thin-replica
+    subscriber survives its data server's kill by rotating to another
+    replica and catching up digest-verified, while writes ride the
+    pre-execution plane. Run twice: green both times, digest-identical
+    schedule (the replayability contract for the new scenario)."""
+    by_name = cmp.matrix_by_name()
+    spec = by_name["thin-replica-failover"]
+    first = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
+    assert first["failed"] == 0, json.dumps(first["scenarios"], indent=1)
+    second = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
+    assert second["failed"] == 0, json.dumps(second["scenarios"],
+                                             indent=1)
+    assert first["event_log_digest"] == second["event_log_digest"]
+    stats = first["scenarios"][0]["stats"]
+    assert stats["blocks"] >= 6          # pre + post writes all streamed
+    assert stats["preexec_agreed"] >= stats["blocks"]

@@ -175,3 +175,160 @@ def test_preprocess_batch_wire_grouping():
     # PreProcessRequest sends than elements x backups
     singles = sent[(0, int(m.MsgCode.PreProcessRequest))]
     assert singles < 8 * 3
+
+
+def test_reply_cache_is_lru_bounded_with_eviction_counter():
+    """Satellite: the backup-side reply cache is a config-capped LRU
+    (it was an unbounded-growth dict under real client traffic), with
+    hits refreshing recency and evictions counted."""
+    from tpubft.preprocessor import PreProcessor
+    from tpubft.utils.config import ReplicaConfig
+    from tpubft.utils.metrics import Component
+
+    class _FakeDispatcher:
+        def register_internal(self, *a, **kw):
+            pass
+
+        def add_timer(self, *a, **kw):
+            pass
+
+    class _FakeIncoming:
+        def push_internal(self, *a, **kw):
+            pass
+
+    class _FakeReplica:
+        dispatcher = _FakeDispatcher()
+        incoming = _FakeIncoming()
+        cfg = ReplicaConfig(pre_execution_enabled=True,
+                            preexec_reply_cache_max=3)
+        preexec_metrics = Component("preexec")
+
+    pp = PreProcessor(_FakeReplica(), num_threads=1)
+    try:
+        for i in range(5):
+            pp._cache_put((1, i, 1), b"r%d" % i)
+        assert len(pp._reply_cache) == 3
+        assert pp.m_cache_evictions.value == 2
+        # oldest evicted, newest retained
+        assert pp._cache_get((1, 0, 1)) is None
+        assert pp._cache_get((1, 4, 1)) == b"r4"
+        assert pp.m_cache_hits.value == 1
+        # a HIT refreshes recency: touch (1,2,1), insert two more —
+        # (1,3,1) evicts before the refreshed entry
+        assert pp._cache_get((1, 2, 1)) == b"r2"
+        pp._cache_put((1, 5, 1), b"r5")
+        pp._cache_put((1, 6, 1), b"r6")
+        assert pp._cache_get((1, 2, 1)) == b"r2"
+        assert pp._cache_get((1, 3, 1)) is None
+    finally:
+        pp.shutdown()
+
+
+def test_reply_cache_rebroadcast_does_not_reexecute():
+    """Satellite: a primary rebroadcast of a PreProcessRequest the
+    backup already executed is answered from the reply cache — the
+    handler's pre_execute must NOT run again."""
+    import threading as _t
+
+    with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                          cfg_overrides=PREEXEC) as cluster:
+        rep = cluster.replicas[1]          # a backup
+        calls = []
+        orig_pre = rep.handler.pre_execute
+
+        def counting_pre(client_id, req_seq, request):
+            calls.append((client_id, req_seq))
+            return orig_pre(client_id, req_seq, request)
+
+        rep.handler.pre_execute = counting_pre
+        # a properly client-signed PRE_PROCESS request, injected as a
+        # primary broadcast (the backup validates the embedded client
+        # signature before executing)
+        client_id = cluster.first_client_id
+        from tpubft.crypto.cpu import Ed25519Signer
+        signer = Ed25519Signer.generate(
+            seed=cluster.keys.for_node(client_id).my_sign_seed)
+        orig = m.ClientRequestMsg(
+            sender_id=client_id, req_seq_num=777,
+            flags=int(m.RequestFlag.PRE_PROCESS),
+            request=skvbc.pack(
+                skvbc.WriteRequest(writeset=[(b"rb", b"v")])),
+            cid="rb", signature=b"")
+        orig.signature = signer.sign(orig.signed_payload())
+        ppr = m.PreProcessRequestMsg(
+            sender_id=0, client_id=client_id, req_seq_num=777,
+            retry_id=55, request=orig.pack())
+        rep.incoming.push_external(0, ppr.pack())
+        deadline = time.time() + 10
+        key = (client_id, 777, 55)
+        while time.time() < deadline \
+                and key not in rep.preprocessor._reply_cache:
+            time.sleep(0.05)
+        assert key in rep.preprocessor._reply_cache, \
+            "backup never produced the pre-execution reply"
+        n_first = len(calls)
+        assert n_first == 1
+        hits_before = rep.preprocessor.m_cache_hits.value
+        evt = _t.Event()
+        # rebroadcast: identical wire message again
+        rep.incoming.push_external(0, ppr.pack())
+        deadline = time.time() + 10
+        while time.time() < deadline \
+                and rep.preprocessor.m_cache_hits.value == hits_before:
+            time.sleep(0.05)
+        evt.wait(0.2)                      # settle: any stray execution
+        assert rep.preprocessor.m_cache_hits.value > hits_before, \
+            "rebroadcast missed the reply cache"
+        assert len(calls) == n_first, \
+            "rebroadcast RE-EXECUTED the handler"
+
+
+def _ledger_fingerprint(cluster, expect_blocks):
+    """Wait for every replica to converge, return the (digest, height)
+    the cluster agreed on — the byte-identity witness."""
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        states = {(h.blockchain.state_digest(), h.blockchain.last_block_id)
+                  for h in cluster.handlers.values()}
+        if len(states) == 1 and next(iter(states))[1] == expect_blocks:
+            return next(iter(states))
+        time.sleep(0.1)
+    raise AssertionError(f"no convergence: {states}")
+
+
+def test_preexec_conflict_fallback_state_equivalence():
+    """Tentpole invariant: contended + uncontended workloads produce
+    BYTE-IDENTICAL ledgers with pre-execution on vs off, and the
+    contended preexec run observes preexec_conflicts > 0 (conflict
+    detection at commit → fallback to normal ordering)."""
+    fingerprints = {}
+    for label, pre in (("on", True), ("off", False)):
+        with InProcessCluster(f=1, handler_factory=_skvbc_factory,
+                              cfg_overrides=PREEXEC if pre else {}) \
+                as cluster:
+            client = cluster.client(0)
+            client.start()
+            kv = skvbc.SkvbcClient(client)
+            # uncontended: multi-key unsorted writeset (canonicalization
+            # must not change ledger bytes)
+            assert kv.write([(b"z", b"9"), (b"a", b"1")],
+                            pre_process=pre, timeout_ms=15000).success
+            assert kv.write([(b"a", b"2")], pre_process=pre,
+                            timeout_ms=15000).success
+            # contended: readset watermark stale by the time it commits
+            stale = kv.write([(b"b", b"x")], readset=[b"a"],
+                             read_version=1, pre_process=pre,
+                             timeout_ms=15000)
+            assert not stale.success, "stale readset write must fail"
+            assert kv.write([(b"c", b"3")], pre_process=pre,
+                            timeout_ms=15000).success
+            fingerprints[label] = _ledger_fingerprint(cluster, 3)
+            if pre:
+                conflicts = sum(
+                    cluster.metric(r, "counters", "preexec_conflicts",
+                                   component="preexec") or 0
+                    for r in range(cluster.n))
+                assert conflicts >= 1, \
+                    "conflict fallback never fired in the contended run"
+    assert fingerprints["on"] == fingerprints["off"], \
+        f"ledger divergence between preexec on/off: {fingerprints}"

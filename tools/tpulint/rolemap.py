@@ -103,6 +103,12 @@ THREAD_ROLES: Dict[FuncId, FrozenSet[str]] = {
     # load-generator worker threads (apps/tester_client CLI)
     ("tpubft/apps/tester_client.py", None, "run_workload.worker"):
         frozenset({"load_gen"}),
+    # pre-execution worker pool (ThreadPoolExecutor — invisible to the
+    # threading.Thread audit, seeded directly like CollectorPool): runs
+    # handler.pre_execute off the dispatcher and re-enters through the
+    # internal queue
+    ("tpubft/preprocessor/preprocessor.py", "PreProcessor",
+     "_launch.job"): frozenset({"preexec"}),
 }
 
 # -- cross-thread API surfaces (callable-attribute seams) --------------
@@ -116,7 +122,7 @@ API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
      "push_external_obj"): frozenset({"transport", "admission"}),
     ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
      "push_internal"): frozenset({"transport", "exec_lane",
-                                  "dispatcher"}),
+                                  "dispatcher", "preexec"}),
     ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
      "push_internal_once"): frozenset({"exec_lane"}),
     # admission ingest: called from transport receive threads
@@ -134,6 +140,16 @@ API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
         frozenset({"client_api"}),
     ("tpubft/bftclient/client.py", "BftClient", "on_new_message"):
         frozenset({"transport"}),
+    # thin-replica commit-listener hop: the ledger's run listeners fire
+    # on whichever thread sealed the commit — the execution lane
+    # (end_accumulation), the dispatcher (inline execution, ST link
+    # segments), or an app thread in unit tests
+    ("tpubft/thinreplica/server.py", "ThinReplicaServer", "_on_run"):
+        frozenset({"exec_lane", "dispatcher"}),
+    # checkpoint-anchor snapshot: served to thin-replica connection
+    # handler threads; published by the dispatcher (_store_checkpoint)
+    ("tpubft/consensus/replica.py", "Replica", "thin_replica_anchor"):
+        frozenset({"thinreplica_srv"}),
 }
 
 # -- callback registrars: arg positions/kwargs that receive a function

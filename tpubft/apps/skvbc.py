@@ -16,7 +16,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from tpubft.consensus.replica import IRequestsHandler
-from tpubft.kvbc import VERSIONED_KV, BlockUpdates, KeyValueBlockchain
+from tpubft.kvbc import (BLOCK_MERKLE, VERSIONED_KV, BlockUpdates,
+                         KeyValueBlockchain)
 from tpubft.utils import serialize as ser
 from tpubft.utils.racecheck import make_lock
 
@@ -103,8 +104,16 @@ class SkvbcHandler(IRequestsHandler):
     """InternalCommandsHandler equivalent
     (tests/simpleKVBC/TesterReplica/internalCommandsHandler.hpp:34)."""
 
-    def __init__(self, blockchain: KeyValueBlockchain) -> None:
+    def __init__(self, blockchain: KeyValueBlockchain,
+                 merkle: bool = False) -> None:
+        """`merkle=True` keeps the kv state in a BLOCK_MERKLE category
+        (the reference SKVBC layout): every key is provable with a
+        sparse-merkle audit path against the block-anchored root, which
+        is what the thin-replica read tier serves. Historical
+        (read_version != latest) reads are unsupported in merkle mode —
+        the proof plane serves those."""
         self._bc = blockchain
+        self._cat_type = BLOCK_MERKLE if merkle else VERSIONED_KV
         self._lock = make_lock("skvbc_app")
 
     @property
@@ -114,8 +123,11 @@ class SkvbcHandler(IRequestsHandler):
     # -- helpers --
     def _read_at(self, key: bytes, version: int) -> Optional[bytes]:
         if version == READ_LATEST:
-            hit = self._bc.get_latest(_CATEGORY, key)
+            hit = self._bc.get_latest(_CATEGORY, key,
+                                      cat_type=self._cat_type)
             return hit[1] if hit else None
+        if self._cat_type == BLOCK_MERKLE:
+            return None
         return self._bc.get_versioned(_CATEGORY, key, version)
 
     # -- IRequestsHandler --
@@ -131,17 +143,24 @@ class SkvbcHandler(IRequestsHandler):
             # reads routed through consensus still serve consistent data
             return self._execute_read(msg)
 
-    def _execute_write(self, msg: WriteRequest) -> bytes:
-        # conflict detection (internalCommandsHandler.cpp verifyWriteCommand):
-        # any readset key written after read_version fails the write
+    def _readset_stale(self, msg: WriteRequest) -> bool:
+        """Any readset key written after read_version ⇒ stale (the
+        conflict-detection discipline of
+        internalCommandsHandler.cpp verifyWriteCommand)."""
         for key in msg.readset:
-            hit = self._bc.get_latest(_CATEGORY, key)
+            hit = self._bc.get_latest(_CATEGORY, key,
+                                      cat_type=self._cat_type)
             if hit is not None and hit[0] > msg.read_version:
-                return pack(WriteReply(success=False,
-                                       latest_block=self._bc.last_block_id))
+                return True
+        return False
+
+    def _execute_write(self, msg: WriteRequest) -> bytes:
+        if msg.readset and self._readset_stale(msg):
+            return pack(WriteReply(success=False,
+                                   latest_block=self._bc.last_block_id))
         bu = BlockUpdates()
         for k, v in msg.writeset:
-            bu.put(_CATEGORY, k, v, cat_type=VERSIONED_KV)
+            bu.put(_CATEGORY, k, v, cat_type=self._cat_type)
         if msg.writeset:
             self._bc.add_block(bu)
         return pack(WriteReply(success=True,
@@ -201,6 +220,25 @@ class SkvbcHandler(IRequestsHandler):
                                  readset=sorted(msg.readset),
                                  writeset=sorted(msg.writeset))
         return pack(canonical)
+
+    def pre_exec_conflicted(self, client_id: int, req_seq: int,
+                            original_request: bytes,
+                            result: bytes) -> bool:
+        """Commit-time read-set watermark re-validation (the execution
+        lane calls this before applying a pre-executed result): the
+        speculation ran over an older snapshot — any readset key
+        versioned past the request's read watermark invalidates it.
+        Advisory for the replica's fallback decision; _execute_write
+        repeats the scan under the lock because it is load-bearing for
+        the PLAIN ordering path too (readset point reads — cheap)."""
+        try:
+            msg = unpack(result)
+        except ser.SerializeError:
+            return False
+        if not isinstance(msg, WriteRequest) or not msg.readset:
+            return False
+        with self._lock:
+            return self._readset_stale(msg)
 
     def apply_pre_executed(self, client_id: int, req_seq: int, flags: int,
                            original_request: bytes,

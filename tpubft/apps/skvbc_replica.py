@@ -70,7 +70,14 @@ def build_replica(args, comm_wrapper=None) -> KvbcReplica:
     db_path = (os.path.join(args.db_dir, f"replica-{args.replica}.kvlog")
                if args.db_dir else None)
     agg = Aggregator()
+    handler_factory = None
+    if getattr(args, "merkle", False):
+        # provable state for the thin-replica serving tier: kv lives in
+        # a BLOCK_MERKLE category so every read has an audit path
+        from tpubft.apps.skvbc import SkvbcHandler
+        handler_factory = lambda bc: SkvbcHandler(bc, merkle=True)  # noqa: E731
     return KvbcReplica(cfg, keys, comm, db_path=db_path, aggregator=agg,
+                       handler_factory=handler_factory,
                        thin_replica_port=args.trs_port)
 
 
@@ -114,6 +121,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--crypto-backend", default="cpu",
                    choices=("cpu", "tpu", "auto"))
     p.add_argument("--pre-execution", action="store_true")
+    p.add_argument("--merkle", action="store_true",
+                   help="keep SKVBC state in a BLOCK_MERKLE category so "
+                        "the thin-replica tier serves provable reads")
     p.add_argument("--fault-port", type=int, default=None,
                    help="per-link fault-injection control port "
                         "(Apollo iptables-partitioning analog)")

@@ -78,9 +78,12 @@ class CompletedRun:
     n_requests: int                       # executed (non-dedup) requests
     replies: List[Tuple[int, bytes]] = field(default_factory=list)
     reply_keys: List[Tuple[int, int]] = field(default_factory=list)
-    # (seq, state_digest, pages_digest) when `last` is a checkpoint
-    # boundary — snapshotted at the boundary, before the next run ran
-    checkpoint: Optional[Tuple[int, bytes, bytes]] = None
+    # (seq, state_digest, pages_digest, block_id) when `last` is a
+    # checkpoint boundary — snapshotted at the boundary, before the
+    # next run ran. block_id is the ledger height the state digest
+    # binds (None for non-ledger handlers) — the thin-replica anchor
+    # needs it to resolve a certified digest to a block row.
+    checkpoint: Optional[Tuple[int, bytes, bytes, Optional[int]]] = None
 
 
 @dataclass
@@ -651,8 +654,12 @@ class ExecutionLane:
                     if r.state_transfer is not None:
                         r.state_transfer.on_checkpoint_created(
                             result.last, state_digest)
+                    # ledger height snapshotted WITH the digest (same
+                    # thread, same boundary): resolves the certified
+                    # digest to a block for the thin-replica anchor
+                    head = getattr(blockchain, "last_block_id", None)
                     result.checkpoint = (result.last, state_digest,
-                                         r.res_pages.digest())
+                                         r.res_pages.digest(), head)
                 except Exception:  # noqa: BLE001 — skip OUR checkpoint
                     # vote for this boundary; peers' quorum can still
                     # certify it, and re-executing the run would be

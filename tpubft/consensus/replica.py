@@ -62,6 +62,7 @@ from tpubft.utils import flight
 from tpubft.utils.config import ReplicaConfig
 from tpubft.utils.logging import get_logger, mdc_scope
 from tpubft.utils.metrics import Aggregator, Component
+from tpubft.utils.racecheck import make_lock
 
 log = get_logger("replica")
 
@@ -109,6 +110,16 @@ class IRequestsHandler(abc.ABC):
         """Commit a pre-executed result, re-checking conflicts against
         current state. Default: execute the original normally."""
         return self.execute(client_id, req_seq, flags, original_request)
+
+    def pre_exec_conflicted(self, client_id: int, req_seq: int,
+                            original_request: bytes,
+                            result: bytes) -> bool:
+        """Commit-time conflict check for a pre-executed result: True
+        when the result's read set is stale against CURRENT state (it
+        was computed over an older snapshot) — the replica then falls
+        back to ordering the original request normally in the same
+        slot. Must be side-effect free. Default: never conflicted."""
+        return False
 
 
 class Replica(IReceiver):
@@ -431,11 +442,37 @@ class Replica(IReceiver):
         # reference: ReplicaForStateTransfer owning an IStateTransfer)
         self.state_transfer = None
 
-        # pre-execution (reference src/preprocessor/, gated on config)
+        # pre-execution (reference src/preprocessor/, gated on config).
+        # The `preexec` metrics component exists whenever the plane can
+        # be exercised — conflict/fallback counters tick from the
+        # execution path even on replicas that only APPLY pre-executed
+        # results
+        self.preexec_metrics = Component("preexec", self.aggregator)
+        self.m_preexec_conflicts = self.preexec_metrics.register_counter(
+            "preexec_conflicts")
+        self.m_preexec_applied = self.preexec_metrics.register_counter(
+            "preexec_applied")
         self.preprocessor = None
         if cfg.pre_execution_enabled:
             from tpubft.preprocessor import PreProcessor
-            self.preprocessor = PreProcessor(self)
+            self.preprocessor = PreProcessor(
+                self, num_threads=cfg.preexec_threads)
+
+        # thin-replica read tier (reference thin-replica-server, gated
+        # on config): reads/subscriptions served off the consensus path,
+        # fed once per sealed run from the ledger commit stream, with
+        # the f+1-signed checkpoint anchor published from
+        # _store_checkpoint so clients can digest-verify every read.
+        # The anchor snapshot crosses threads (dispatcher publishes,
+        # thin-replica handler threads serve) — guarded by _trs_mu.
+        self.thin_replica = None
+        self._trs_mu = make_lock("trs.anchor")
+        self._trs_anchor: Optional[tuple] = None
+        # state_digest -> ledger height at our own checkpoint boundaries
+        # (bounded; resolves a certified digest to a servable block row)
+        self._ckpt_blocks: Dict[bytes, int] = {}
+        if cfg.thin_replica_enabled:
+            self.attach_thin_replica(port=cfg.thin_replica_port)
 
         # reserved pages + the subsystems riding them (internal client,
         # key exchange, time service, cron)
@@ -715,6 +752,8 @@ class Replica(IReceiver):
             self.exec_lane.start()
         if self.admission is not None:
             self.admission.start()
+        if self.thin_replica is not None:
+            self.thin_replica.start()
         self.health.start()
         self.dispatcher.start()
         with mdc_scope(r=self.id):       # start() runs on the caller thread
@@ -737,6 +776,8 @@ class Replica(IReceiver):
             self.exec_lane.stop()
         if self.admission is not None:
             self.admission.stop()
+        if self.thin_replica is not None:
+            self.thin_replica.stop()
         self.health.stop()
         self.dispatcher.stop()
         self.collector_pool.shutdown()
@@ -746,6 +787,48 @@ class Replica(IReceiver):
         if self.preprocessor:
             self.preprocessor.shutdown()
         self.comm.stop()
+
+    # ------------------------------------------------------------------
+    # thin-replica serving plane
+    # ------------------------------------------------------------------
+    def attach_thin_replica(self, port: int = 0,
+                            host: str = "127.0.0.1"):
+        """Create (idempotently) the thin-replica server over the
+        handler's ledger, wired to the commit stream and this replica's
+        quorum-signed checkpoint anchor. Started by start() (or
+        immediately when the replica is already running)."""
+        if self.thin_replica is not None:
+            return self.thin_replica
+        bc = getattr(self.handler, "blockchain", None)
+        if bc is None:
+            log.warning("thin_replica_enabled but the handler has no "
+                        "blockchain — read tier inactive")
+            return None
+        from tpubft.thinreplica import ThinReplicaServer
+        self.thin_replica = ThinReplicaServer(
+            bc, host=host, port=port,
+            sub_buffer=self.cfg.thin_replica_sub_buffer,
+            aggregator=self.aggregator,
+            anchor_fn=self.thin_replica_anchor)
+        # __init__-time attach runs before _running exists; start()
+        # brings the server up then
+        if getattr(self, "_running", False):
+            self.thin_replica.start()
+        return self.thin_replica
+
+    def thin_replica_anchor(self) -> Optional[tuple]:
+        """(ckpt_seq, block_id, [packed CheckpointMsg...]) snapshot for
+        the thin-replica server — called from its handler threads; the
+        dispatcher publishes via _publish_trs_anchor."""
+        with self._trs_mu:
+            return self._trs_anchor
+
+    def _publish_trs_anchor(self, seq: int, block_id: int,
+                            certs: tuple) -> None:
+        with self._trs_mu:
+            cur = self._trs_anchor
+            if cur is None or seq > cur[0]:
+                self._trs_anchor = (seq, block_id, certs)
 
     @property
     def is_primary(self) -> bool:
@@ -1890,6 +1973,28 @@ class Replica(IReceiver):
                 orig, result = unpack_preprocessed(req.request)
             except Exception:  # noqa: BLE001 — malformed wrapper
                 return b""
+            # conflict detection at commit (reference verifyWriteCommand
+            # at execution): re-validate the pre-executed result's
+            # read-set version watermark against CURRENT state — the
+            # speculation ran against an older snapshot. On conflict the
+            # request falls back to NORMAL ORDERING: the original
+            # request executes in this same committed slot (identical
+            # total-order position, so ledgers stay byte-identical with
+            # a pure-ordering run), and the flight event + counter make
+            # the conflict rate observable for tuning.
+            try:
+                conflicted = self.handler.pre_exec_conflicted(
+                    orig.sender_id, orig.req_seq_num, orig.request,
+                    result)
+            except Exception:  # noqa: BLE001 — advisory check only
+                conflicted = False
+            if conflicted:
+                flight.record(flight.EV_PREEXEC_CONFLICT, seq=seq)
+                self.m_preexec_conflicts.inc()
+                return self.handler.execute(
+                    orig.sender_id, orig.req_seq_num, orig.flags,
+                    orig.request)
+            self.m_preexec_applied.inc()
             return self.handler.apply_pre_executed(
                 orig.sender_id, orig.req_seq_num, orig.flags,
                 orig.request, result)
@@ -2131,9 +2236,10 @@ class Replica(IReceiver):
             for seq in range(run.first, run.last + 1):
                 flight.record(flight.EV_REPLY, seq=seq)
             if run.checkpoint is not None:
-                seq, state_digest, pages_digest = run.checkpoint
+                seq, state_digest, pages_digest, height = run.checkpoint
                 self._send_checkpoint(seq, state_digest=state_digest,
-                                      pages_digest=pages_digest)
+                                      pages_digest=pages_digest,
+                                      block_id=height)
         self._maybe_announce_restart_ready()
         self._try_send_pre_prepare()
         if repump:
@@ -2416,19 +2522,29 @@ class Replica(IReceiver):
     # ------------------------------------------------------------------
     def _send_checkpoint(self, seq: int,
                          state_digest: Optional[bytes] = None,
-                         pages_digest: Optional[bytes] = None) -> None:
+                         pages_digest: Optional[bytes] = None,
+                         block_id: Optional[int] = None) -> None:
         """Broadcast our checkpoint for `seq`. The digests may be passed
         in by the execution lane, which snapshots them AT the run
         boundary (before the next run mutates state) — computing them
         here would race the executor. The inline path computes them now
-        (nothing executes concurrently there)."""
+        (nothing executes concurrently there). `block_id` is the ledger
+        height the state digest binds — remembered so the thin-replica
+        anchor can resolve a certified digest to a servable block."""
         if state_digest is None:
             state_digest = self.handler.state_digest()
+            bc = getattr(self.handler, "blockchain", None)
+            if bc is not None and block_id is None:
+                block_id = bc.last_block_id   # inline path: same thread
             if self.state_transfer is not None:
                 # snapshot NOW — this is the state the cert will bind
                 self.state_transfer.on_checkpoint_created(seq, state_digest)
         if pages_digest is None:
             pages_digest = self.res_pages.digest()
+        if block_id is not None:
+            self._ckpt_blocks[state_digest] = block_id
+            while len(self._ckpt_blocks) > 8:
+                del self._ckpt_blocks[next(iter(self._ckpt_blocks))]
         ck = m.CheckpointMsg(sender_id=self.id, seq_num=seq,
                              state_digest=state_digest,
                              is_stable=False, epoch=self.epoch,
@@ -2510,6 +2626,19 @@ class Replica(IReceiver):
         matching = sum(1 for other in slot.values()
                        if other.state_digest == ck.state_digest
                        and other.res_pages_digest == ck.res_pages_digest)
+        # thin-replica anchor: f+1 matching SIGNED digests — at least
+        # one honest replica vouches — and we know which ledger height
+        # the digest binds (our own checkpoint at that state). Publish
+        # the cert set for untrusted thin-replica clients to verify.
+        if matching >= self.info.st_anchor_quorum \
+                and self.thin_replica is not None:
+            height = self._ckpt_blocks.get(ck.state_digest)
+            if height is not None:
+                certs = tuple(
+                    other.pack() for other in slot.values()
+                    if other.state_digest == ck.state_digest
+                    and other.res_pages_digest == ck.res_pages_digest)
+                self._publish_trs_anchor(ck.seq_num, height, certs)
         if matching >= self.info.st_anchor_quorum \
                 and ck.seq_num > self.last_executed:
             # f+1 matching signed digests = at least one honest vouches:

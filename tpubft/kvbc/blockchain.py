@@ -179,6 +179,12 @@ class BlockStoreMixin:
         self._genesis = int.from_bytes(gen, "big") if gen else 0
         self._listeners: List[Callable[[int, "cat.BlockUpdates"],
                                        None]] = []
+        # run listeners see one call per ATOMIC COMMIT (a coalesced
+        # execution run, a bulk add_blocks, a link segment) with the
+        # whole batch of (block_id, updates) — the thin-replica feed
+        # pays one publish hop per sealed run, not one per block
+        self._run_listeners: List[Callable[
+            [List[Tuple[int, "cat.BlockUpdates"]]], None]] = []
         # serializes the two users of the staged-read redirect — the
         # execution lane's block accumulation (executor thread) and
         # state-transfer linking (dispatcher thread). Held across
@@ -215,12 +221,31 @@ class BlockStoreMixin:
                      fn: Callable[[int, "cat.BlockUpdates"], None]) -> None:
         self._listeners.append(fn)
 
+    def add_run_listener(self, fn: Callable[
+            [List[Tuple[int, "cat.BlockUpdates"]]], None]) -> None:
+        """Commit-stream listener at RUN granularity: `fn(items)` fires
+        once per atomic commit with every (block_id, updates) it sealed,
+        in order. A single add_block is a run of one."""
+        self._run_listeners.append(fn)
+
     def _notify(self, block_id: int, updates: "cat.BlockUpdates") -> None:
-        for fn in self._listeners:
+        self._notify_run([(block_id, updates)])
+
+    def _notify_run(self,
+                    items: List[Tuple[int, "cat.BlockUpdates"]]) -> None:
+        if not items:
+            return
+        for fn in self._run_listeners:
             try:
-                fn(block_id, updates)
+                fn(items)
             except Exception:  # noqa: BLE001 — listeners must not break commit
                 pass
+        for block_id, updates in items:
+            for fn in self._listeners:
+                try:
+                    fn(block_id, updates)
+                except Exception:  # noqa: BLE001 — see above
+                    pass
 
     # ---- write path ----
     def add_block(self, updates: "cat.BlockUpdates") -> int:
@@ -314,8 +339,7 @@ class BlockStoreMixin:
         if self._last and self._genesis == 0:
             self._genesis = 1
         self._staging_mu.release()
-        for block_id, updates in acc.notifications:
-            self._notify(block_id, updates)
+        self._notify_run(acc.notifications)
         return self._last
 
     def abort_accumulation(self) -> None:
@@ -499,8 +523,7 @@ class BlockStoreMixin:
                 self._last = adopted[-1][0]
                 if self._genesis == 0:
                     self._genesis = 1
-                for block_id, updates in adopted:
-                    self._notify(block_id, updates)
+                self._notify_run(adopted)
 
         while error is None:
             # one segment at a time under the staging lock: the
@@ -680,8 +703,7 @@ class KeyValueBlockchain(BlockStoreMixin):
             self._last = first + len(updates_list) - 1
             if self._genesis == 0:
                 self._genesis = 1
-        for bid, bu in last_notified:
-            self._notify(bid, bu)
+        self._notify_run(last_notified)
         return self._last
 
     # ---- categorized reads ----

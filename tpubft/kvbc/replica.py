@@ -82,6 +82,11 @@ class KvbcReplica:
         # vice versa. Splitting pages into their own DB silently
         # downgrades that to two ordered batches.
         pages = ReservedPages(self.db)
+        if thin_replica_port is not None:
+            # the CLI port must win over cfg.thin_replica_port even
+            # when thin_replica_enabled makes the Replica constructor
+            # attach the server itself
+            cfg.thin_replica_port = thin_replica_port
         self.replica = Replica(cfg, keys, comm, self.handler,
                                storage=DBPersistentStorage(self.db),
                                aggregator=aggregator,
@@ -104,19 +109,20 @@ class KvbcReplica:
             blockchain=self.blockchain, db=self.db,
             db_checkpoint_dir=ckpt_dir))
 
-        self.thin_replica_server = None
-        if thin_replica_port is not None:
-            from tpubft.thinreplica import ThinReplicaServer
-            self.thin_replica_server = ThinReplicaServer(
-                self.blockchain, port=thin_replica_port)
+        # thin-replica read tier: the consensus Replica owns the server
+        # (commit-stream feed + signed checkpoint anchor + metrics live
+        # there). The explicit port arg (process CLI --trs-port) wins:
+        # it is written into cfg BEFORE the Replica constructor runs
+        # (see above), so a thin_replica_enabled config attaches at the
+        # CLI port; without the knob, attach explicitly here.
+        if thin_replica_port is not None \
+                and self.replica.thin_replica is None:
+            self.replica.attach_thin_replica(port=thin_replica_port)
+        self.thin_replica_server = self.replica.thin_replica
 
     def start(self) -> None:
         self.replica.start()
-        if self.thin_replica_server is not None:
-            self.thin_replica_server.start()
 
     def stop(self) -> None:
-        if self.thin_replica_server is not None:
-            self.thin_replica_server.stop()
         self.replica.stop()
         self.db.close()

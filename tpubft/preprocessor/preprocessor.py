@@ -2,11 +2,13 @@
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
 from tpubft.consensus import messages as m
+from tpubft.utils import flight
 from tpubft.utils import serialize as ser
 
 
@@ -36,8 +38,24 @@ class PreProcessor:
                                         thread_name_prefix="preexec")
         self._sessions: Dict[Tuple[int, int], _Session] = {}
         # backup-side reply cache: (client, req_seq, retry_id) -> packed
-        # PreProcessReplyMsg — rebroadcasts must not re-execute the app
-        self._reply_cache: Dict[Tuple[int, int, int], bytes] = {}
+        # PreProcessReplyMsg — rebroadcasts must not re-execute the app.
+        # Bounded LRU (the SigManager verify-memo discipline): real
+        # client traffic over millions of principals must not grow it
+        # without bound; hits refresh recency, inserts evict the oldest.
+        self._reply_cache: "OrderedDict[Tuple[int, int, int], bytes]" = \
+            OrderedDict()
+        self._reply_cache_max = max(
+            1, getattr(replica.cfg, "preexec_reply_cache_max", 512))
+        # metrics ride the replica's `preexec` component (conflict /
+        # apply counters already live there, ticked by the exec path)
+        comp = replica.preexec_metrics
+        self.m_sessions = comp.register_counter("preexec_sessions")
+        self.m_agreed = comp.register_counter("preexec_agreed")
+        self.m_fallbacks = comp.register_counter("preexec_fallbacks")
+        self.m_cache_hits = comp.register_counter(
+            "preexec_reply_cache_hits")
+        self.m_cache_evictions = comp.register_counter(
+            "preexec_reply_cache_evictions")
         self._retry_counter = 0
         # primary-side broadcast micro-batching: sessions created while
         # one external message is being handled (e.g. the elements of a
@@ -53,6 +71,21 @@ class PreProcessor:
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False)
+
+    # ---- bounded reply cache (dispatcher-thread only) ----
+    def _cache_get(self, key: Tuple[int, int, int]) -> Optional[bytes]:
+        raw = self._reply_cache.get(key)
+        if raw is not None:
+            self._reply_cache.move_to_end(key)
+            self.m_cache_hits.inc()
+        return raw
+
+    def _cache_put(self, key: Tuple[int, int, int], raw: bytes) -> None:
+        self._reply_cache[key] = raw
+        self._reply_cache.move_to_end(key)
+        while len(self._reply_cache) > self._reply_cache_max:
+            self._reply_cache.popitem(last=False)
+            self.m_cache_evictions.inc()
 
     # ------------------------------------------------------------------
     # primary side
@@ -80,6 +113,7 @@ class PreProcessor:
                         started=time.monotonic(),
                         last_broadcast=time.monotonic())
         self._sessions[key] = sess
+        self.m_sessions.inc()
         # defer the broadcast to the flush point: sessions created while
         # this dispatcher turn runs (a client batch admits its elements
         # in one loop) ship as ONE grouped wire message per client
@@ -131,6 +165,8 @@ class PreProcessor:
         """Run handler.pre_execute on the pool; result re-enters the
         dispatcher as an internal msg (launchAsyncReqPreProcessingJob)."""
         handler = self.replica.handler
+        flight.record(flight.EV_PREEXEC_LAUNCH, seq=req.req_seq_num,
+                      arg=retry_id)
 
         def job():
             try:
@@ -159,6 +195,7 @@ class PreProcessor:
                 # the request untouched (flags are client-signed)
                 sess.done = True
                 del self._sessions[key]
+                self.m_fallbacks.inc()
                 self.replica._admit_request(req)
                 return
             sess.my_result = result
@@ -179,9 +216,7 @@ class PreProcessor:
                 req_seq_num=key[1], retry_id=retry_id,
                 result_digest=digest, status=status, signature=sig)
             raw = reply.pack()
-            self._reply_cache[(key[0], key[1], retry_id)] = raw
-            if len(self._reply_cache) > 512:
-                self._reply_cache.pop(next(iter(self._reply_cache)))
+            self._cache_put((key[0], key[1], retry_id), raw)
             if group is not None:
                 self._fold_group_reply(group, raw, reply_to)
             else:
@@ -233,8 +268,8 @@ class PreProcessor:
                               msg: m.PreProcessRequestMsg) -> None:
         if sender != self.replica.primary:
             return
-        cached = self._reply_cache.get((msg.client_id, msg.req_seq_num,
-                                        msg.retry_id))
+        cached = self._cache_get((msg.client_id, msg.req_seq_num,
+                                  msg.retry_id))
         if cached is not None:
             self.replica.comm.send(sender, cached)
             return
@@ -265,8 +300,8 @@ class PreProcessor:
             return                      # duplicate batch delivery
         cached_raws, todo = [], []
         for ppr in elements:
-            cached = self._reply_cache.get((ppr.client_id, ppr.req_seq_num,
-                                            ppr.retry_id))
+            cached = self._cache_get((ppr.client_id, ppr.req_seq_num,
+                                      ppr.retry_id))
             if cached is not None:
                 cached_raws.append(cached)
                 continue
@@ -332,6 +367,9 @@ class PreProcessor:
             return
         sess.done = True
         del self._sessions[key]
+        self.m_agreed.inc()
+        flight.record(flight.EV_PREEXEC_AGREE, seq=key[1],
+                      arg=len(agreeing))
         envelope = m.PreProcessResult(
             original=sess.original.pack(), result=sess.my_result,
             signatures=sorted(agreeing)[:quorum])

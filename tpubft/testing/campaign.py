@@ -695,6 +695,74 @@ def scenario_crashpoint_vc_persist(ctx: ScenarioContext) -> dict:
     return {"recovery_s": round(recovery, 3)}
 
 
+def scenario_thin_replica_failover(ctx: ScenarioContext) -> dict:
+    """Read-tier failover: a thin-replica subscriber streams digest-
+    verified updates (every block needs f+1 server agreement) while the
+    cluster orders PRE-EXECUTED writes; its DATA server's replica is
+    killed mid-stream. The client must rotate to a surviving replica
+    and catch up — every committed block delivered exactly once, in
+    order, with the committed bytes (no gap, no dup, no divergence)."""
+    from tpubft.apps import skvbc
+    from tpubft.kvbc import KeyValueBlockchain
+    from tpubft.storage.memorydb import MemoryDB
+    from tpubft.testing.cluster import InProcessCluster
+    from tpubft.thinreplica import ThinReplicaClient
+
+    def handler_factory(_r):
+        return skvbc.SkvbcHandler(
+            KeyValueBlockchain(MemoryDB(), use_device_hashing=False),
+            merkle=True)
+
+    n_pre = ctx.randint("writes_before", 3, 5)
+    n_post = ctx.randint("writes_after", 3, 5)
+    writes = [(b"k%03d" % i, b"v%d" % ctx.randint(f"val{i}", 1, 999))
+              for i in range(n_pre + n_post)]
+    victim = 1          # the subscriber's data source; NOT the primary —
+    # the scenario isolates read-tier failover from ordering failover
+    # (the primary-kill paths have their own scenarios)
+    ctx.event("kill_data_server", replica=victim)
+    overrides = dict(_FAST_VC, thin_replica_enabled=True,
+                     pre_execution_enabled=True)
+    with InProcessCluster(f=1, seed=ctx.cluster_seed(),
+                          handler_factory=handler_factory,
+                          cfg_overrides=overrides) as cluster:
+        kv = skvbc.SkvbcClient(cluster.client(0))
+        got: List[tuple] = []
+        # data source = victim first, survivors as hash servers/fallback
+        eps = [("127.0.0.1", cluster.replicas[r].thin_replica.port)
+               for r in (victim, 2, 3, 0)]
+        trc = ThinReplicaClient(eps, f_val=1)
+        trc.STALL_TIMEOUT_S = 1.0
+        trc.subscribe(lambda b, kvs: got.append((b, dict(kvs))),
+                      start_block=1)
+        for k, v in writes[:n_pre]:
+            assert kv.write([(k, v)], pre_process=True,
+                            timeout_ms=30000).success
+        ctx.wait_until(lambda: len(got) >= n_pre, 20,
+                       what="subscriber streamed the pre-kill blocks")
+        cluster.kill(victim)            # SIGKILL analog: server vanishes
+        t0 = time.monotonic()
+        for k, v in writes[n_pre:]:
+            assert kv.write([(k, v)], pre_process=True,
+                            timeout_ms=30000).success
+        total = len(writes)
+        ctx.wait_until(lambda: len(got) >= total, 30,
+                       what="subscriber caught up after data-server kill")
+        recovery = time.monotonic() - t0
+        trc.stop()
+        blocks = [b for b, _ in got]
+        assert blocks == list(range(1, total + 1)), \
+            f"gap/dup/disorder in the resumed stream: {blocks}"
+        for i, (k, v) in enumerate(writes):
+            assert got[i][1] == {k: v}, \
+                f"divergence at block {i + 1}: {got[i][1]}"
+        # the pre-execution plane really carried the writes
+        agreed = cluster.metric(0, "counters", "preexec_agreed",
+                                component="preexec")
+    return {"recovery_s": round(recovery, 3), "blocks": total,
+            "preexec_agreed": agreed}
+
+
 def smoke_matrix() -> List[ScenarioSpec]:
     return [
         ScenarioSpec("wrong-digest-primary", scenario_wrong_digest_primary,
@@ -714,6 +782,10 @@ def smoke_matrix() -> List[ScenarioSpec]:
                      "inproc", 90, tags=("byzantine", "combine")),
         ScenarioSpec("crash-restart-replay", scenario_crash_restart_replay,
                      "inproc", 60, tags=("recovery",)),
+        ScenarioSpec("thin-replica-failover",
+                     scenario_thin_replica_failover,
+                     "inproc", 90, tags=("crash", "read-tier",
+                                         "pre-execution")),
         ScenarioSpec("crashpoint-exec-post-apply",
                      scenario_crashpoint_exec_post_apply,
                      "inproc", 60, tags=("crashpoint", "recovery")),

@@ -78,6 +78,7 @@ class BftTestNetwork:
                  threshold_scheme: str = "multisig-ed25519",
                  client_sig_scheme: str = "ed25519",
                  device_min_verify_batch: Optional[int] = None,
+                 merkle: bool = False,
                  cfg_overrides: Optional[dict] = None) -> None:
         self.f, self.c = f, c
         self.n = 3 * f + 2 * c + 1
@@ -99,6 +100,8 @@ class BftTestNetwork:
         self.threshold_scheme = threshold_scheme
         self.client_sig_scheme = client_sig_scheme
         self.device_min_verify_batch = device_min_verify_batch
+        self.merkle = merkle     # BLOCK_MERKLE skvbc state (provable
+        # reads for the thin-replica tier)
         # arbitrary ReplicaConfig fields, forwarded to every replica
         # process as --config-override FIELD=VALUE
         self.cfg_overrides = dict(cfg_overrides or {})
@@ -211,6 +214,8 @@ class BftTestNetwork:
             args += ["--certs-dir", self.certs_dir]
         if self.pre_execution:
             args += ["--pre-execution"]
+        if self.merkle:
+            args += ["--merkle"]
         if self.db_dir:
             args += ["--db-dir", self.db_dir]
         # per-replica log files (Apollo keeps logs under

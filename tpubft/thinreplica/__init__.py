@@ -15,7 +15,8 @@ The kvbc_app_filter role (client-visible event filtering + hashing) is
 FilterSpec: category + key-prefix selection with a canonical per-block
 update hash.
 """
-from tpubft.thinreplica.client import ThinReplicaClient
+from tpubft.thinreplica.client import ThinReplicaClient, keys_cert_verifier
 from tpubft.thinreplica.server import FilterSpec, ThinReplicaServer
 
-__all__ = ["ThinReplicaServer", "ThinReplicaClient", "FilterSpec"]
+__all__ = ["ThinReplicaServer", "ThinReplicaClient", "FilterSpec",
+           "keys_cert_verifier"]

@@ -20,6 +20,20 @@ from tpubft.thinreplica import messages as tm
 Endpoint = Tuple[str, int]
 
 
+def keys_cert_verifier(keys) -> Callable[[int, bytes, bytes], bool]:
+    """cert_verifier over ClusterKeys: verify a replica's CheckpointMsg
+    signature with its registered public key (cached per replica)."""
+    cache: Dict[int, object] = {}
+
+    def verify(replica_id: int, payload: bytes, sig: bytes) -> bool:
+        v = cache.get(replica_id)
+        if v is None:
+            v = cache[replica_id] = keys.verifier_of(replica_id)
+        return v.verify(payload, sig)
+
+    return verify
+
+
 class _Conn:
     def __init__(self, ep: Endpoint, timeout: float = 5.0) -> None:
         self.sock = socket.create_connection(ep, timeout=timeout)
@@ -63,12 +77,23 @@ class _Conn:
 
 class ThinReplicaClient:
     def __init__(self, endpoints: List[Endpoint], f_val: int,
-                 key_prefix: bytes = b"") -> None:
-        if len(endpoints) < f_val + 1:
+                 key_prefix: bytes = b"",
+                 cert_verifier: Optional[Callable[[int, bytes, bytes],
+                                                  bool]] = None) -> None:
+        if len(endpoints) < f_val + 1 and cert_verifier is None:
+            # the QUORUM paths (read_state / verified_proof / subscribe)
+            # compare f+1 servers; the checkpoint-anchored path draws
+            # its trust from f+1 SIGNATURES instead and can run against
+            # a single untrusted server
             raise ValueError("need at least f+1 thin-replica servers")
         self.endpoints = endpoints
         self.f = f_val
         self.key_prefix = key_prefix
+        # (replica_id, signed_payload, signature) -> bool: how this
+        # client checks CheckpointMsg signatures for the anchor path
+        # (wire it to ClusterKeys.verifier_of / a SigManager); without
+        # it only the f+1 cross-server quorum APIs are available
+        self.cert_verifier = cert_verifier
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
@@ -79,6 +104,16 @@ class ThinReplicaClient:
         self._callback: Optional[Callable] = None
         self._generation = 0
         self._last_progress = 0.0
+        # ---- checkpoint-anchored verified chain (anchor path) ----
+        self._anchor_lock = threading.Lock()
+        # per-server rpc locks: a slow/dead server must not stall
+        # requests riding OTHER servers (failover is the point)
+        self._rpc_locks = [threading.Lock() for _ in endpoints]
+        self._rpc_conns: Dict[int, _Conn] = {}
+        self._digests: Dict[int, bytes] = {}     # verified id -> digest
+        self._headers: Dict[int, object] = {}    # verified id -> Block
+        self._anchor_high: Optional[int] = None  # newest anchored block
+        self._anchor_seq = 0                     # its checkpoint seqnum
 
     # ---- one-shot state read with hash verification ----
     def read_state(self) -> Dict[bytes, bytes]:
@@ -119,6 +154,8 @@ class ThinReplicaClient:
         answering ProtocolError('ahead') is still catching up and gets
         retried until the deadline. True once f votes are in (f+1 total
         with the data server ⇒ at least one honest replica agrees)."""
+        if len(self.endpoints) < self.f + 1:
+            raise ValueError("quorum path needs f+1 servers")
         votes = 0
         deadline = time.monotonic() + 10
         pending = list(self.endpoints[1:])
@@ -188,6 +225,233 @@ class ThinReplicaClient:
             raise ValueError("value does not match proven hash")
         return vh
 
+    # ------------------------------------------------------------------
+    # checkpoint-anchored reads (the read-scaling serving path)
+    #
+    # Trust model: ONE AnchorRequest returns f+1 CheckpointMsgs signed
+    # by distinct replicas over the same state digest — at least one
+    # honest replica vouches, so the digest (and the block row hashing
+    # to it) is authentic. From that anchor the parent-digest hash
+    # chain authenticates every EARLIER block, and each block row
+    # carries its categories' merkle roots; a read then needs only a
+    # single untrusted server: proof + value verify locally against the
+    # anchored root, no per-read quorum round trips. Later blocks
+    # become readable by rolling the anchor forward to a NEWER
+    # certificate set (hash chains do not authenticate forward).
+    # ------------------------------------------------------------------
+    ANCHOR_SCAN_LIMIT = 512      # max backward header walk per read
+
+    def _rpc(self, server: int, msg):
+        """Request/reply over a PERSISTENT per-server connection (the
+        server pipelines these frames): the read hot path must not pay
+        a TCP handshake per read. One reconnect retry on a dead conn."""
+        with self._rpc_locks[server]:
+            for attempt in (0, 1):
+                c = self._rpc_conns.get(server)
+                if c is None:
+                    c = self._rpc_conns[server] = _Conn(
+                        self.endpoints[server])
+                try:
+                    c.send(msg)
+                    reply = c.recv()
+                except OSError:
+                    reply = None
+                if reply is not None:
+                    return reply
+                c.close()
+                self._rpc_conns.pop(server, None)
+                if attempt:
+                    raise ConnectionError(
+                        f"thin-replica server {server} unreachable")
+
+    def fetch_anchor(self, server: int = 0) -> Optional[int]:
+        """Fetch + verify the server's newest quorum-signed checkpoint
+        anchor. Returns the anchored block id (None if the server has
+        no anchor yet — e.g. before the first checkpoint window
+        closes). Raises ValueError on any verification failure."""
+        import hashlib
+
+        from tpubft.consensus import messages as cm
+        from tpubft.kvbc.blockchain import Block
+        from tpubft.utils import serialize as ser
+        if self.cert_verifier is None:
+            raise ValueError("anchor path needs a cert_verifier")
+        reply = self._rpc(server, tm.AnchorRequest())
+        if isinstance(reply, tm.ProtocolError):
+            return None if reply.reason in ("no anchor", "pruned") else \
+                self._anchor_fail(f"anchor error: {reply.reason}")
+        if not isinstance(reply, tm.AnchorReply):
+            self._anchor_fail(f"bad anchor reply: {reply!r}")
+        # 1. f+1 valid signatures from DISTINCT replicas over one digest
+        digests = set()
+        signers = set()
+        for raw in reply.certs:
+            try:
+                ck = cm.unpack(raw)
+            except cm.MsgError:
+                continue
+            if not isinstance(ck, cm.CheckpointMsg) \
+                    or ck.seq_num != reply.ckpt_seq \
+                    or ck.sender_id in signers:
+                continue
+            try:
+                if not self.cert_verifier(ck.sender_id,
+                                          ck.signed_payload(),
+                                          ck.signature):
+                    continue
+            except Exception:  # noqa: BLE001 — unknown signer etc.
+                continue
+            signers.add(ck.sender_id)
+            digests.add(ck.state_digest)
+        if len(signers) < self.f + 1 or len(digests) != 1:
+            self._anchor_fail(
+                f"anchor quorum not reached: {len(signers)} valid "
+                f"certs over {len(digests)} digests (need {self.f + 1} "
+                f"over 1)")
+        state_digest = digests.pop()
+        # 2. the block row must HASH to the certified digest
+        if hashlib.sha256(reply.block_raw).digest() != state_digest:
+            self._anchor_fail("anchor block does not hash to the "
+                              "certified state digest")
+        blk = ser.decode_msg(reply.block_raw, Block)
+        if blk.block_id != reply.block_id:
+            self._anchor_fail("anchor block id mismatch")
+        # 3. install (monotone; equivocation across anchors is fatal)
+        blk.updates_blob = b""      # digest already checked; only
+        # parent_digest + category_digests are read from stored headers
+        with self._anchor_lock:
+            prev = self._digests.get(blk.block_id)
+            if prev is not None and prev != state_digest:
+                self._anchor_fail(
+                    f"anchor equivocation at block {blk.block_id}")
+            self._digests[blk.block_id] = state_digest
+            self._headers[blk.block_id] = blk
+            if self._anchor_high is None \
+                    or blk.block_id > self._anchor_high:
+                self._anchor_high = blk.block_id
+                self._anchor_seq = reply.ckpt_seq
+            self._prune_headers_locked()
+        return blk.block_id
+
+    def _prune_headers_locked(self) -> None:
+        """Bound client memory as the anchor rolls forward: verified
+        headers below the scan horizon are droppable — a later
+        historical read re-verifies them through the backward walk."""
+        horizon = (self._anchor_high or 0) - 2 * self.ANCHOR_SCAN_LIMIT
+        if horizon <= 0:
+            return
+        for b in [b for b in self._digests if b < horizon]:
+            del self._digests[b]
+            self._headers.pop(b, None)
+
+    @staticmethod
+    def _anchor_fail(msg: str) -> None:
+        raise ValueError(msg)
+
+    @property
+    def anchor_block(self) -> Optional[int]:
+        with self._anchor_lock:
+            return self._anchor_high
+
+    def _ensure_verified(self, block_id: int, server: int = 0) -> None:
+        """Extend the verified header chain BACKWARD to `block_id` by
+        walking parent digests down from the nearest verified block
+        above it. Caller must hold no lock; takes the anchor lock."""
+        import hashlib
+
+        from tpubft.kvbc.blockchain import Block
+        from tpubft.utils import serialize as ser
+        with self._anchor_lock:
+            if block_id in self._headers:
+                return
+            above = [b for b in self._digests if b > block_id]
+            if not above:
+                self._anchor_fail(
+                    f"block {block_id} is beyond the anchor — "
+                    f"fetch_anchor() a newer certificate set first")
+            frm = min(above)
+        for b in range(frm - 1, block_id - 1, -1):
+            with self._anchor_lock:
+                if b in self._headers:
+                    continue
+                want = self._headers[b + 1].parent_digest
+            reply = self._rpc(server, tm.BlockRequest(block_id=b))
+            if not isinstance(reply, tm.BlockReply) or not reply.raw:
+                self._anchor_fail(f"block {b} unavailable from server")
+            if hashlib.sha256(reply.raw).digest() != want:
+                self._anchor_fail(
+                    f"hash chain broken at block {b}: the served row "
+                    f"is not the parent of verified block {b + 1}")
+            blk = ser.decode_msg(reply.raw, Block)
+            if blk.block_id != b:
+                self._anchor_fail(f"block id mismatch at {b}")
+            blk.updates_blob = b""    # header fields only (see install)
+            with self._anchor_lock:
+                self._digests[b] = want
+                self._headers[b] = blk
+
+    def _root_for(self, category: str, block_id: int,
+                  server: int = 0) -> bytes:
+        """The category's merkle root AS OF `block_id`, from the
+        verified chain: the newest verified block <= block_id whose row
+        carries the category's digest (a block not touching the
+        category leaves its root where the previous writer put it)."""
+        for b in range(block_id, max(0, block_id
+                                     - self.ANCHOR_SCAN_LIMIT), -1):
+            self._ensure_verified(b, server)
+            with self._anchor_lock:
+                hdr = self._headers[b]
+            root = hdr.category_digests.get(category)
+            if root is not None:
+                return root
+        self._anchor_fail(
+            f"no {category!r} root within {self.ANCHOR_SCAN_LIMIT} "
+            f"verified blocks at or below {block_id}")
+
+    def verified_read(self, category: str, key: bytes,
+                      block_id: Optional[int] = None,
+                      server: int = 0) -> Optional[bytes]:
+        """Digest-authenticated single-server read: value of `key` as
+        of `block_id` (default: the anchor head), proven by a sparse-
+        merkle audit path against the ANCHORED root — no per-read
+        quorum. Returns the value (None = key absent at that block).
+        Raises ValueError on verification failure (forged proof, value,
+        or root) and LookupError when the proof verifies but the server
+        no longer holds the value bytes at that version (overwritten
+        since — retry at a newer anchor)."""
+        import hashlib
+
+        from tpubft.kvbc.sparse_merkle import Proof, SparseMerkleTree
+        with self._anchor_lock:
+            high = self._anchor_high
+        if high is None:
+            raise ValueError("no anchor: call fetch_anchor() first")
+        bid = block_id if block_id else high
+        if bid > high:
+            self._anchor_fail(
+                f"read at {bid} beyond anchor {high}: refresh the "
+                f"anchor (hash chains authenticate backward only)")
+        reply = self._rpc(server, tm.ReadProofRequest(
+            block_id=bid, category=category, key=key))
+        if not isinstance(reply, tm.ProofReply) or reply.block_id != bid:
+            raise ValueError(f"no proof for block {bid}: {reply!r}")
+        root = self._root_for(category, bid, server)
+        vh = reply.value_hash or None
+        if not SparseMerkleTree.verify(
+                root, key, vh,
+                Proof(bitmap=reply.bitmap, siblings=list(reply.siblings))):
+            raise ValueError("audit path does not reach the anchored "
+                             "root")
+        if vh is None:
+            return None
+        if not reply.value or hashlib.sha256(reply.value).digest() != vh:
+            if reply.value:
+                raise ValueError("served value does not match the "
+                                 "proven hash")
+            raise LookupError(f"value at block {bid} no longer "
+                              f"retrievable (overwritten since)")
+        return reply.value
+
     # ---- live subscription ----
     STALL_TIMEOUT_S = 5.0
 
@@ -208,6 +472,11 @@ class ThinReplicaClient:
 
     def stop(self) -> None:
         self._stop.set()
+        # close without taking the per-server locks: an rpc blocked on
+        # a dead server sees its socket close (OSError) and unwinds
+        for c in list(self._rpc_conns.values()):
+            c.close()
+        self._rpc_conns.clear()
 
     def _supervise(self) -> None:
         """Start a generation of stream threads; rotate the data source

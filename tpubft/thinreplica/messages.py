@@ -93,15 +93,58 @@ class ProofReply:
     value_hash: bytes = b""     # b"" = key absent at that block
     bitmap: bytes = b""         # sparse_merkle.Proof compressed path
     siblings: List[bytes] = field(default_factory=list)
+    # the value itself when the server still holds it at the proven
+    # hash (b"" otherwise) — untrusted: the client binds it to
+    # value_hash, which the verified audit path proves
+    value: bytes = b""
     SPEC = [("block_id", "u64"), ("root", "bytes"),
             ("value_hash", "bytes"), ("bitmap", "bytes"),
-            ("siblings", ("list", "bytes"))]
+            ("siblings", ("list", "bytes")), ("value", "bytes")]
+
+
+@dataclass
+class AnchorRequest:
+    """Ask the server for its newest quorum-certified checkpoint anchor:
+    the f+1 matching signed CheckpointMsgs plus the raw block row whose
+    digest is the certified state digest. The CLIENT verifies the cert
+    signatures and the digest binding — the server is untrusted."""
+    ID = 11
+    SPEC = []
+
+
+@dataclass
+class AnchorReply:
+    ID = 12
+    ckpt_seq: int = 0           # consensus seqnum of the checkpoint
+    block_id: int = 0           # chain height the certified digest binds
+    block_raw: bytes = b""      # encoded Block row; sha256 == cert digest
+    certs: List[bytes] = field(default_factory=list)  # packed CheckpointMsg
+    SPEC = [("ckpt_seq", "u64"), ("block_id", "u64"),
+            ("block_raw", "bytes"), ("certs", ("list", "bytes"))]
+
+
+@dataclass
+class BlockRequest:
+    """Raw block row for hash-chain verification (the client walks
+    parent digests from a certified anchor; the bytes prove themselves)."""
+    ID = 13
+    block_id: int = 0
+    SPEC = [("block_id", "u64")]
+
+
+@dataclass
+class BlockReply:
+    ID = 14
+    block_id: int = 0
+    raw: bytes = b""            # b"" = missing (ahead or pruned)
+    SPEC = [("block_id", "u64"), ("raw", "bytes")]
 
 
 _TYPES = {cls.ID: cls for cls in
           (ReadStateRequest, ReadStateHashRequest, SubscribeRequest,
            UnsubscribeRequest, Update, UpdateHash, StateDone,
-           ProtocolError, ReadProofRequest, ProofReply)}
+           ProtocolError, ReadProofRequest, ProofReply,
+           AnchorRequest, AnchorReply, BlockRequest, BlockReply)}
 
 
 def pack(msg) -> bytes:

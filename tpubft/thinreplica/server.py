@@ -4,22 +4,44 @@ Rebuild of the reference's ThinReplicaImpl
 (/root/reference/thin-replica-server/include/thin-replica-server/
 thin_replica_impl.hpp:98) + subscription_buffer.hpp: one TCP listener,
 one handler thread per connection; live updates arrive from the
-blockchain's commit listener into per-subscriber bounded buffers; history
+blockchain's commit stream into per-subscriber bounded buffers; history
 is read from the chain so a subscriber can start at any block and roll
 forward into the live stream without gaps.
+
+Serving-plane wiring (the read-scaling tier):
+
+  * the live feed rides the blockchain's RUN listener — one publish hop
+    per sealed execution run (the coalesced durable apply), not one per
+    block, so the read tier's cost on the write pipeline stays constant
+    as accumulation deepens;
+  * every proof request is answered with the block-anchored merkle root
+    + audit path; the digest-authenticated trust chain up to f+1 signed
+    checkpoint certificates is served via AnchorRequest/BlockRequest
+    (`anchor_fn` — wired by the consensus replica). The server remains
+    untrusted: clients verify everything;
+  * observability: the `thinreplica` metrics component
+    (trs_overflows / trs_dropped_subscribers / push + read counters)
+    and the trs_subscribe / trs_push / trs_proof flight events.
 """
 from __future__ import annotations
 
+import hashlib
 import queue
 import socket
 import struct
 import threading
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from tpubft.kvbc import categories as cat
 from tpubft.kvbc.blockchain import KeyValueBlockchain
 from tpubft.thinreplica import messages as tm
+from tpubft.utils import flight
+from tpubft.utils.logging import get_logger
+from tpubft.utils.metrics import Component
+from tpubft.utils.racecheck import make_lock
+
+log = get_logger("thinreplica")
 
 
 @dataclass
@@ -43,36 +65,65 @@ class FilterSpec:
 
 
 class _Subscriber:
-    """SubUpdateBuffer: bounded queue; overflow drops the subscriber
-    (it re-subscribes and catches up from history)."""
+    """SubUpdateBuffer: bounded queue of RUNS; overflow drops the
+    subscriber (it re-subscribes and catches up from history)."""
 
     def __init__(self, start_block: int, maxsize: int = 1024) -> None:
         self.q: "queue.Queue" = queue.Queue(maxsize=maxsize)
         self.next_block = start_block
         self.dead = False
 
-    def push(self, item) -> None:
+    def push(self, item) -> bool:
+        """True = enqueued; False = buffer full (caller marks dead and
+        accounts for the drop — this used to be a silent loss)."""
         try:
             self.q.put_nowait(item)
+            return True
         except queue.Full:
             self.dead = True
+            return False
 
 
 class ThinReplicaServer:
     def __init__(self, blockchain: KeyValueBlockchain,
                  filter_spec: Optional[FilterSpec] = None,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 sub_buffer: int = 1024,
+                 aggregator=None,
+                 anchor_fn: Optional[Callable[[], Optional[tuple]]] = None
+                 ) -> None:
         self.bc = blockchain
         self.filter = filter_spec or FilterSpec()
+        self._sub_buffer = max(1, sub_buffer)
+        # () -> (ckpt_seq, block_id, [packed CheckpointMsg...]) or None;
+        # provided by the consensus replica (thread-safe snapshot)
+        self._anchor_fn = anchor_fn
         self._subs: List[_Subscriber] = []
-        self._subs_lock = threading.Lock()
+        # make_lock (not raw): the subscriber list crosses the commit
+        # thread (exec lane / dispatcher) and connection handlers —
+        # the lint's static-race pass and the runtime lock-order graph
+        # must both see it
+        self._subs_lock = make_lock("trs.subs")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self.port = self._sock.getsockname()[1]
         self._running = False
         self._accept_thread: Optional[threading.Thread] = None
-        blockchain.add_listener(self._on_block)
+        # --- metrics (the serving plane's day-one observability) ---
+        self.metrics = Component("thinreplica", aggregator)
+        self.m_subscribers = self.metrics.register_gauge("trs_subscribers")
+        self.m_pushed_runs = self.metrics.register_counter(
+            "trs_pushed_runs")
+        self.m_pushed_blocks = self.metrics.register_counter(
+            "trs_pushed_blocks")
+        self.m_overflows = self.metrics.register_counter("trs_overflows")
+        self.m_dropped_subs = self.metrics.register_counter(
+            "trs_dropped_subscribers")
+        self.m_reads = self.metrics.register_counter("trs_reads")
+        self.m_proofs = self.metrics.register_counter("trs_proofs")
+        self.m_anchors = self.metrics.register_counter("trs_anchors")
+        blockchain.add_run_listener(self._on_run)
 
     # ---- lifecycle ----
     def start(self) -> None:
@@ -92,13 +143,42 @@ class ThinReplicaServer:
         except OSError:
             pass
 
-    # ---- commit-path feed ----
-    def _on_block(self, block_id: int, updates: cat.BlockUpdates) -> None:
-        kv = self.filter.filter_updates(updates)
+    # ---- commit-path feed (exec-lane / dispatcher thread) ----
+    def _on_run(self, items) -> None:
+        """One sealed run (N blocks, one atomic commit) → ONE buffer
+        push per subscriber. Filtering happens once, here, instead of
+        per subscriber."""
+        batch = [(bid, self.filter.filter_updates(bu))
+                 for bid, bu in items]
+        dropped = 0
         with self._subs_lock:
-            self._subs = [s for s in self._subs if not s.dead]
+            live = []
             for sub in self._subs:
-                sub.push((block_id, kv))
+                if sub.dead:
+                    continue
+                if not sub.push(batch):
+                    # overflow: the subscriber is too slow for the live
+                    # stream — drop it (it re-subscribes and catches up
+                    # from history) and tell the operator how far behind
+                    # it was so buffers can be sized
+                    dropped += 1
+                    self.m_overflows.inc()
+                    log.warning(
+                        "trs subscriber overflow: lag=%d blocks "
+                        "(next wanted %d, head %d, buffer %d runs); "
+                        "dropping — it must re-subscribe",
+                        max(0, batch[-1][0] - sub.next_block),
+                        sub.next_block, batch[-1][0], self._sub_buffer)
+                    continue
+                live.append(sub)
+            self._subs = live
+            self.m_subscribers.set(len(live))
+        if dropped:
+            self.m_dropped_subs.inc(dropped)
+        self.m_pushed_runs.inc()
+        self.m_pushed_blocks.inc(len(batch))
+        flight.record(flight.EV_TRS_PUSH, seq=batch[-1][0],
+                      arg=len(batch))
 
     # ---- connection handling ----
     def _accept_loop(self) -> None:
@@ -112,20 +192,33 @@ class ThinReplicaServer:
 
     def _serve(self, conn: socket.socket) -> None:
         try:
-            body = self._read_frame(conn)
-            if body is None:
-                return
-            req = tm.unpack_body(body)
-            if isinstance(req, tm.ReadStateRequest):
-                self._serve_read_state(conn, req.key_prefix)
-            elif isinstance(req, tm.ReadStateHashRequest):
-                self._serve_state_hash(conn, req)
-            elif isinstance(req, tm.SubscribeRequest):
-                self._serve_subscription(conn, req)
-            elif isinstance(req, tm.ReadProofRequest):
-                self._serve_proof(conn, req)
-            else:
-                conn.sendall(tm.pack(tm.ProtocolError(reason="bad request")))
+            # request/reply messages PIPELINE on one connection (the
+            # read-serving hot path must not pay a TCP handshake per
+            # read); streaming requests take the connection over and
+            # close it when the stream ends
+            while True:
+                body = self._read_frame(conn)
+                if body is None:
+                    return
+                req = tm.unpack_body(body)
+                if isinstance(req, tm.ReadStateRequest):
+                    self._serve_read_state(conn, req.key_prefix)
+                    return
+                if isinstance(req, tm.SubscribeRequest):
+                    self._serve_subscription(conn, req)
+                    return
+                if isinstance(req, tm.ReadStateHashRequest):
+                    self._serve_state_hash(conn, req)
+                elif isinstance(req, tm.ReadProofRequest):
+                    self._serve_proof(conn, req)
+                elif isinstance(req, tm.AnchorRequest):
+                    self._serve_anchor(conn)
+                elif isinstance(req, tm.BlockRequest):
+                    self._serve_block(conn, req)
+                else:
+                    conn.sendall(tm.pack(
+                        tm.ProtocolError(reason="bad request")))
+                    return
         except Exception:  # noqa: BLE001 — connection teardown
             pass
         finally:
@@ -189,6 +282,7 @@ class ThinReplicaServer:
 
     def _serve_read_state(self, conn: socket.socket,
                           key_prefix: bytes) -> None:
+        self.m_reads.inc()
         block_id, kv = self._state_snapshot(key_prefix)
         for pair in kv:
             conn.sendall(tm.pack(tm.Update(block_id=block_id, kv=[pair])))
@@ -197,6 +291,7 @@ class ThinReplicaServer:
 
     def _serve_state_hash(self, conn: socket.socket,
                           req: tm.ReadStateHashRequest) -> None:
+        self.m_reads.inc()
         if req.block_id and req.block_id != self.bc.last_block_id:
             if req.block_id > self.bc.last_block_id:
                 conn.sendall(tm.pack(tm.ProtocolError(reason="ahead")))
@@ -216,7 +311,7 @@ class ThinReplicaServer:
         versions): audit path for key@block plus the root anchored in
         that block's category digests. The CLIENT verifies — this server
         is untrusted; the root gains authority from an f+1 cross-server
-        match."""
+        match or from the signed checkpoint anchor's hash chain."""
         bid = req.block_id or self.bc.last_block_id
         if bid > self.bc.last_block_id:
             conn.sendall(tm.pack(tm.ProtocolError(reason="ahead")))
@@ -231,9 +326,47 @@ class ThinReplicaServer:
         except Exception:  # noqa: BLE001 — malformed request data
             conn.sendall(tm.pack(tm.ProtocolError(reason="bad proof req")))
             return
+        # ship the value alongside the proof when the LATEST value still
+        # hashes to the proven value_hash (one round trip for
+        # read+verify); a key overwritten since `bid` yields proof-only
+        value = b""
+        if vh:
+            hit = self.bc.get_latest(req.category, req.key,
+                                     cat_type=cat.BLOCK_MERKLE)
+            if hit is not None \
+                    and hashlib.sha256(hit[1]).digest() == vh:
+                value = hit[1]
+        self.m_proofs.inc()
+        flight.record(flight.EV_TRS_PROOF, seq=bid)
         conn.sendall(tm.pack(tm.ProofReply(
             block_id=bid, root=root, value_hash=vh or b"",
-            bitmap=proof.bitmap, siblings=proof.siblings)))
+            bitmap=proof.bitmap, siblings=proof.siblings, value=value)))
+
+    # ---- checkpoint anchor + raw blocks (digest-auth trust chain) ----
+    def _serve_anchor(self, conn: socket.socket) -> None:
+        anchor = self._anchor_fn() if self._anchor_fn is not None else None
+        if anchor is None:
+            conn.sendall(tm.pack(tm.ProtocolError(reason="no anchor")))
+            return
+        ckpt_seq, block_id, certs = anchor
+        raw = self.bc.get_raw_block(block_id)
+        if raw is None:
+            # the anchored block was pruned (or this replica lags its
+            # own anchor after a restart): the client falls back to the
+            # f+1 root-quorum path until the next checkpoint certifies
+            conn.sendall(tm.pack(tm.ProtocolError(reason="pruned")))
+            return
+        self.m_anchors.inc()
+        conn.sendall(tm.pack(tm.AnchorReply(
+            ckpt_seq=ckpt_seq, block_id=block_id, block_raw=raw,
+            certs=list(certs))))
+
+    def _serve_block(self, conn: socket.socket,
+                     req: tm.BlockRequest) -> None:
+        raw = (self.bc.get_raw_block(req.block_id)
+               if 1 <= req.block_id <= self.bc.last_block_id else None)
+        conn.sendall(tm.pack(tm.BlockReply(block_id=req.block_id,
+                                           raw=raw or b"")))
 
     # ---- subscriptions ----
     def _block_kv(self, block_id: int,
@@ -247,9 +380,12 @@ class ThinReplicaServer:
 
     def _serve_subscription(self, conn: socket.socket,
                             req: tm.SubscribeRequest) -> None:
-        sub = _Subscriber(start_block=max(req.block_id, 1))
+        sub = _Subscriber(start_block=max(req.block_id, 1),
+                          maxsize=self._sub_buffer)
         with self._subs_lock:
             self._subs.append(sub)
+            self.m_subscribers.set(len(self._subs))
+        flight.record(flight.EV_TRS_SUBSCRIBE, seq=sub.next_block)
         try:
             next_block = sub.next_block
             # history first (catch-up), then drain the live buffer;
@@ -262,25 +398,31 @@ class ThinReplicaServer:
                         break
                     self._emit(conn, req, next_block, kv)
                     next_block += 1
+                    sub.next_block = next_block
                     continue
                 try:
-                    block_id, kv = sub.q.get(timeout=0.5)
+                    batch = sub.q.get(timeout=0.5)
                 except queue.Empty:
                     continue
-                if block_id < next_block:
-                    continue   # already served from history
-                if block_id > next_block:
-                    # gap (buffer overflowed earlier): fall back to history
-                    continue
-                kv = [(k, v) for k, v in kv
-                      if k.startswith(req.key_prefix)]
-                self._emit(conn, req, block_id, kv)
-                next_block += 1
+                for block_id, kv in batch:
+                    if block_id < next_block:
+                        continue   # already served from history
+                    if block_id > next_block:
+                        # gap (an earlier run was consumed as history
+                        # before we enqueued): the outer loop's history
+                        # branch fills it on the next pass
+                        break
+                    kv = [(k, v) for k, v in kv
+                          if k.startswith(req.key_prefix)]
+                    self._emit(conn, req, block_id, kv)
+                    next_block += 1
+                    sub.next_block = next_block
         finally:
             sub.dead = True
             with self._subs_lock:
                 if sub in self._subs:
                     self._subs.remove(sub)
+                self.m_subscribers.set(len(self._subs))
 
     def _emit(self, conn: socket.socket, req: tm.SubscribeRequest,
               block_id: int, kv: List[Tuple[bytes, bytes]]) -> None:

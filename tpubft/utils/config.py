@@ -92,6 +92,31 @@ class ReplicaConfig:
     auto_primary_rotation_enabled: bool = False
     view_change_protocol_enabled: bool = True
     pre_execution_enabled: bool = False
+    # backup-side pre-execution reply cache (preprocessor/preprocessor.py
+    # _reply_cache): bounded LRU of packed PreProcessReplyMsg so a
+    # primary's rebroadcast is answered from cache instead of
+    # re-executing the handler. Sized like the SigManager verify memo:
+    # big enough to cover in-flight sessions x retries, small enough
+    # that real client traffic cannot grow it without bound.
+    preexec_reply_cache_max: int = 512
+    # pre-execution worker pool width (backup + primary speculative
+    # executions run here, off the dispatcher)
+    preexec_threads: int = 4
+
+    # thin-replica read tier (thinreplica/server.py): serve state reads,
+    # merkle proofs, and live update subscriptions off the consensus
+    # path, fed once per sealed execution run from the ledger's
+    # durable-apply seam. Requires a blockchain-backed handler —
+    # silently inactive otherwise.
+    thin_replica_enabled: bool = False
+    # TCP port for the thin-replica listener (0 = ephemeral; in-process
+    # clusters discover the bound port via replica.thin_replica.port)
+    thin_replica_port: int = 0
+    # per-subscriber live-update buffer (runs, not blocks): a subscriber
+    # lagging more than this many sealed runs is dropped (it
+    # re-subscribes and catches up from history) — see
+    # trs_dropped_subscribers / trs_overflows
+    thin_replica_sub_buffer: int = 1024
     time_service_enabled: bool = False
     time_max_skew_ms: int = 1000
     key_exchange_on_start: bool = False
@@ -317,6 +342,14 @@ class ReplicaConfig:
         if self.combine_batch_max < 1 or self.combine_flush_us < 0:
             raise ValueError("combine_batch_max must be >= 1 and "
                              "combine_flush_us >= 0")
+        if self.preexec_reply_cache_max < 1:
+            raise ValueError("preexec_reply_cache_max must be >= 1")
+        if self.preexec_threads < 1:
+            raise ValueError("preexec_threads must be >= 1")
+        if self.thin_replica_sub_buffer < 1:
+            raise ValueError("thin_replica_sub_buffer must be >= 1")
+        if not 0 <= self.thin_replica_port <= 65535:
+            raise ValueError("thin_replica_port must be a valid TCP port")
 
     # ---- serialization ----
     def to_json(self) -> str:

@@ -82,6 +82,17 @@ EV_SPEC_ENQ = 16        # slot handed to the lane SPECULATIVELY
 EV_SPEC_SEAL = 17       # speculative run sealed at commit (arg=run len)
 EV_SPEC_ABORT = 18      # speculation aborted; slot re-executes committed
 EV_COMBINE_FLUSH = 19   # fused combine flush (batcher; arg=slots drained)
+# thin-replica read tier (serving-plane events; seq carries a BLOCK id,
+# not a consensus seqnum — the read path has no slot)
+EV_TRS_SUBSCRIBE = 20   # subscription accepted (seq=start block)
+EV_TRS_PUSH = 21        # sealed run published to subscribers
+#                         (seq=last block of the run; arg=blocks in run)
+EV_TRS_PROOF = 22       # merkle proof served (seq=block; arg=category id)
+# pre-execution plane (seq carries the client req_seq_num)
+EV_PREEXEC_LAUNCH = 23  # speculative execution launched (arg=retry id)
+EV_PREEXEC_AGREE = 24   # f+1 digest agreement reached (arg=votes)
+EV_PREEXEC_CONFLICT = 25  # read-set conflict at commit; fell back to
+#                           normal ordering (seq=consensus slot)
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -94,6 +105,10 @@ EV_NAMES = {
     EV_HEALTH: "health", EV_SPEC_ENQ: "spec_enqueue",
     EV_SPEC_SEAL: "spec_seal", EV_SPEC_ABORT: "spec_abort",
     EV_COMBINE_FLUSH: "combine_flush",
+    EV_TRS_SUBSCRIBE: "trs_subscribe", EV_TRS_PUSH: "trs_push",
+    EV_TRS_PROOF: "trs_proof", EV_PREEXEC_LAUNCH: "preexec_launch",
+    EV_PREEXEC_AGREE: "preexec_agree",
+    EV_PREEXEC_CONFLICT: "preexec_conflict",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
