@@ -110,6 +110,28 @@ def _secondary_metrics(platform: str) -> dict:
         dt = (time.perf_counter() - t0) / reps
         out["ecdsa-%s-verifies/sec" % curve] = round(eb / dt, 1)
 
+        # RLC batch kernel (one MSM-shaped launch per flush) and the
+        # batched host fallback — the two tiers of the rescued path
+        verdict = eops.rlc_verify_batch(curve, items)     # compile
+        assert bool(verdict.all()), curve
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            eops.rlc_verify_batch(curve, items)
+        dt = (time.perf_counter() - t0) / reps
+        out["ecdsa-%s-rlc-verifies/sec" % curve] = round(eb / dt, 1)
+
+        from tpubft.crypto import scalar as _scalar
+        host_items = [(item_pk, m, s) for m, s, item_pk in items]
+        # heat the per-principal comb past the hot threshold so the
+        # timed reps measure warm steady state at ANY eb
+        for _ in range(_scalar._COMB_HOT_AFTER // eb + 2):
+            _scalar.ecdsa_verify_batch(host_items, curve)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            assert all(_scalar.ecdsa_verify_batch(host_items, curve))
+        dt = (time.perf_counter() - t0) / reps
+        out["ecdsa-%s-host-batch/sec" % curve] = round(eb / dt, 1)
+
     # BLS threshold combine — Lagrange + k-point G1 MSM, the per-slot
     # certificate cost of every threshold-bls config (reference
     # FastMultExp.cpp role). k=3 quorum of config 2's n=7 shape at CPU

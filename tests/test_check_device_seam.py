@@ -61,6 +61,31 @@ def test_lint_catches_violations(tmp_path):
     assert len(violations) >= 3, violations
 
 
+def test_lint_covers_ecdsa_rlc_entry_point(tmp_path):
+    """The RLC batch verifier is a kernel entry point like any other:
+    a naked device_dispatch ride inside ops/ecdsa.py (bypassing the
+    breaker-guarded device_section the real `_rlc_launch` uses) must be
+    rejected — and the real module must be inside the scanned set."""
+    tool = _load_tool()
+    # the real tree: ops/ecdsa.py is scanned and clean (its launches go
+    # through device_section)
+    import tpubft.ops.ecdsa  # noqa: F401 — the entry point exists
+    assert tool.find_violations(_ROOT) == []
+    # a seeded defect shaped like the new entry point is caught
+    mod_dir = tmp_path / "tpubft" / "ops"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "ecdsa.py").write_text(textwrap.dedent("""\
+        from tpubft.ops.dispatch import device_dispatch
+
+        def rlc_verify_batch(curve_name, items):
+            with device_dispatch():
+                return None
+    """))
+    violations = tool.find_violations(str(tmp_path))
+    assert {p for p, _, _ in violations} == {
+        os.path.join("tpubft", "ops", "ecdsa.py")}, violations
+
+
 def test_lint_fails_when_nothing_scanned(tmp_path):
     """A wrong root (or a package rename) must fail loudly, not report
     a vacuous OK over zero scanned modules."""

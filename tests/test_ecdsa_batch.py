@@ -1,0 +1,325 @@
+"""Two-tier batched ECDSA verification (ROADMAP item 4 rescue).
+
+Pins the contracts of the rescued hot path:
+  * three-way verdict equivalence — the per-item scalar loop, the
+    batched host engine (Montgomery batch inversion + comb tables), the
+    per-item device kernel, and the RLC batch kernel agree byte-for-byte
+    on a mixed corpus including forged/edge items, on both curves;
+  * the r+n wrap case (x(R') >= n is unreachable by honest signing, so
+    the compare branch is pinned synthetically at the kernel seam);
+  * RLC aggregate semantics — one MSM-shaped launch per clean flush
+    (kernel profiler visible), aggregate failure bisects to exactly the
+    forged signature while every sibling still verifies;
+  * SigManager wiring — ECDSA admission rides ecdsa_verify_batch while
+    the device breaker is OPEN (the degraded-mode smoke), counters
+    (`ecdsa_batched_host`, `pubkey_memo_hits`) and the host-batch
+    histogram flow, and scalar/batched-host/device verdict vectors are
+    identical on a mixed-scheme corpus.
+"""
+import numpy as np
+import pytest
+
+from tpubft.crypto import cpu, scalar
+from tpubft.ops import ecdsa as ops_ecdsa
+
+
+@pytest.fixture(autouse=True)
+def _clean_breaker():
+    from tpubft.ops.dispatch import device_breaker
+    b = device_breaker()
+    b.configure(failure_threshold=3, cooldown_s=2.0, latency_slo_s=0.0)
+    b.reset()
+    yield
+    b.reset()
+
+
+def _corpus(curve, valid=3):
+    """Mixed corpus: multi-principal valid items + every reject class.
+    Returns (items, expected) with items as (msg, sig, pk)."""
+    s1 = cpu.EcdsaSigner.generate(curve, seed=b"eb-1")
+    s2 = cpu.EcdsaSigner.generate(curve, seed=b"eb-2")
+    n = ops_ecdsa.CURVES[curve]["n"]
+    items = []
+    for i in range(valid):
+        signer = s1 if i % 2 else s2
+        m = b"batch-msg-%d" % i
+        items.append((m, signer.sign(m), signer.public_bytes()))
+    good_m, good_s, good_pk = items[0]
+    r_int = int.from_bytes(good_s[:32], "big")
+    s_int = int.from_bytes(good_s[32:], "big")
+    # high-s twin: (r, n-s) verifies too (ECDSA malleability — accepted
+    # by the spec, and all four paths must agree it is accepted)
+    items.append((good_m, good_s[:32] + (n - s_int).to_bytes(32, "big"),
+                  good_pk))
+    expected = [True] * (valid + 1)
+    rejects = [
+        (b"forged", good_s, good_pk),                        # wrong msg
+        (good_m, good_s, s2.public_bytes()
+         if good_pk == s1.public_bytes() else s1.public_bytes()),  # wrong key
+        (good_m, b"\x00" * 32 + good_s[32:], good_pk),       # r = 0
+        (good_m, good_s[:32] + b"\x00" * 32, good_pk),       # s = 0
+        (good_m, good_s[:32] + n.to_bytes(32, "big"), good_pk),   # s = n
+        (good_m, (r_int + n if r_int + n < 2**256 else 1).to_bytes(
+            32, "big") + good_s[32:], good_pk),              # r out of range
+        (good_m, good_s[:40], good_pk),                      # short sig
+        (good_m, good_s, b"\x04" + b"\x00" * 64),            # pk off-curve
+        (good_m, good_s, b"\x02" + good_pk[1:33]),           # compressed pk
+    ]
+    items += rejects
+    expected += [False] * len(rejects)
+    return items, expected
+
+
+@pytest.mark.parametrize("curve", ["secp256k1", "secp256r1"])
+def test_three_way_verdict_equivalence(curve):
+    items, expected = _corpus(curve)
+    want = [scalar.ecdsa_verify(pk, m, s, curve) for m, s, pk in items]
+    assert want == expected
+    host = scalar.ecdsa_verify_batch([(pk, m, s) for m, s, pk in items],
+                                     curve)
+    kernel = ops_ecdsa.verify_batch(curve, items).tolist()
+    rlc = ops_ecdsa.rlc_verify_batch(curve, items).tolist()
+    assert host == want
+    assert kernel == want
+    assert rlc == want
+
+
+def test_host_batch_multi_principal_and_sizes():
+    """Batch-of-one, odd sizes, and cross-principal items all agree
+    with the loop (the lockstep walk pads/partitions internally)."""
+    curve = "secp256k1"
+    signers = [cpu.EcdsaSigner.generate(curve, seed=b"mp-%d" % j)
+               for j in range(5)]
+    items = []
+    for i in range(23):
+        s = signers[i % 5]
+        m = b"mp-msg-%d" % i
+        items.append((s.public_bytes(), m, s.sign(m)))
+    items[9] = (items[9][0], b"tampered", items[9][2])
+    for size in (1, 2, 7, 23):
+        sub = items[:size]
+        got = scalar.ecdsa_verify_batch(sub, curve)
+        assert got == [scalar.ecdsa_verify(pk, m, s, curve)
+                       for pk, m, s in sub]
+    assert scalar.ecdsa_verify_batch([], curve) == []
+
+
+def test_host_batch_hot_comb_equivalence():
+    """Crossing the hot-comb threshold must not change verdicts (the
+    8-bit rebuild is a pure speed upgrade)."""
+    curve = "secp256r1"
+    s = cpu.EcdsaSigner.generate(curve, seed=b"hot")
+    pk = s.public_bytes()
+    items = [(pk, b"hot-%d" % i, s.sign(b"hot-%d" % i)) for i in range(64)]
+    items[5] = (pk, b"evil", items[5][2])
+    want = [scalar.ecdsa_verify(p, m, g, curve) for p, m, g in items]
+    rounds = scalar._COMB_HOT_AFTER // len(items) + 2
+    for _ in range(rounds):
+        assert scalar.ecdsa_verify_batch(items, curve) == want
+    key = (curve, pk)
+    with scalar._cache_lock:
+        entry = scalar._pk_cache.get(key)
+    assert entry is not None and entry.width == scalar._COMB_Q_HOT_WIDTH
+
+
+def _synthetic_wrap_prep(curve):
+    """The wrap case x(R') = r + n needs x(R') >= n, which no feasible
+    honest signature reaches (prob ~2^-128) — so pin the compare branch
+    synthetically: pick u1, u2, compute T = [u1]G + [u2]Q on the host,
+    and present r' = x(T) - n as the signature's r. Valid exactly via
+    the r+n candidate."""
+    cv = scalar.CURVES[curve]
+    p, n, a = cv["p"], cv["n"], cv["a"]
+    u1, u2 = 0x1234567, 0x89ABCDE
+    d = scalar.ecdsa_seed_to_private(b"wrap", curve)
+    q = scalar._jac_to_affine(scalar._mul_g(d, curve), p)
+    t = scalar._jac_add(scalar._mul_g(u1, curve),
+                        scalar._jac_mul(u2, q, cv), p, a)
+    xt, _ = scalar._jac_to_affine(t, p)
+    return u1, u2, q, xt
+
+
+@pytest.mark.parametrize("curve", ["secp256k1", "secp256r1"])
+def test_wrap_case_kernels(curve):
+    u1, u2, q, xt = _synthetic_wrap_prep(curve)
+    ocv = ops_ecdsa.get_curve(curve)
+    f = ocv.f
+    nl = f.nl
+    from tpubft.ops.field import int_to_limbs
+
+    u1b = ops_ecdsa._bits_msb(u1).reshape(256, 1)
+    u2b = ops_ecdsa._bits_msb(u2).reshape(256, 1)
+    qx = f.from_int(q[0]).reshape(nl, 1)
+    qy = f.from_int(q[1]).reshape(nl, 1)
+    valid = np.ones(1, bool)
+
+    # per-item kernel: r_raw mismatches, r_plus_n_raw == x(T) -> accept
+    junk = (xt + 1) % f.p
+    prep = ops_ecdsa.PreparedEcdsaBatch(
+        u1b, u2b, qx, qy,
+        int_to_limbs(junk, nl).reshape(nl, 1),
+        int_to_limbs(xt, nl).reshape(nl, 1), valid)
+    kern = ops_ecdsa.make_verify_kernel(curve)
+    assert bool(np.asarray(kern(prep.u1_bits, prep.u2_bits, prep.qx,
+                                prep.qy, prep.r_raw,
+                                prep.r_plus_n_raw))[0])
+    # and with the wrap slot mismatching too -> reject
+    prep_bad = prep._replace(r_plus_n_raw=int_to_limbs(
+        junk, nl).reshape(nl, 1))
+    assert not bool(np.asarray(kern(prep_bad.u1_bits, prep_bad.u2_bits,
+                                    prep_bad.qx, prep_bad.qy,
+                                    prep_bad.r_raw,
+                                    prep_bad.r_plus_n_raw))[0])
+
+    # RLC kernel: xr mismatches, xrpn == x(T) with wrap_ok -> aggregate
+    # passes; wrap_ok off -> aggregate fails
+    a_m = f.from_int(12345).reshape(nl, 1)
+    rprep = ops_ecdsa.PreparedRlcBatch(
+        u1b, u2b, qx, qy,
+        f.from_int(junk).reshape(nl, 1),
+        f.from_int(xt).reshape(nl, 1),
+        np.ones(1, bool), a_m, valid)
+    assert ops_ecdsa._rlc_launch(curve, rprep, [0])
+    rprep_off = rprep._replace(wrap_ok=np.zeros(1, bool))
+    assert not ops_ecdsa._rlc_launch(curve, rprep_off, [0])
+
+
+def _ecdsa_kernel_calls():
+    from tpubft.utils import flight
+    return flight.kernel_profiler().snapshot().get(
+        "ecdsa", {}).get("calls", 0)
+
+
+def test_rlc_one_launch_per_clean_flush():
+    curve = "secp256k1"
+    s = cpu.EcdsaSigner.generate(curve, seed=b"flush")
+    pk = s.public_bytes()
+    items = [(b"f-%d" % i, s.sign(b"f-%d" % i), pk) for i in range(8)]
+    ops_ecdsa.rlc_verify_batch(curve, items)          # compile warm-up
+    before = _ecdsa_kernel_calls()
+    assert ops_ecdsa.rlc_verify_batch(curve, items).all()
+    assert _ecdsa_kernel_calls() - before == 1
+
+
+def test_rlc_bisection_isolates_forged_signature():
+    curve = "secp256k1"
+    s = cpu.EcdsaSigner.generate(curve, seed=b"bisect")
+    pk = s.public_bytes()
+    items = [(b"b-%d" % i, s.sign(b"b-%d" % i), pk) for i in range(8)]
+    items[5] = (b"forged-body", items[5][1], pk)
+    before = _ecdsa_kernel_calls()
+    got = ops_ecdsa.rlc_verify_batch(curve, items)
+    launches = _ecdsa_kernel_calls() - before
+    assert got.tolist() == [i != 5 for i in range(8)]
+    # 1 aggregate + a log2(16)-deep descent: strictly fewer than one
+    # launch per item (the naive per-item identification)
+    assert 1 < launches <= 2 * 3 + 1
+    # two forged items in different halves still isolate exactly
+    items[2] = (b"forged-2", items[2][1], pk)
+    got = ops_ecdsa.rlc_verify_batch(curve, items)
+    assert got.tolist() == [i not in (2, 5) for i in range(8)]
+
+
+def _mixed_cluster(scheme="ecdsa-secp256k1"):
+    from tpubft.consensus.keys import ClusterKeys
+    from tpubft.utils.config import ReplicaConfig
+    cfg = ReplicaConfig(f_val=1, num_of_client_proxies=3,
+                        client_sig_scheme=scheme)
+    keys = ClusterKeys.generate(cfg, 3, seed=b"ecdsa-batch-plane")
+    return cfg, keys
+
+
+def _mixed_corpus(cfg, keys):
+    from tpubft.consensus.sig_manager import SigManager
+    cid = cfg.n_val + cfg.num_ro_replicas
+    corpus = []
+    for j in range(3):
+        sm = SigManager(keys.for_node(cid + j))
+        corpus.append((cid + j, b"req-%d" % j, sm.sign(b"req-%d" % j)))
+    rsig = SigManager(keys.for_node(1)).sign(b"replica-msg")
+    corpus.append((1, b"replica-msg", rsig))                 # ed25519
+    corpus.append((cid, b"forged", corpus[1][2]))            # forged
+    corpus.append((cid + 1, corpus[1][1], b"\x00" * 64))     # junk sig
+    return corpus, [True, True, True, True, False, False]
+
+
+def test_sig_manager_path_equivalence_mixed_schemes():
+    """Verdict vectors identical across the scalar loop, the batched
+    host plane, and the device-backend plane on a mixed
+    ed25519/secp256k1 corpus with forged items."""
+    from tpubft.consensus.sig_manager import SigManager
+    from tpubft.crypto.tpu import verify_batch_mixed
+    cfg, keys = _mixed_cluster()
+    corpus, want = _mixed_corpus(cfg, keys)
+    sm_scalar = SigManager(keys.for_node(0), memo_capacity=0)
+    sm_dev = SigManager(keys.for_node(0), batch_fn=verify_batch_mixed,
+                        device_min_batch=1, memo_capacity=0)
+    assert sm_scalar.verify_batch(corpus) == want
+    assert sm_dev.verify_batch(corpus) == want
+    # force the device ride for the ECDSA group regardless of platform
+    # (on the XLA-CPU fallback the default crossover routes to host)
+    import os
+    os.environ["TPUBFT_ECDSA_CROSSOVER_B"] = "1"
+    try:
+        sm_dev2 = SigManager(keys.for_node(0),
+                             batch_fn=verify_batch_mixed,
+                             device_min_batch=1, memo_capacity=0)
+        assert sm_dev2.verify_batch(corpus) == want
+    finally:
+        del os.environ["TPUBFT_ECDSA_CROSSOVER_B"]
+
+
+def test_breaker_open_rides_batched_host():
+    """Tier-1 degraded-mode smoke: with the device breaker OPEN, ECDSA
+    admission traffic must flow through ecdsa_verify_batch (visible as
+    scalar_fallbacks + ecdsa_batched_host), never fail, and keep
+    rejecting forged signatures."""
+    from tpubft.consensus.sig_manager import SigManager
+    from tpubft.crypto.tpu import verify_batch_mixed
+    from tpubft.ops.dispatch import device_breaker
+    cfg, keys = _mixed_cluster()
+    corpus, want = _mixed_corpus(cfg, keys)
+    sm = SigManager(keys.for_node(0), batch_fn=verify_batch_mixed,
+                    device_min_batch=1, memo_capacity=0)
+    b = device_breaker()
+    for _ in range(3):
+        b.record_failure("ecdsa")
+    assert not b.allow()
+    assert sm.verify_batch(corpus) == want
+    assert sm.degraded_verifies.value == len(corpus)
+    assert sm.scalar_fallbacks.value == len(corpus)
+    # the ECDSA groups (>= 2 items per principal) rode the batched host
+    assert sm.ecdsa_batched_host.value > 0
+    assert sm._h_ecdsa_host_batch.snapshot()["count"] > 0
+    assert sm._h_ecdsa_host_batch.name == "sigmgr0.ecdsa_host_batch"
+
+
+def test_pubkey_decode_memo_counter_flows():
+    from tpubft.consensus.sig_manager import SigManager
+    cfg, keys = _mixed_cluster()
+    corpus, want = _mixed_corpus(cfg, keys)
+    sm = SigManager(keys.for_node(0), memo_capacity=0)
+    scalar.consume_decode_stats()                  # reset module stats
+    assert sm.verify_batch(corpus) == want
+    assert sm.verify_batch(corpus) == want         # re-presents keys
+    assert sm.pubkey_memo_hits.value > 0
+    # events verified under a SigManager are attributed to ITS sink on
+    # its thread — the module-level fallback counters stay untouched
+    assert scalar.consume_decode_stats()["hits"] == 0
+    # a second manager's counters are independent (no cross-replica
+    # bleed through the shared engine)
+    sm2 = SigManager(keys.for_node(1), memo_capacity=0)
+    assert sm2.pubkey_memo_hits.value == 0
+
+
+def test_ecdsa_verifier_batch_seam():
+    """cpu.EcdsaVerifier.verify_batch == per-item verify (the seam
+    SigManager's grouped fallback drains into)."""
+    curve = "secp256k1"
+    s = cpu.EcdsaSigner.generate(curve, seed=b"seam")
+    v = cpu.EcdsaVerifier(s.public_bytes(), curve)
+    items = [(b"s-%d" % i, s.sign(b"s-%d" % i)) for i in range(8)]
+    items[3] = (b"bad", items[3][1])
+    got = v.verify_batch(items)
+    assert got == [v.verify(m, sg) for m, sg in items]
+    assert got == [i != 3 for i in range(8)]

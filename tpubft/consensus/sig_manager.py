@@ -108,6 +108,25 @@ class SigManager:
         # in degraded verification mode; the breaker snapshot says why
         self.degraded_verifies = self.metrics.register_counter(
             "degraded_verifies")
+        # ECDSA two-tier sensors (ROADMAP item 8 autotuner inputs): the
+        # device tier's batch stats flow through the kernel profiler
+        # (device_section("ecdsa")); the host tier is counted here —
+        # items through crypto/scalar.ecdsa_verify_batch (attributed to
+        # THIS manager via the thread-local stats sink wrapped around
+        # its verification, so every route it takes is covered) and
+        # pubkey-decode memo hits (decode + on-curve check paid once per
+        # key, not per retransmitted verify)
+        self.ecdsa_batched_host = self.metrics.register_counter(
+            "ecdsa_batched_host")
+        self.pubkey_memo_hits = self.metrics.register_counter(
+            "pubkey_memo_hits")
+        from tpubft.diagnostics import get_registrar
+        # replica-scoped (PR 11's replica<id>.combine_batch_size
+        # convention) so in-process multi-replica topologies don't
+        # co-mingle batch-shape samples
+        who = "" if keys.my_id is None else keys.my_id
+        self._h_ecdsa_host_batch = get_registrar().histogram(
+            f"sigmgr{who}.ecdsa_host_batch", unit="items")
 
     # ---- signing ----
     def sign(self, data: bytes) -> bytes:
@@ -310,49 +329,79 @@ class SigManager:
                 keys[i] = key
                 pending.append(i)
         if pending:
-            sub = [items[i] for i in pending]
-            verdicts = None
-            use_device = (self._batch_fn is not None
-                          and len(sub) >= self.device_min_batch)
-            if use_device and not device_breaker().allow():
-                # non-mutating preview: while the breaker is OPEN, skip
-                # building the device batch entirely instead of paying
-                # list construction + a BreakerOpen round-trip on every
-                # degraded verify (attempt() below still guards the
-                # admitted path — a lost race just raises as before)
-                self.degraded_verifies.inc(len(sub))
-            elif use_device:
-                try:
-                    verdicts, via_grace = self._verify_batch_cross(
-                        sub, seq, view_scoped,
-                        aliased=[aliased[i] for i in pending],
-                        pks=[pks[i] for i in pending])
-                    self.batched_verifies.inc(len(sub))
-                except BreakerOpen:
-                    # breaker tripped: fast-fail BEFORE the device — the
-                    # scalar engines carry the load until the half-open
-                    # probe re-admits the device
-                    self.degraded_verifies.inc(len(sub))
-                except Exception:  # noqa: BLE001 — a device failure must
-                    # degrade verification, never fail it: the breaker
-                    # recorded the failure (trip after N consecutive)
-                    log.warning("device verify batch failed (%d items); "
-                                "rerouting to scalar engines",
-                                len(sub), exc_info=True)
-                    self.degraded_verifies.inc(len(sub))
-            if verdicts is None:
-                verdicts, via_grace = self._verify_batch_grouped(
-                    sub, seq, view_scoped)
-                self.scalar_fallbacks.inc(len(sub))
-            for i, ok, grace in zip(pending, verdicts, via_grace):
-                out[i] = ok
-                # grace-key acceptances are deliberately NOT memoized:
-                # the memo must never outlive the grace window
-                if ok and not grace and keys[i] is not None:
-                    self._memo_add(keys[i])
+            from tpubft.crypto import scalar as scalar_engine
+            # thread-local attribution scope: the shared module-level
+            # scalar engine records ECDSA batch/memo events into THIS
+            # manager's sink (verification runs synchronously on this
+            # thread), so per-replica metrics stay exact even when
+            # several in-process replicas share the engine's caches
+            sink = scalar_engine.new_stats_sink()
+            with scalar_engine.attribute_stats(sink):
+                self._verify_pending(items, pending, out, keys, aliased,
+                                     pks, seq, view_scoped)
+            self._fold_ecdsa_stats(sink)
         for ok in out:
             (self.sigs_verified if ok else self.sig_failures).inc()
         return out
+
+    def _verify_pending(self, items, pending: List[int], out: List[bool],
+                        keys: List[Optional[Tuple]], aliased, pks,
+                        seq: Optional[int], view_scoped: bool) -> None:
+        """Memo-miss residue: one cross-principal device dispatch when
+        configured and the sub-batch is big enough, else the grouped
+        host path. Successful current-key verdicts are memoized."""
+        sub = [items[i] for i in pending]
+        verdicts = None
+        use_device = (self._batch_fn is not None
+                      and len(sub) >= self.device_min_batch)
+        if use_device and not device_breaker().allow():
+            # non-mutating preview: while the breaker is OPEN, skip
+            # building the device batch entirely instead of paying
+            # list construction + a BreakerOpen round-trip on every
+            # degraded verify (attempt() below still guards the
+            # admitted path — a lost race just raises as before)
+            self.degraded_verifies.inc(len(sub))
+        elif use_device:
+            try:
+                verdicts, via_grace = self._verify_batch_cross(
+                    sub, seq, view_scoped,
+                    aliased=[aliased[i] for i in pending],
+                    pks=[pks[i] for i in pending])
+                self.batched_verifies.inc(len(sub))
+            except BreakerOpen:
+                # breaker tripped: fast-fail BEFORE the device — the
+                # scalar engines carry the load until the half-open
+                # probe re-admits the device
+                self.degraded_verifies.inc(len(sub))
+            except Exception:  # noqa: BLE001 — a device failure must
+                # degrade verification, never fail it: the breaker
+                # recorded the failure (trip after N consecutive)
+                log.warning("device verify batch failed (%d items); "
+                            "rerouting to scalar engines",
+                            len(sub), exc_info=True)
+                self.degraded_verifies.inc(len(sub))
+        if verdicts is None:
+            verdicts, via_grace = self._verify_batch_grouped(
+                sub, seq, view_scoped)
+            self.scalar_fallbacks.inc(len(sub))
+        for i, ok, grace in zip(pending, verdicts, via_grace):
+            out[i] = ok
+            # grace-key acceptances are deliberately NOT memoized:
+            # the memo must never outlive the grace window
+            if ok and not grace and keys[i] is not None:
+                self._memo_add(keys[i])
+
+    def _fold_ecdsa_stats(self, sink) -> None:
+        """Fold this manager's attributed scalar-engine events into its
+        metrics component + batch-shape histogram (covers BOTH host
+        routes — the grouped fallback and verify_batch_mixed's
+        below-crossover ride, the default on a cpu backend)."""
+        if sink["host_items"]:
+            self.ecdsa_batched_host.inc(sink["host_items"])
+        if sink["hits"]:
+            self.pubkey_memo_hits.inc(sink["hits"])
+        for size in sink["host_sizes"]:
+            self._h_ecdsa_host_batch.record(size)
 
     def _verify_batch_grouped(self, items: Sequence[Tuple[int, bytes, bytes]],
                               seq: Optional[int], view_scoped: bool

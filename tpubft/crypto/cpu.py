@@ -222,6 +222,25 @@ class EcdsaVerifier(IVerifier):
                                    self.curve_name)
 
     @property
+    def uses_scalar_engine(self) -> bool:
+        """True when verifies run on the in-repo scalar engine (no
+        OpenSSL) — the shape whose batches ride ecdsa_verify_batch."""
+        return self._pk is None
+
+    def verify_batch(self, items) -> list:
+        """Batch verification through the Montgomery/comb engine
+        (scalar.ecdsa_verify_batch) when the scalar path would carry the
+        items anyway: this is what keeps degraded mode (breaker OPEN, no
+        device, no OpenSSL) at thousands of verifies/sec instead of the
+        per-item ladder's tens. With OpenSSL present the per-item
+        C-backed verify is already faster than the batched python walk."""
+        if self.uses_scalar_engine and len(items) > 1:
+            return scalar.ecdsa_verify_batch(
+                [(self.public_key_bytes, d, s) for d, s in items],
+                self.curve_name)
+        return [self.verify(d, s) for d, s in items]
+
+    @property
     def signature_length(self) -> int:
         return ECDSA_SIG_LEN
 

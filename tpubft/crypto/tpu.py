@@ -47,14 +47,53 @@ def verify_batch_items(items: Sequence[Tuple[bytes, bytes, bytes]]
             ops.verify_batch([(d, s, pk) for pk, d, s in items])]
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _platform_default_crossover() -> int:
+    """Platform half of the crossover default — the expensive
+    jax.devices() probe cannot change after process start, so it
+    resolves once."""
+    import jax
+    return 1 if jax.devices()[0].platform != "cpu" else 1 << 30
+
+
+def _ecdsa_device_crossover() -> int:
+    """Minimum ECDSA sub-batch size that rides the device RLC kernel;
+    smaller groups verify through the batched host engine
+    (crypto/scalar.ecdsa_verify_batch). TPUBFT_ECDSA_CROSSOVER_B is
+    exported by `benchmarks/bench_msm_crossover.py --ecdsa` (env read
+    stays per-call: tests flip it at runtime); unset, the default
+    prefers the device on real accelerators and the batched host on
+    the XLA-CPU fallback (where the kernel is ~100x slower than the
+    comb walk — BENCH_r05's 30-34/s cliff)."""
+    import os
+    v = os.environ.get("TPUBFT_ECDSA_CROSSOVER_B")
+    if v is not None:
+        try:
+            return int(v)
+        except ValueError:
+            # a malformed knob must not poison every verify batch (the
+            # caller's degrade-never-fail wrapper would reroute forever
+            # with only a cryptic per-batch traceback)
+            import logging
+            logging.getLogger("tpubft.crypto").warning(
+                "ignoring non-integer TPUBFT_ECDSA_CROSSOVER_B=%r", v)
+    return _platform_default_crossover()
+
+
 def verify_batch_mixed(items: Sequence[Tuple[str, bytes, bytes, bytes]]
                        ) -> List[bool]:
     """SigManager's cross-principal batch entry: (scheme, pubkey, data,
     sig) tuples, one device dispatch per scheme present. This is how the
     secp256k1/P-256 client-auth mix of BASELINE configs 3/5 rides the
     device: EdDSA through the windowed ed25519 kernel, ECDSA through the
-    Shamir-ladder kernel (tpubft/ops/ecdsa.py — the batched counterpart of
-    the reference's per-message ECDSAVerifier, crypto_utils.hpp:57-73)."""
+    RLC batch kernel (tpubft/ops/ecdsa.rlc_verify_batch — one MSM-shaped
+    launch per flush, the batched counterpart of the reference's
+    per-message ECDSAVerifier, crypto_utils.hpp:57-73). ECDSA groups
+    below the measured device crossover verify on the batched host
+    engine instead of paying a losing kernel dispatch."""
     groups = {}
     for i, (scheme, pk, data, sig) in enumerate(items):
         groups.setdefault(scheme, []).append(i)
@@ -66,10 +105,15 @@ def verify_batch_mixed(items: Sequence[Tuple[str, bytes, bytes, bytes]]
                                            for _, pk, d, s in sub])
         elif scheme in ("ecdsa-secp256k1", "secp256k1",
                         "ecdsa-secp256r1", "secp256r1", "ecdsa-p256"):
-            from tpubft.ops import ecdsa as ops_ecdsa
             curve = ("secp256k1" if "k1" in scheme else "secp256r1")
-            verdicts = [bool(x) for x in ops_ecdsa.verify_batch(
-                curve, [(d, s, pk) for _, pk, d, s in sub])]
+            if len(sub) >= _ecdsa_device_crossover():
+                from tpubft.ops import ecdsa as ops_ecdsa
+                verdicts = [bool(x) for x in ops_ecdsa.rlc_verify_batch(
+                    curve, [(d, s, pk) for _, pk, d, s in sub])]
+            else:
+                from tpubft.crypto import scalar as _scalar
+                verdicts = _scalar.ecdsa_verify_batch(
+                    [(pk, d, s) for _, pk, d, s in sub], curve)
         else:                       # unknown scheme: CPU fallback
             from tpubft.crypto.cpu import make_verifier
             verdicts = []

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpubft.crypto import bls12381 as ref
-from tpubft.ops.field import get_field
+from tpubft.ops.field import get_field, pad_pow2 as _pad_pow2
 from tpubft.ops.weierstrass import Curve, WPoint
 
 
@@ -41,13 +41,6 @@ def _bits_msb_batch(scalars: Sequence[int]) -> np.ndarray:
         for i in range(SCALAR_BITS):
             out[i, j] = (k >> (SCALAR_BITS - 1 - i)) & 1
     return out
-
-
-def _pad_pow2(n: int) -> int:
-    m = 1
-    while m < n:
-        m *= 2
-    return m
 
 
 @functools.partial(jax.jit, static_argnums=())

@@ -35,6 +35,15 @@ LIMB_BITS = 11
 LIMB_MASK = (1 << LIMB_BITS) - 1
 
 
+def pad_pow2(n: int) -> int:
+    """Smallest power of two >= n — batch axes are padded to powers of
+    two so the jit cache stays at O(log sizes) compiled programs."""
+    m = 1
+    while m < n:
+        m *= 2
+    return m
+
+
 def int_to_limbs(x: int, n_limbs: int) -> np.ndarray:
     out = np.zeros(n_limbs, dtype=np.int32)
     for i in range(n_limbs):
