@@ -113,6 +113,89 @@ def sweep_row(scheme: str, n: int, k: int, slots: int, backend: str,
     return _annotate_device(row, backend)
 
 
+def autotune_row(scheme: str, n: int, k: int, slots: int, backend: str,
+                 secs: float) -> dict:
+    """--sweep --autotune leg (ISSUE 14 satellite): the combine flush
+    knobs now feed through the knob registry, so this leg drives a LIVE
+    CombineBatcher end-to-end through that seam — a pipelined producer
+    replays `slots` collectors per round while a measured-rate hill
+    climb votes the `combine_batch_max` knob through the registry's
+    hysteresis/step machinery (the in-replica controller votes from
+    kernel/stage telemetry instead; the actuator path is identical).
+    Reports the static-default rate vs the converged operating point,
+    with verdict correctness asserted on every flush."""
+    import threading
+    from tpubft.consensus.collectors import CombineBatcher, ShareCollector
+    from tpubft.tuning.knobs import GROW, SHRINK, Knob, KnobRegistry
+    system, v = _verifier(scheme, k, n, backend)
+    jobs = _jobs(system, k, slots)
+    reference = IThresholdVerifier.combine_batch(v, jobs)
+    collectors = [ShareCollector(0, i, "commit", d, v)
+                  for i, (d, _s) in enumerate(jobs)]
+    done = threading.Semaphore(0)
+    bad = []
+
+    def post(res):
+        ok, combined, shares = reference[res.seq_num]
+        if bool(res.ok) != bool(ok) or res.combined_sig != combined:
+            bad.append(res.seq_num)
+        done.release()
+
+    batcher = CombineBatcher(post, flush_us=300, max_batch=64)
+    registry = KnobRegistry("bench-combine")
+    registry.register(Knob(
+        name="combine_batch_max", value=64, default=64, lo=1, hi=512,
+        cooldown_s=0.0, hysteresis=1,
+        apply_fn=lambda val: batcher.reconfigure(max_batch=val)))
+    registry.register(Knob(
+        name="combine_flush_us", value=300, default=300, lo=0, hi=5000,
+        cooldown_s=0.0, hysteresis=1,
+        apply_fn=lambda val: batcher.reconfigure(flush_us=val)))
+
+    def pump(window_s: float) -> float:
+        t0 = time.perf_counter()
+        rounds = 0
+        while True:
+            for c, (_d, shares) in zip(collectors, jobs):
+                batcher.submit(c, shares)
+            for _ in jobs:
+                done.acquire()
+            rounds += 1
+            dt = time.perf_counter() - t0
+            if dt >= window_s and rounds >= 2:
+                return rounds * slots / dt
+
+    try:
+        pump(0.05)                              # warmup / compile
+        default_rate = pump(secs / 2)
+        best_rate, stale = default_rate, 0
+        for _ in range(10):                     # bounded hill climb
+            if stale >= 2:
+                break
+            direction = GROW if stale == 0 else SHRINK
+            if registry.vote("combine_batch_max", direction):
+                registry.step("combine_batch_max", direction)
+            rate = pump(secs / 6)
+            if rate > best_rate * 1.02:
+                best_rate, stale = rate, 0
+            else:
+                stale += 1
+        tuned_rate = max(best_rate, default_rate)
+    finally:
+        batcher.stop()
+    row = {
+        "bench": "combine_autotune", "scheme": scheme,
+        "backend": backend, "n": n, "k": k, "in_flight_slots": slots,
+        "default_combines_per_sec": round(default_rate, 1),
+        "tuned_combines_per_sec": round(tuned_rate, 1),
+        "tuned_over_default": round(tuned_rate / default_rate, 2),
+        "converged_batch_max": registry.get("combine_batch_max"),
+        "converged_flush_us": registry.get("combine_flush_us"),
+        "verdicts_match": not bad,
+    }
+    return _annotate_device(row, backend)
+
+
 def crossover_row(n: int, k: int, slots: int, backend: str,
                   secs: float) -> dict:
     """Per-combine µs of both certificate schemes at committee size n:
@@ -154,6 +237,10 @@ def main(argv: List[str] = None) -> int:
                     help="measurement window per point")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 shape: tiny sizes, correctness gates")
+    ap.add_argument("--autotune", action="store_true",
+                    help="with --sweep: add the knob-registry leg — a "
+                         "live CombineBatcher hill-climbed through the "
+                         "registry seam vs the static default")
     args = ap.parse_args(argv)
     if args.smoke:
         rows = [sweep_row("threshold-bls", 4, 3, 4, "cpu", 0.1),
@@ -170,6 +257,13 @@ def main(argv: List[str] = None) -> int:
             for slots in [int(x) for x in args.slots.split(",")]:
                 row = sweep_row(scheme, 4, 3, slots, args.backend,
                                 args.secs)
+                rc |= 0 if row["verdicts_match"] else 1
+                print(json.dumps(row), flush=True)
+        if args.autotune:
+            for scheme in ("threshold-bls", "multisig-ed25519"):
+                slots = max(int(x) for x in args.slots.split(","))
+                row = autotune_row(scheme, 4, 3, slots, args.backend,
+                                   args.secs)
                 rc |= 0 if row["verdicts_match"] else 1
                 print(json.dumps(row), flush=True)
     if args.crossover:

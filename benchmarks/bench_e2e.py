@@ -176,6 +176,14 @@ def run_config(config: int, backend: str, secs: float,
             from tpubft.utils import flight
             row["stage_breakdown"] = flight.stage_summary()
             row["kernel_profile"] = flight.kernel_profiler().snapshot()
+            # autotuner state (knob values + decision log per replica)
+            # while the controllers are still registered — bench_autotune
+            # joins this to the A/B goodput rows
+            tuning = {name: state for name, state
+                      in flight._provider_payloads().items()
+                      if name.startswith("tuning")}
+            if tuning:
+                row["tuning_state"] = tuning
         return row
 
 

@@ -165,12 +165,31 @@ def main_ecdsa(args) -> None:
         print(json.dumps(row), flush=True)
     crossover = min((r["batch"] for r in rows if r["device_wins"]),
                     default=None)
-    print(json.dumps({
-        "crossover_b": crossover,
-        "recommend": "TPUBFT_ECDSA_CROSSOVER_B=%s" % (
-            crossover if crossover is not None
-            else "unset (batched host always wins here; SigManager "
-                 "routes ECDSA to ecdsa_verify_batch)")}), flush=True)
+    summary = {"crossover_b": crossover}
+    if args.seed_out:
+        # knob-registry seed file, the ISSUE-14 handoff: the autotuner
+        # loads it at replica wiring (ReplicaConfig.autotune_seed_file)
+        # and re-baselines the knob's default to the measured value —
+        # replacing the old copy-an-env-export workflow. No measured
+        # crossover (host always wins, this container's XLA-CPU case)
+        # seeds the always-host sentinel instead of omitting the knob,
+        # so the seed still overrides a stale env export.
+        from tpubft.tuning.knobs import write_seed
+        value = crossover if crossover is not None else 1 << 20
+        summary["seed_file"] = write_seed(
+            args.seed_out, {"ecdsa_crossover_b": value},
+            note="bench_msm_crossover --ecdsa (%s): device RLC vs "
+                 "batched host, batches %s" % (args.curve, args.batches))
+        summary["recommend"] = (
+            "--config-override autotune_seed_file=%s" % args.seed_out)
+    else:
+        summary["recommend"] = (
+            "rerun with --seed-out <path> to emit a knob-registry seed "
+            "file (autotune_seed_file)"
+            if crossover is not None
+            else "batched host always wins here; SigManager routes "
+                 "ECDSA to ecdsa_verify_batch (--seed-out pins it)")
+    print(json.dumps(summary), flush=True)
 
 
 def main() -> None:
@@ -187,6 +206,11 @@ def main() -> None:
     ap.add_argument("--curve", default="secp256k1",
                     choices=("secp256k1", "secp256r1"))
     ap.add_argument("--principals", type=int, default=8)
+    ap.add_argument("--seed-out", default=None,
+                    help="with --ecdsa: write the measured crossover as "
+                         "a knob-registry seed file (load via "
+                         "ReplicaConfig.autotune_seed_file) instead of "
+                         "an env-export line")
     args = ap.parse_args()
     if args.ecdsa:
         main_ecdsa(args)

@@ -312,6 +312,86 @@ def test_pubkey_decode_memo_counter_flows():
     assert sm2.pubkey_memo_hits.value == 0
 
 
+def test_two_replica_concurrent_drain_is_exact():
+    """ISSUE 14 satellite: the per-sink drain is atomic. Two replicas'
+    SigManagers hammer the shared batched host engine from separate
+    threads, each draining its attributed sink per verify call
+    (`_fold_ecdsa_stats` → StatsSink.drain). Exact accounting must
+    hold: each manager's `ecdsa_batched_host` equals exactly the ECDSA
+    items IT verified (no lost updates, no cross-replica bleed), host
+    timing flows, and the module-level fallback sink stays untouched."""
+    import threading
+    from tpubft.consensus.sig_manager import SigManager
+    cfg, keys = _mixed_cluster()
+    corpus, want = _mixed_corpus(cfg, keys)
+    # per round, the grouped fallback batches the two >=2-item ECDSA
+    # principal groups (valid+forged, valid+junk) through the host
+    # engine; the lone third client sig rides the per-item path
+    ecdsa_items = 4
+    rounds = 20
+    scalar.consume_decode_stats()      # reset the module fallback sink
+    sms = [SigManager(keys.for_node(r), memo_capacity=0)
+           for r in (0, 2)]
+    # the batch-shape histograms live in the process-global registrar
+    # (earlier tests' node-0 managers share the name): assert deltas
+    h_before = [sm._h_ecdsa_host_batch.snapshot()["count"] for sm in sms]
+    errs = []
+    gate = threading.Barrier(2)
+
+    def drive(sm):
+        try:
+            gate.wait(timeout=10)
+            for _ in range(rounds):
+                assert sm.verify_batch(corpus) == want
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=drive, args=(sm,)) for sm in sms]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    for sm, before in zip(sms, h_before):
+        assert sm.ecdsa_batched_host.value == ecdsa_items * rounds
+        assert sm.ecdsa_host_us.value > 0
+        assert sm._h_ecdsa_host_batch.snapshot()["count"] - before \
+            == 2 * rounds
+    # nothing leaked into the unattributed module sink
+    mod = scalar.consume_decode_stats()
+    assert mod["host_items"] == 0 and mod["hits"] == 0
+
+
+def test_stats_sink_drain_races_writer_exactly_once():
+    """StatsSink unit: a drain racing concurrent writers never loses or
+    double-counts an increment — sum(drains) + residue == writes."""
+    import threading
+    sink = scalar.StatsSink()
+    N, writers = 2000, 4
+    drained = []
+    stop = threading.Event()
+
+    def write():
+        for _ in range(N):
+            sink.add("host_items")
+
+    def drain_loop():
+        while not stop.is_set():
+            drained.append(sink.drain()["host_items"])
+
+    ts = [threading.Thread(target=write) for _ in range(writers)]
+    d = threading.Thread(target=drain_loop)
+    d.start()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    d.join()
+    total = sum(drained) + sink.drain()["host_items"]
+    assert total == N * writers
+
+
 def test_ecdsa_verifier_batch_seam():
     """cpu.EcdsaVerifier.verify_batch == per-item verify (the seam
     SigManager's grouped fallback drains into)."""

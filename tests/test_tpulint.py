@@ -206,6 +206,63 @@ def test_foreign_store_single_writer_role_clean(fixture_tree):
         [f.render() for f in findings]
 
 
+_KNOB_STORE = """\
+from tpubft.utils.racecheck import make_lock
+
+class Knob:
+    def __init__(self):
+        self._mu = make_lock("tuning.knobs")
+        self.value = 100
+
+    def set(self, v):
+        with self._mu:
+            self.value = v
+
+class Controller:
+    def poll(self, k: Knob):
+        k.set(5)
+
+class Handler:
+    def on_msg(self, k: Knob):
+        k.value = 7
+"""
+
+_KNOB_SEEDS = {
+    ("tpubft/fix.py", "Controller", "poll"): frozenset({"tuner"}),
+    ("tpubft/fix.py", "Handler", "on_msg"): frozenset({"dispatcher"}),
+}
+
+
+def test_knob_store_from_non_controller_role_caught(fixture_tree):
+    """ISSUE 14 satellite: the autotuner's thread discipline is
+    lint-enforced. Knob values mutate only through the registry's
+    locked store path on the tuner role — a raw knob store from any
+    other role (here the dispatcher poking `k.value` directly) is a
+    static-race finding, exactly like the CollectorPool foreign-store
+    seam PR 11 pinned."""
+    root = fixture_tree(_KNOB_STORE, _KNOB_SEEDS)
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles", "static-race"])
+    race = [f for f in findings if f.pass_id == "static-race"]
+    assert len(race) == 1, [f.render() for f in findings]
+    f = race[0]
+    assert f.key == "tpubft/fix.py:Handler.on_msg:k.value:foreign", \
+        f.render()
+    assert "tuner" in f.message and "dispatcher" in f.message
+
+
+def test_knob_store_via_registry_path_clean(fixture_tree):
+    """Same shape, but the non-controller role only READS the knob (the
+    hot-path pull-style consumers) and every store rides the locked
+    registry path: clean."""
+    src = _KNOB_STORE.replace("k.value = 7", "_ = k.value")
+    root = fixture_tree(src, _KNOB_SEEDS)
+    findings, _, _ = analyze(root,
+                             pass_ids=["thread-roles", "static-race"])
+    assert [f for f in findings if f.pass_id == "static-race"] == [], \
+        [f.render() for f in findings]
+
+
 def test_race_fixture_reports_file_line_roles(fixture_tree):
     root = fixture_tree(_RACY, _RACE_SEEDS)
     findings, _, _ = analyze(root,

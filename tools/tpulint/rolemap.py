@@ -39,6 +39,13 @@ THREAD_ROLES: Dict[FuncId, FrozenSet[str]] = {
         frozenset({"admission"}),
     ("tpubft/consensus/health.py", "HealthMonitor", "_run"):
         frozenset({"health"}),
+    # autotuner control loop (tpubft/tuning/): the ONLY role that may
+    # store knob values post-wiring — every store goes through
+    # KnobRegistry.set under the registry lock, and the static-race
+    # pass catches a knob store from any other role (see the knob-store
+    # fixture in tests/test_tpulint.py)
+    ("tpubft/tuning/controller.py", "TuningController", "_run"):
+        frozenset({"tuner"}),
     # infrastructure
     ("tpubft/utils/racecheck.py", "StallWatchdog", "_run"):
         frozenset({"watchdog"}),

@@ -304,6 +304,28 @@ class AdmissionPipeline:
         self._buf.append((sender, raw))
         return "ok"
 
+    def set_watermarks(self, high_watermark: int,
+                       low_watermark: int) -> None:
+        """Autotuner actuator: retune the overload watermarks live.
+        Same clamping as construction (both bounded by max_pending, low
+        strictly under high so the hysteresis gap never inverts); a
+        shed mode now outside the new band clears on the next ingest's
+        watermark pass."""
+        with self._cv:
+            self._high = min(high_watermark, self._max_pending) \
+                if high_watermark else 0
+            self._low = min(low_watermark, self._high - 1) if self._high \
+                else low_watermark
+            if not self._high and self._shedding:
+                # shedding disabled mid-episode: nothing will ever
+                # cross the (gone) low watermark to clear the flag
+                self._shedding = False
+                self.adm_shedding.set(0)
+
+    @property
+    def high_watermark(self) -> int:
+        return self._high
+
     def submit(self, sender: int, raw: bytes) -> bool:
         flight.record(flight.EV_ADM_INGEST, arg=1)
         cls = self._class_of(raw)

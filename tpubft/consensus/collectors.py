@@ -137,6 +137,12 @@ class CertBatchVerifier:
                cookie) -> None:
         self._batcher.submit((verifier, digest, sig, cookie))
 
+    def reconfigure(self, max_batch: int = None,
+                    flush_us: int = None) -> None:
+        """Autotuner actuator: retune the cert-batch flush live."""
+        self._batcher.reconfigure(batch_size=max_batch,
+                                  flush_us=flush_us)
+
     def _drain(self, batch) -> None:
         # keyed by the verifier OBJECT, not id(): the dict key holds the
         # verifier alive for the drain, so a GC'd-and-recycled id can
@@ -193,6 +199,14 @@ class CombineBatcher:
         """Dispatcher-side: `snapshot` was taken under the dispatcher's
         ownership of collector.shares; the drain only reads it."""
         self._batcher.submit((collector, snapshot))
+
+    def reconfigure(self, max_batch: int = None,
+                    flush_us: int = None) -> None:
+        """Autotuner actuator: retune the fused-combine flush live
+        (combine_flush_us / combine_batch_max move through the knob
+        registry after startup, not the frozen ReplicaConfig field)."""
+        self._batcher.reconfigure(batch_size=max_batch,
+                                  flush_us=flush_us)
 
     def _drop(self, item: Tuple[ShareCollector, Dict[int, bytes]]) -> None:
         # stopped batcher: resolve as a combine failure so the
@@ -278,6 +292,14 @@ class CollectorPool:
             return False
         self._pool.submit(fn)
         return True
+
+    def reconfigure(self, max_batch: int = None,
+                    flush_us: int = None) -> None:
+        """Autotuner actuator (no-op on the per-collector control
+        path, which has no flush to tune)."""
+        if self._combiner is not None:
+            self._combiner.reconfigure(max_batch=max_batch,
+                                       flush_us=flush_us)
 
     def maybe_launch(self, collector: ShareCollector) -> bool:
         """Called on the dispatcher thread only; snapshots the share set

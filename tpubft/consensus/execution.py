@@ -161,6 +161,17 @@ class ExecutionLane:
             self._thread = None
         get_watchdog().unregister(self._name)
 
+    def set_max_accumulation(self, n: int) -> None:
+        """Autotuner actuator: retune the run-coalescing cap live. The
+        lane thread reads it once per run pop (under the condition), so
+        the new cap applies from the next run."""
+        with self._cond:
+            self._max_acc = max(1, int(n))
+
+    @property
+    def max_accumulation(self) -> int:
+        return self._max_acc
+
     # ------------------------------------------------------------------
     # dispatcher-side API
     # ------------------------------------------------------------------

@@ -585,6 +585,21 @@ class Replica(IReceiver):
                 busy_fn=lambda: not self.exec_lane.idle(),
                 detail_fn=lambda: {"depth": self.exec_lane.depth})
 
+        # --- closed-loop autotuner (tpubft/tuning/): drives the perf
+        # knobs above (flush windows, batch caps, accumulation depth,
+        # admission watermarks, ECDSA crossover) from the telemetry
+        # plane, backing everything off to the configured defaults
+        # whenever health leaves `healthy` or a breaker opens. The
+        # ReplicaConfig fields seed the knob registry; after this point
+        # the registry — not the frozen dataclass — owns the values.
+        self.tuning = None
+        if cfg.autotune_enabled:
+            from tpubft.tuning import build_replica_tuning
+            self.tuning = build_replica_tuning(self, cfg)
+            self._diag.register_status(f"replica{self.id}.tuning",
+                                       self.tuning.render)
+            self._diag.register_status("tuning", self.tuning.render)
+
         # assigned BEFORE the restore replay: _restore_window can reach
         # _execute_committed, whose pipeline retrigger reads _running
         self._running = False
@@ -755,6 +770,8 @@ class Replica(IReceiver):
         if self.thin_replica is not None:
             self.thin_replica.start()
         self.health.start()
+        if self.tuning is not None:
+            self.tuning.start()
         self.dispatcher.start()
         with mdc_scope(r=self.id):       # start() runs on the caller thread
             log.info("replica up: n=%d f=%d c=%d view=%d primary=%d "
@@ -778,6 +795,8 @@ class Replica(IReceiver):
             self.admission.stop()
         if self.thin_replica is not None:
             self.thin_replica.stop()
+        if self.tuning is not None:
+            self.tuning.stop()
         self.health.stop()
         self.dispatcher.stop()
         self.collector_pool.shutdown()

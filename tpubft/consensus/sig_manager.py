@@ -120,6 +120,13 @@ class SigManager:
             "ecdsa_batched_host")
         self.pubkey_memo_hits = self.metrics.register_counter(
             "pubkey_memo_hits")
+        # cumulative wall time the batched host engine spent on THIS
+        # manager's items (µs) — with ecdsa_batched_host this yields the
+        # host tier's per-item cost, the sensor the autotuner compares
+        # against the kernel profiler's `ecdsa` device tier to place
+        # the crossover knob
+        self.ecdsa_host_us = self.metrics.register_counter(
+            "ecdsa_host_us")
         from tpubft.diagnostics import get_registrar
         # replica-scoped (PR 11's replica<id>.combine_batch_size
         # convention) so in-process multi-replica topologies don't
@@ -395,12 +402,18 @@ class SigManager:
         """Fold this manager's attributed scalar-engine events into its
         metrics component + batch-shape histogram (covers BOTH host
         routes — the grouped fallback and verify_batch_mixed's
-        below-crossover ride, the default on a cpu backend)."""
-        if sink["host_items"]:
-            self.ecdsa_batched_host.inc(sink["host_items"])
-        if sink["hits"]:
-            self.pubkey_memo_hits.inc(sink["hits"])
-        for size in sink["host_sizes"]:
+        below-crossover ride, the default on a cpu backend). The drain
+        is atomic per sink (StatsSink.drain swaps under the sink lock),
+        so concurrent drains — two replicas' managers, or a drain
+        racing a straggler increment — never lose or double-count."""
+        stats = sink.drain()
+        if stats["host_items"]:
+            self.ecdsa_batched_host.inc(stats["host_items"])
+        if stats["host_ns"]:
+            self.ecdsa_host_us.inc(stats["host_ns"] // 1000)
+        if stats["hits"]:
+            self.pubkey_memo_hits.inc(stats["hits"])
+        for size in stats["host_sizes"]:
             self._h_ecdsa_host_batch.record(size)
 
     def _verify_batch_grouped(self, items: Sequence[Tuple[int, bytes, bytes]],
@@ -529,6 +542,14 @@ class BatchVerifier:
         non-blocking entry the replica's admission path uses — the
         dispatcher thread never waits on a verdict."""
         self._batcher.submit((principal, data, sig, resolve))
+
+    def reconfigure(self, batch_size: int = None,
+                    flush_us: int = None) -> None:
+        """Autotuner actuator: retune the verify batch cap / flush
+        window live (ReplicaConfig seeds the defaults; the knob
+        registry owns them after startup)."""
+        self._batcher.reconfigure(batch_size=batch_size,
+                                  flush_us=flush_us)
 
     def _drain(self, batch) -> None:
         verdicts = self._sm.verify_batch([(p, d, s) for p, d, s, _ in batch])

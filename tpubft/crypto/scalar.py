@@ -546,29 +546,72 @@ _cache_lock = _make_stats_lock()
 # busy principal's hot comb is never evicted by insertion age)
 from collections import OrderedDict as _OrderedDict
 _pk_cache: "_OrderedDict[Tuple[str, bytes], _PubkeyEntry]" = _OrderedDict()
-_decode_stats = {"hits": 0, "misses": 0, "comb_builds": 0,
-                 "host_batches": 0, "host_items": 0}
-# recent batch sizes for the autotuner histogram (drained with the
-# stats; bounded so a drain-less standalone user can't grow it)
-_host_batch_sizes: List[int] = []
 _HOST_SIZES_KEEP = 256
 _hot_combs: List[Tuple[str, bytes]] = []
+
+_SINK_KEYS = ("hits", "misses", "comb_builds", "host_batches",
+              "host_items", "host_ns")
+
+
+class StatsSink:
+    """Attributed counter sink with an ATOMIC drain: increments and the
+    drain-and-reset swap serialize on the sink's own lock, so two
+    replicas' SigManagers (or a writer racing a concurrent drain — the
+    event recorded on one side of the swap lands in exactly one drain,
+    never both, never neither) can't lose or double-count updates.
+    `host_ns` carries the batched engine's wall time — the autotuner's
+    host-tier cost sensor next to the kernel profiler's device tier."""
+
+    __slots__ = ("_mu", "_d", "_sizes")
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._d = {k: 0 for k in _SINK_KEYS}
+        self._sizes: List[int] = []
+
+    def add(self, key: str, amount: int = 1) -> None:
+        with self._mu:
+            self._d[key] += amount
+
+    def note_host_batch(self, size: int, elapsed_ns: int = 0) -> None:
+        with self._mu:
+            self._d["host_batches"] += 1
+            self._d["host_items"] += size
+            self._d["host_ns"] += elapsed_ns
+            self._sizes.append(size)
+            del self._sizes[:-_HOST_SIZES_KEEP]
+
+    def drain(self) -> Dict[str, object]:
+        """Atomic drain-and-reset: one lock section swaps the counters
+        out, so a concurrent writer's increment is either in this drain
+        or the next — never torn across both."""
+        with self._mu:
+            out: Dict[str, object] = dict(self._d)
+            out["host_sizes"] = self._sizes
+            self._d = {k: 0 for k in _SINK_KEYS}
+            self._sizes = []
+        return out
+
+
+# module-level fallback sink: engine users outside an attribute_stats
+# scope (standalone benches, direct cpu.EcdsaVerifier callers) land
+# here; consume_decode_stats drains it
+_module_sink = StatsSink()
 
 # thread-local stats attribution: a SigManager wraps its verification in
 # `attribute_stats(sink)` so events recorded on ITS thread land in ITS
 # sink — exact per-replica metrics in multi-replica processes, where the
 # engine (and its caches) is shared module state.  Without a sink,
-# events fall through to the module counters above.
+# events fall through to the module sink above.
 _TLS = threading.local()
 
 
-def new_stats_sink() -> Dict[str, object]:
-    return {"hits": 0, "misses": 0, "comb_builds": 0,
-            "host_batches": 0, "host_items": 0, "host_sizes": []}
+def new_stats_sink() -> StatsSink:
+    return StatsSink()
 
 
 @contextlib.contextmanager
-def attribute_stats(sink: Dict[str, object]):
+def attribute_stats(sink: StatsSink):
     prev = getattr(_TLS, "sink", None)
     _TLS.sink = sink
     try:
@@ -577,27 +620,17 @@ def attribute_stats(sink: Dict[str, object]):
         _TLS.sink = prev
 
 
+def _sink() -> StatsSink:
+    sink = getattr(_TLS, "sink", None)
+    return sink if sink is not None else _module_sink
+
+
 def _stat(key: str, amount: int = 1) -> None:
-    sink = getattr(_TLS, "sink", None)
-    if sink is not None:
-        sink[key] += amount
-        return
-    with _cache_lock:
-        _decode_stats[key] += amount
+    _sink().add(key, amount)
 
 
-def _note_host_batch(size: int) -> None:
-    sink = getattr(_TLS, "sink", None)
-    if sink is not None:
-        sink["host_batches"] += 1
-        sink["host_items"] += size
-        sink["host_sizes"].append(size)
-        return
-    with _cache_lock:
-        _decode_stats["host_batches"] += 1
-        _decode_stats["host_items"] += size
-        _host_batch_sizes.append(size)
-        del _host_batch_sizes[:-_HOST_SIZES_KEEP]
+def _note_host_batch(size: int, elapsed_ns: int = 0) -> None:
+    _sink().note_host_batch(size, elapsed_ns)
 
 
 def _pk_entry(pk: bytes, curve_name: str) -> _PubkeyEntry:
@@ -642,17 +675,11 @@ def reset_ecdsa_caches() -> None:
 
 
 def consume_decode_stats() -> Dict[str, object]:
-    """Drain-and-reset the decode-memo counters plus recent host batch
-    sizes (SigManager feeds them into its metrics component and batch
-    histogram; draining keeps multi-replica processes from
-    double-counting one shared module-level engine)."""
-    with _cache_lock:
-        out: Dict[str, object] = dict(_decode_stats)
-        out["host_sizes"] = list(_host_batch_sizes)
-        _host_batch_sizes.clear()
-        for k in _decode_stats:
-            _decode_stats[k] = 0
-    return out
+    """Drain-and-reset the module-level (unattributed) sink: decode-memo
+    counters plus recent host batch sizes/time. Atomic per sink
+    (StatsSink.drain) — concurrent drains can't double-count, and a
+    racing writer's increment lands in exactly one drain."""
+    return _module_sink.drain()
 
 
 def _q_comb(entry: _PubkeyEntry, key: Tuple[str, bytes], batch: int):
@@ -775,14 +802,25 @@ def ecdsa_verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]],
     """Batched ECDSA verify: items are (pubkey, message, raw r||s sig)
     triples (pubkeys may all differ).  Verdict-identical to calling
     `ecdsa_verify` per item, ~10x faster at batch 256 on the bench
-    container (see benchmarks/RESULTS.md)."""
+    container (see benchmarks/RESULTS.md). Batch shape AND wall time
+    land in the attributed stats sink (`host_ns`) — the autotuner's
+    host-tier cost sensor for the device/host crossover."""
+    if not items:
+        return []
+    import time as _time
+    t0 = _time.monotonic_ns()
+    try:
+        return _ecdsa_verify_batch(items, curve_name)
+    finally:
+        _note_host_batch(len(items), _time.monotonic_ns() - t0)
+
+
+def _ecdsa_verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]],
+                        curve_name: str) -> List[bool]:
     cv = CURVES[curve_name]
     p, n, a = cv["p"], cv["n"], cv["a"]
     B = len(items)
     out = [False] * B
-    if B == 0:
-        return out
-    _note_host_batch(B)
     chk = ecdsa_precheck_batch(items, curve_name)
     rs, u1, u2 = chk.r, chk.u1, chk.u2
     if not chk.live:

@@ -59,16 +59,42 @@ def _platform_default_crossover() -> int:
     return 1 if jax.devices()[0].platform != "cpu" else 1 << 30
 
 
+# runtime override of the ECDSA device/host crossover — the autotuner's
+# actuator (tpubft/tuning/wiring.py drives it from measured `ecdsa`
+# kernel batch stats vs the batched-host timing counters). Process-wide
+# like the device itself: all replicas of one process share one
+# accelerator, so the last-configured value wins (same doctrine as the
+# breaker's configure()). None = fall through to the env knob/platform
+# default below.
+_crossover_override: Optional[int] = None
+
+
+def set_ecdsa_crossover(b: Optional[int]) -> None:
+    """Set (or with None, clear) the runtime ECDSA device/host
+    crossover. Takes precedence over TPUBFT_ECDSA_CROSSOVER_B."""
+    global _crossover_override
+    _crossover_override = None if b is None else max(1, int(b))
+
+
+def ecdsa_crossover() -> int:
+    """The effective crossover (override > env > platform default) —
+    the autotuner seeds its knob default from this."""
+    return _ecdsa_device_crossover()
+
+
 def _ecdsa_device_crossover() -> int:
     """Minimum ECDSA sub-batch size that rides the device RLC kernel;
     smaller groups verify through the batched host engine
-    (crypto/scalar.ecdsa_verify_batch). TPUBFT_ECDSA_CROSSOVER_B is
-    exported by `benchmarks/bench_msm_crossover.py --ecdsa` (env read
-    stays per-call: tests flip it at runtime); unset, the default
-    prefers the device on real accelerators and the batched host on
-    the XLA-CPU fallback (where the kernel is ~100x slower than the
-    comb walk — BENCH_r05's 30-34/s cliff)."""
+    (crypto/scalar.ecdsa_verify_batch). The runtime override (autotuner)
+    wins, then TPUBFT_ECDSA_CROSSOVER_B as exported by
+    `benchmarks/bench_msm_crossover.py --ecdsa` (env read stays
+    per-call: tests flip it at runtime); unset, the default prefers the
+    device on real accelerators and the batched host on the XLA-CPU
+    fallback (where the kernel is ~100x slower than the comb walk —
+    BENCH_r05's 30-34/s cliff)."""
     import os
+    if _crossover_override is not None:
+        return _crossover_override
     v = os.environ.get("TPUBFT_ECDSA_CROSSOVER_B")
     if v is not None:
         try:

@@ -239,6 +239,15 @@ class ChaosCampaign:
             device_breaker().reset()
         except Exception:  # noqa: BLE001 — cleanup is best-effort
             pass
+        try:
+            # the autotuner's ECDSA crossover override is process-wide
+            # (all replicas share the device): a scenario whose
+            # controllers moved it must not leak tuned routing into
+            # the next scenario's clusters
+            from tpubft.crypto import tpu
+            tpu.set_ecdsa_crossover(None)
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
 
 
 # ----------------------------------------------------------------------
@@ -452,6 +461,107 @@ def scenario_fused_flush_bad_share(ctx: ScenarioContext) -> dict:
         assert batches > 0, "fused combine batcher never drained"
     return {"recovery_s": round(recovery, 3),
             "combine_batches": batches}
+
+
+def scenario_autotune_stability(ctx: ScenarioContext) -> dict:
+    """Autotuner control-loop stability (ISSUE 14): a breaker flap plus
+    a load step must leave every knob convergent — the degraded rule
+    resets tuned knobs to their defaults the moment the breaker opens
+    (the controller never fights the degradation plane), tuning resumes
+    only after the healthy warmup, and across the whole scenario no
+    knob oscillates (bounded direction flips) or leaves its bounds."""
+    from tpubft.apps import counter
+    from tpubft.ops.dispatch import device_breaker
+    from tpubft.utils.breaker import CLOSED
+    b = device_breaker()
+    # scheduled facts: the operator-style knob nudges the reset must
+    # undo, and the load-step deltas
+    flush_nudge = ctx.randint("flush_nudge", 600, 1200)
+    acc_nudge = ctx.randint("acc_nudge", 2, 6)
+    deltas = [[ctx.randint(f"step{c}_{i}", 1, 50) for i in range(4)]
+              for c in (0, 1)]
+    ctx.event("knob_nudge", combine_flush_us=flush_nudge,
+              execution_max_accumulation=acc_nudge)
+    ctx.event("breaker_flap", threshold=b.failure_threshold)
+    MAX_FLIPS = 4
+    with _counter_cluster(ctx, num_clients=2, cfg_overrides={
+            "view_change_timer_ms": 2500,
+            "autotune_enabled": True,
+            "autotune_interval_ms": 40,
+            "autotune_cooldown_ms": 80}) as cluster:
+        reps = list(cluster.replicas.values())
+        assert all(r.tuning is not None for r in reps)
+        cl = cluster.client()
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(1), timeout_ms=30000)) == 1
+        # operator-style nudges away from the defaults, so the degraded
+        # reset has real work to prove
+        for r in reps:
+            r.tuning.registry.set("combine_flush_us", flush_nudge)
+            r.tuning.registry.set("execution_max_accumulation",
+                                  acc_nudge)
+        # breaker flap: trip OPEN; every controller (all replicas share
+        # the process-wide device) must back its knobs off to defaults
+        for _ in range(b.failure_threshold):
+            b.record_failure(kind="chaos", cause="injected")
+        assert b.state != CLOSED, "breaker did not trip"
+
+        def all_reset() -> bool:
+            return all(
+                r.tuning.registry.get("combine_flush_us")
+                == r.cfg.combine_flush_us
+                and r.tuning.registry.get("execution_max_accumulation")
+                == r.cfg.execution_max_accumulation for r in reps)
+
+        t0 = time.monotonic()
+        ctx.wait_until(all_reset, 15,
+                       what="degraded reset backs every knob to default")
+        reset_s = time.monotonic() - t0
+        assert all(r.exec_lane.max_accumulation
+                   == r.cfg.execution_max_accumulation for r in reps), \
+            "reset reached the registry but not the live actuator"
+        b.reset()
+        # load step under the restored device: two pipelined writers;
+        # the controller may tune, but must not oscillate
+        errs: list = []
+
+        def drive(idx: int) -> None:
+            c = cluster.client(idx)
+            try:
+                for d in deltas[idx]:
+                    c.send_write(counter.encode_add(d),
+                                 timeout_ms=30000)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=drive, args=(c,))
+                   for c in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, f"load step failed: {errs}"
+        total = 1 + sum(sum(ds) for ds in deltas)
+        _wait_converged(ctx, cluster, total, range(cluster.n), 20,
+                        "cluster converges through the flap + step")
+        # stability: bounded direction flips per knob, values in bounds
+        worst_flips = 0
+        steps = resets = 0
+        for r in reps:
+            snap = r.tuning.registry.snapshot()
+            for name, k in snap.items():
+                assert k["lo"] <= k["value"] <= k["hi"], \
+                    f"{name} out of bounds: {k}"
+                worst_flips = max(worst_flips, k["direction_flips"])
+            assert worst_flips <= MAX_FLIPS, \
+                f"knob oscillation on replica {r.id}: {snap}"
+            steps += r.tuning.m_steps.value
+            resets += r.tuning.m_resets.value
+        assert resets >= cluster.n, \
+            "not every controller observed the degraded episode"
+    return {"recovery_s": round(reset_s, 3),
+            "tune_steps": steps, "reset_episodes": resets,
+            "max_direction_flips": worst_flips}
 
 
 def scenario_crash_restart_replay(ctx: ScenarioContext) -> dict:
@@ -780,6 +890,9 @@ def smoke_matrix() -> List[ScenarioSpec]:
                                          "speculation")),
         ScenarioSpec("fused-flush-bad-share", scenario_fused_flush_bad_share,
                      "inproc", 90, tags=("byzantine", "combine")),
+        ScenarioSpec("autotune-stability", scenario_autotune_stability,
+                     "inproc", 90, tags=("autotune", "degraded",
+                                         "compound")),
         ScenarioSpec("crash-restart-replay", scenario_crash_restart_replay,
                      "inproc", 60, tags=("recovery",)),
         ScenarioSpec("thin-replica-failover",

@@ -1,0 +1,39 @@
+"""Closed-loop autotuner — the actuator half of the telemetry plane.
+
+PRs 5-8 built the sensors (breaker verdicts, HealthMonitor verdicts,
+flight-recorder stage histograms, kernel-profiler batch stats) but every
+performance actuator stayed a static knob hand-benched per machine:
+flush windows, batch caps, accumulation depth, admission watermarks, the
+ECDSA device/host crossover. This package closes the loop (ROADMAP item
+8): a per-replica `TuningController` thread periodically snapshots the
+telemetry plane and drives registered `Knob`s through per-knob policies,
+within operator-configured bounds, with hysteresis and cooldown so one
+noisy sample never flips a knob, and with one hard rule — when the
+HealthMonitor leaves `healthy` or any breaker opens, every unpinned knob
+backs off to its configured default (the controller never fights the
+degradation plane).
+
+Layout:
+
+  * ``knobs.py``     — `Knob` + `KnobRegistry` (bounds, step policy,
+                       hysteresis/cooldown bookkeeping, frozen pins,
+                       seed-file I/O);
+  * ``policies.py``  — the per-knob direction policies (grow/shrink/
+                       hold) over a `Telemetry` snapshot;
+  * ``controller.py``— the `TuningController` loop, decision log,
+                       `tuning` metrics component, `EV_TUNE` flight
+                       events, `status get tuning` payload;
+  * ``wiring.py``    — `build_replica_tuning(replica, cfg)`: the knob
+                       catalog for one replica, bound to its live
+                       actuator seams.
+
+See docs/OPERATIONS.md "Autotuning" for the knob catalog and the
+operator workflow (pinning, seed files, reading decisions).
+"""
+from tpubft.tuning.knobs import (Knob, KnobRegistry, load_seed,
+                                 write_seed)
+from tpubft.tuning.controller import TuningController
+from tpubft.tuning.wiring import build_replica_tuning
+
+__all__ = ["Knob", "KnobRegistry", "TuningController",
+           "build_replica_tuning", "load_seed", "write_seed"]

@@ -1,0 +1,198 @@
+"""Per-knob tuning policies — pure functions from telemetry to a
+direction vote.
+
+A policy never moves a knob itself: it votes GROW / SHRINK / HOLD each
+controller interval, and the registry's hysteresis (consecutive
+same-direction votes) + cooldown decide whether the vote becomes a
+step. Policies therefore stay simple threshold rules over the measured
+signals; the stability machinery lives in one place.
+
+The shared doctrine (ISSUE 14 / ROADMAP item 8):
+
+  * batch/flush knobs grow while the kernel profile shows falling
+    per-item cost (amortization still improving) and shrink as soon as
+    the latency-sensitive stage (`adm_wait` for the verify plane,
+    `commit` for the combine plane) dominates the slot breakdown —
+    batching is only worth the latency it buys back;
+  * `execution_max_accumulation` shrinks when `exec` dominates the
+    slot breakdown and grows back while the lane is deep and exec is
+    cheap;
+  * the ECDSA device/host crossover follows the measured per-item cost
+    of the `ecdsa` kernel vs the batched host engine;
+  * every policy HOLDs without fresh signal — an idle replica's knobs
+    must not wander.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from tpubft.tuning.knobs import GROW, HOLD, SHRINK, Knob
+from tpubft.utils.flight import PIPELINE_STAGES
+
+# a stage "dominates" the slot breakdown past this fraction of the
+# summed per-stage p50s
+DOMINANT_FRAC = 0.5
+# and is "cheap" below this fraction
+MINOR_FRAC = 0.2
+# per-item kernel cost is "falling" when the fresh interval's cost is
+# at most this ratio of the previous interval's
+FALLING_RATIO = 0.98
+# device/host crossover moves only on a >=10% measured cost gap
+CROSSOVER_MARGIN = 0.9
+
+
+@dataclass
+class Telemetry:
+    """One controller interval's sensor snapshot (built by the
+    controller; policies treat it read-only)."""
+
+    stages: Dict[str, Dict] = field(default_factory=dict)
+    kernels: Dict[str, Dict] = field(default_factory=dict)
+    breakers: Dict[str, Dict] = field(default_factory=dict)
+    health: str = "healthy"
+    depths: Dict[str, int] = field(default_factory=dict)
+    counters: Dict[str, float] = field(default_factory=dict)
+    completed_slots: int = 0
+
+
+Policy = Callable[[Telemetry, Optional[Telemetry], Knob], int]
+
+
+# ----------------------------------------------------------------------
+# signal helpers
+# ----------------------------------------------------------------------
+def fresh_slots(cur: Telemetry, prev: Optional[Telemetry]) -> int:
+    if prev is None:
+        return 0
+    return max(0, cur.completed_slots - prev.completed_slots)
+
+
+def stage_fraction(tel: Telemetry, stage: str) -> float:
+    """`stage`'s share of the summed pipeline-stage p50s (0 when the
+    breakdown is empty)."""
+    total = 0.0
+    for s in PIPELINE_STAGES:
+        total += float(tel.stages.get(s, {}).get("p50_ms", 0.0))
+    if total <= 0.0:
+        return 0.0
+    return float(tel.stages.get(stage, {}).get("p50_ms", 0.0)) / total
+
+
+def kernel_per_item_us(tel: Telemetry, kind: str) -> Optional[float]:
+    """Warm per-item cost of one kernel kind in µs (None until the
+    profile has warm calls and a batch shape)."""
+    st = tel.kernels.get(kind)
+    if not st or st.get("calls", 0) < 2:
+        return None
+    batch_avg = float(st.get("batch_avg", 0.0))
+    if batch_avg <= 0.0:
+        return None
+    return float(st.get("warm_avg_ms", 0.0)) * 1e3 / batch_avg
+
+
+def kernel_calls(tel: Telemetry, kind: str) -> int:
+    return int(tel.kernels.get(kind, {}).get("calls", 0))
+
+
+def per_item_falling(cur: Telemetry, prev: Optional[Telemetry],
+                     kind: str) -> bool:
+    """True when the kernel's per-item cost this interval is at or
+    below FALLING_RATIO of the previous interval's (amortization still
+    paying off) — and there were fresh calls to measure it on."""
+    if prev is None or kernel_calls(cur, kind) <= kernel_calls(prev, kind):
+        return False
+    a, b = kernel_per_item_us(cur, kind), kernel_per_item_us(prev, kind)
+    if a is None or b is None or b <= 0.0:
+        return False
+    return a <= b * FALLING_RATIO
+
+
+# ----------------------------------------------------------------------
+# policy factories
+# ----------------------------------------------------------------------
+def batch_amortize_policy(kernel_kind: str,
+                          latency_stage: str) -> Policy:
+    """Flush windows and batch caps: shrink when `latency_stage`
+    dominates the slot breakdown (batching is costing more latency than
+    it amortizes), grow while the kernel's per-item cost is still
+    falling, hold otherwise."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if not fresh_slots(cur, prev):
+            return HOLD
+        if stage_fraction(cur, latency_stage) > DOMINANT_FRAC:
+            return SHRINK
+        if per_item_falling(cur, prev, kernel_kind):
+            return GROW
+        return HOLD
+
+    return policy
+
+
+def exec_accumulation_policy() -> Policy:
+    """Shrink accumulation when `exec` dominates the slot breakdown
+    (long coalesced runs are serializing replies behind one apply);
+    grow while the lane is deeper than the current cap and exec stays
+    minor (coalescing would cut per-slot commit overhead)."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if not fresh_slots(cur, prev):
+            return HOLD
+        frac = stage_fraction(cur, "exec")
+        if frac > DOMINANT_FRAC:
+            return SHRINK
+        if frac < MINOR_FRAC \
+                and cur.depths.get("exec_lane", 0) > knob.value:
+            return GROW
+        return HOLD
+
+    return policy
+
+
+def ecdsa_crossover_policy() -> Policy:
+    """Move the device/host crossover from measured per-item costs:
+    the `ecdsa` kernel profile (device tier) vs the batched host
+    engine's drained timing counters (`ecdsa_host_us` / items, fed by
+    SigManager). A >=10% gap in either direction moves the boundary
+    toward the cheaper tier; anything closer holds."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if prev is None:
+            return HOLD
+        dev = kernel_per_item_us(cur, "ecdsa")
+        items = cur.counters.get("ecdsa_host_items_delta", 0.0)
+        us = cur.counters.get("ecdsa_host_us_delta", 0.0)
+        host = (us / items) if items > 0 else None
+        if dev is None or host is None or host <= 0.0:
+            return HOLD
+        if dev < host * CROSSOVER_MARGIN:
+            return SHRINK        # device cheaper: admit smaller batches
+        if host < dev * CROSSOVER_MARGIN:
+            return GROW          # host cheaper: raise the bar
+        return HOLD
+
+    return policy
+
+
+def admission_watermark_policy() -> Policy:
+    """Grow the shed watermark while the plane is shedding but
+    admission wait is NOT the bottleneck (the queue would drain if
+    allowed to buffer); shrink it when `adm_wait` dominates the slot
+    breakdown (buffered traffic is just aging)."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if not fresh_slots(cur, prev):
+            return HOLD
+        frac = stage_fraction(cur, "adm_wait")
+        if frac > DOMINANT_FRAC:
+            return SHRINK
+        if cur.counters.get("adm_shedding", 0) and frac < MINOR_FRAC:
+            return GROW
+        return HOLD
+
+    return policy

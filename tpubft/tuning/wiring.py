@@ -1,0 +1,190 @@
+"""The per-replica knob catalog — ReplicaConfig seeds the defaults,
+the registry owns the values from then on.
+
+`build_replica_tuning(replica, cfg)` registers every live actuator the
+replica exposes and binds each to its seam:
+
+  ====================================  ==================================
+  knob                                  actuator seam
+  ====================================  ==================================
+  verify_batch_flush_us                 BatchVerifier + CertBatchVerifier
+                                        flush windows (FlushBatcher)
+  verify_batch_size                     BatchVerifier batch cap
+  combine_flush_us / combine_batch_max  CollectorPool → CombineBatcher
+  execution_max_accumulation            ExecutionLane run-coalescing cap
+  admission_high_watermark              AdmissionPipeline shed watermarks
+                                        (low follows at high/3)
+  ecdsa_crossover_b                     crypto/tpu.set_ecdsa_crossover
+                                        (process-wide, like the device)
+  device_min_verify_batch               SigManager.device_min_batch
+  st_window_ranges                      StConfig.window_ranges (late-
+                                        bound; kvbc attaches ST after
+                                        construction)
+  breaker_cooldown_ms                   device breaker configure()
+  ====================================  ==================================
+
+Knobs with a policy move from live telemetry; the rest are
+catalog/pin/seed surfaces (and still reset on degradation). The seed
+file (`ReplicaConfig.autotune_seed_file`, written by
+`bench_msm_crossover --ecdsa --seed-out`) re-baselines measured knobs
+before the controller starts.
+"""
+from __future__ import annotations
+
+from tpubft.tuning.controller import TuningController
+from tpubft.tuning.knobs import Knob, KnobRegistry, load_seed
+from tpubft.tuning.policies import (admission_watermark_policy,
+                                    batch_amortize_policy,
+                                    ecdsa_crossover_policy,
+                                    exec_accumulation_policy)
+from tpubft.utils import flight
+from tpubft.utils.logging import get_logger
+
+log = get_logger("tuning")
+
+# registry bound caps (operator bounds live per knob; these are the
+# hard rails a policy can never leave)
+MAX_FLUSH_US = 20_000
+MAX_BATCH = 8192
+MAX_ACCUMULATION = 128
+MAX_WATERMARK = 1_000_000
+MAX_CROSSOVER = 1 << 20
+
+
+def build_replica_tuning(replica, cfg) -> TuningController:
+    rid = replica.id
+    registry = KnobRegistry(name=f"tuning-r{rid}")
+    cool = cfg.autotune_cooldown_ms / 1e3
+
+    def K(name: str, value: int, lo: int, hi: int, apply_fn,
+          sensor: str, unit: str = "") -> Knob:
+        return registry.register(Knob(
+            name=name, value=int(value), default=int(value), lo=lo,
+            hi=hi, apply_fn=apply_fn, sensor=sensor, unit=unit,
+            cooldown_s=cool))
+
+    controller = TuningController(
+        registry, name=f"tuning-r{rid}",
+        interval_s=cfg.autotune_interval_ms / 1e3,
+        aggregator=getattr(replica, "aggregator", None), rid=rid,
+        stages_fn=lambda: flight.stage_summary(rid=rid),
+        kernels_fn=lambda: flight.kernel_profiler().snapshot(),
+        health_fn=lambda: replica.health.verdict()["verdict"],
+        depths_fn=lambda: _depths(replica),
+        counters_fn=lambda: _counters(replica))
+
+    # --- verify plane: flush window + batch cap, grown while the
+    # ed25519 kernel's per-item cost keeps falling, shrunk when
+    # admission wait dominates the slot breakdown ---
+    def apply_verify_flush(v: int) -> None:
+        if replica.req_batcher is not None:
+            replica.req_batcher.reconfigure(flush_us=v)
+        replica.cert_batcher.reconfigure(flush_us=v)
+
+    K("verify_batch_flush_us", cfg.verify_batch_flush_us, 50,
+      MAX_FLUSH_US, apply_verify_flush,
+      "ed25519 kernel per-item cost vs adm_wait p50 share", "us")
+    controller.add_policy("verify_batch_flush_us",
+                          batch_amortize_policy("ed25519", "adm_wait"))
+    if replica.req_batcher is not None:
+        K("verify_batch_size", cfg.verify_batch_size, 16, MAX_BATCH,
+          lambda v: replica.req_batcher.reconfigure(batch_size=v),
+          "ed25519 kernel batch fill vs adm_wait p50 share", "sigs")
+        controller.add_policy("verify_batch_size",
+                              batch_amortize_policy("ed25519",
+                                                    "adm_wait"))
+
+    # --- combine plane (ROADMAP 3d): flush window + slot cap from the
+    # bls_msm amortization profile vs the commit stage share ---
+    K("combine_flush_us", cfg.combine_flush_us, 0, MAX_FLUSH_US,
+      lambda v: replica.collector_pool.reconfigure(flush_us=v),
+      "bls_msm per-item cost vs commit p50 share", "us")
+    controller.add_policy("combine_flush_us",
+                          batch_amortize_policy("bls_msm", "commit"))
+    K("combine_batch_max", cfg.combine_batch_max, 1, 512,
+      lambda v: replica.collector_pool.reconfigure(max_batch=v),
+      "bls_msm per-item cost vs commit p50 share", "slots")
+    controller.add_policy("combine_batch_max",
+                          batch_amortize_policy("bls_msm", "commit"))
+
+    # --- execution lane: coalescing depth from the exec stage share ---
+    if replica.exec_lane is not None:
+        K("execution_max_accumulation", cfg.execution_max_accumulation,
+          1, MAX_ACCUMULATION, replica.exec_lane.set_max_accumulation,
+          "exec p50 share of the slot breakdown + lane depth", "slots")
+        controller.add_policy("execution_max_accumulation",
+                              exec_accumulation_policy())
+
+    # --- admission backpressure: shed watermark (low follows at
+    # high/3, preserving the construction-time hysteresis shape) ---
+    if replica.admission is not None and cfg.admission_high_watermark:
+        K("admission_high_watermark", cfg.admission_high_watermark,
+          100, MAX_WATERMARK,
+          lambda v: replica.admission.set_watermarks(v, max(1, v // 3)),
+          "shed mode + adm_wait p50 share", "msgs")
+        controller.add_policy("admission_high_watermark",
+                              admission_watermark_policy())
+
+    # --- ECDSA device/host crossover (ROADMAP 4d): process-wide, like
+    # the device itself — measured `ecdsa` kernel tier vs the batched
+    # host engine's drained per-item cost ---
+    from tpubft.crypto import tpu as tpu_mod
+    K("ecdsa_crossover_b", min(tpu_mod.ecdsa_crossover(), MAX_CROSSOVER),
+      1, MAX_CROSSOVER, tpu_mod.set_ecdsa_crossover,
+      "ecdsa kernel per-item cost vs ecdsa_host_us/items", "sigs")
+    controller.add_policy("ecdsa_crossover_b", ecdsa_crossover_policy())
+
+    # --- catalog/pin-only knobs (no policy yet; seedable, freezable,
+    # reset-on-degradation like everything else) ---
+    K("device_min_verify_batch", cfg.device_min_verify_batch, 1,
+      MAX_BATCH, lambda v: setattr(replica.sig, "device_min_batch", v),
+      "host batch sizing floor for the device ride", "sigs")
+    controller.track("device_min_verify_batch")
+
+    def apply_st_window(v: int) -> None:
+        # late-bound: the kvbc layer attaches state transfer after the
+        # consensus replica constructs
+        st = getattr(replica, "state_transfer", None)
+        st_cfg = getattr(st, "cfg", None)
+        if st_cfg is not None:
+            st_cfg.window_ranges = int(v)
+
+    K("st_window_ranges", cfg.st_window_ranges, 1, 64, apply_st_window,
+      "st_blocks_per_sec / source scoreboard", "ranges")
+    controller.track("st_window_ranges")
+
+    def apply_breaker_cooldown(v: int) -> None:
+        from tpubft.ops.dispatch import device_breaker
+        device_breaker().configure(cooldown_s=v / 1e3)
+
+    K("breaker_cooldown_ms", cfg.breaker_cooldown_ms, 100, 120_000,
+      apply_breaker_cooldown, "breaker trip/recovery history", "ms")
+    controller.track("breaker_cooldown_ms")
+
+    # --- measured-operating-point seed (bench handoff) ---
+    if cfg.autotune_seed_file:
+        try:
+            n = load_seed(registry, cfg.autotune_seed_file)
+            log.info("r%d: seeded %d knobs from %s", rid, n,
+                     cfg.autotune_seed_file)
+        except Exception:  # noqa: BLE001 — a bad seed must not stop
+            log.exception("r%d: knob seed %s failed; using defaults",
+                          rid, cfg.autotune_seed_file)
+    return controller
+
+
+def _depths(replica) -> dict:
+    d = {}
+    if replica.exec_lane is not None:
+        d["exec_lane"] = replica.exec_lane.depth
+    if replica.admission is not None:
+        d["admission"] = replica.admission.depth
+    return d
+
+
+def _counters(replica) -> dict:
+    c = {"ecdsa_host_items": replica.sig.ecdsa_batched_host.value,
+         "ecdsa_host_us": replica.sig.ecdsa_host_us.value}
+    if replica.admission is not None:
+        c["adm_shedding"] = 1 if replica.admission.shedding else 0
+    return c

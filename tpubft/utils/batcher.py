@@ -36,6 +36,27 @@ class FlushBatcher(Generic[T]):
                                         name=name)
         self._thread.start()
 
+    def reconfigure(self, batch_size: int = None,
+                    flush_us: int = None) -> None:
+        """Live retuning seam (the autotuner's actuator): batch size
+        and flush window take effect from the next drain cycle. The
+        worker is woken so a SHORTER window applies to the batch
+        already accumulating, not after one stale full wait."""
+        with self._wake:
+            if batch_size is not None:
+                self._batch_size = max(1, int(batch_size))
+            if flush_us is not None:
+                self._flush_s = max(0, int(flush_us)) / 1e6
+            self._wake.notify()
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    @property
+    def flush_us(self) -> int:
+        return int(self._flush_s * 1e6)
+
     def submit(self, item: T) -> None:
         with self._wake:
             if self._running:
@@ -58,15 +79,27 @@ class FlushBatcher(Generic[T]):
             pass           # strand the remaining waiters
 
     def _run(self) -> None:
+        import time as _time
         while self._running:
             with self._wake:
                 if not self._pending:
                     self._wake.wait(timeout=0.05)
                     continue
-                # flush window: wait once for the batch to fill; submits
-                # during this wait do not re-notify (len > 1)
-                if len(self._pending) < self._batch_size:
-                    self._wake.wait(timeout=self._flush_s)
+                # flush window: wait for the batch to fill; submits
+                # during this wait do not re-notify (len > 1). The wait
+                # re-checks its deadline on every wakeup, reading the
+                # (possibly reconfigured) window and cap fresh — a
+                # reconfigure() notify retunes the in-progress wait
+                # instead of being mistaken for window expiry, and a
+                # SHRUNK window cuts the remaining wait short
+                start = _time.monotonic()
+                while (self._running and self._pending
+                       and len(self._pending) < self._batch_size):
+                    remaining = self._flush_s - (_time.monotonic()
+                                                 - start)
+                    if remaining <= 0:
+                        break
+                    self._wake.wait(timeout=remaining)
                 batch, self._pending = self._pending, []
             try:
                 self._drain(batch)

@@ -229,6 +229,30 @@ class ReplicaConfig:
     health_poll_ms: int = 1000
     health_stall_ms: int = 5000
 
+    # closed-loop autotuner (tpubft/tuning/): a per-replica controller
+    # thread drives the performance knobs above (flush windows, batch
+    # caps, accumulation depth, admission watermarks, the ECDSA
+    # device/host crossover) from live telemetry — kernel-profiler
+    # batch stats, flight-recorder stage breakdown, breaker/health
+    # verdicts — within hard bounds, with per-knob hysteresis and
+    # cooldown. The ReplicaConfig values stay the DEFAULTS every knob
+    # backs off to whenever the health verdict leaves `healthy` or a
+    # breaker opens (the controller never fights the degradation
+    # plane). False = every knob stays exactly at its configured value.
+    autotune_enabled: bool = True
+    # controller poll cadence; each poll snapshots telemetry and casts
+    # one policy vote per knob
+    autotune_interval_ms: int = 1000
+    # minimum interval between moves of any one knob (with the 2-vote
+    # hysteresis this bounds how fast tuning can ramp — and how fast a
+    # bad policy could wander)
+    autotune_cooldown_ms: int = 3000
+    # knob-registry seed file (JSON, written by e.g.
+    # `bench_msm_crossover --ecdsa --seed-out`): measured operating
+    # points loaded — and re-baselined as the degraded-reset defaults —
+    # before the controller starts. "" = no seed.
+    autotune_seed_file: str = ""
+
     # execution pipelining (reference: post-execution separation +
     # block accumulation). True = committed slots are executed by a
     # dedicated in-order executor thread that accumulates runs of
@@ -337,6 +361,10 @@ class ReplicaConfig:
             raise ValueError("breaker_failure_threshold must be >= 1")
         if self.health_poll_ms < 1 or self.health_stall_ms < 1:
             raise ValueError("health_poll_ms/health_stall_ms must be >= 1")
+        if self.autotune_interval_ms < 10:
+            raise ValueError("autotune_interval_ms must be >= 10")
+        if self.autotune_cooldown_ms < 0:
+            raise ValueError("autotune_cooldown_ms must be >= 0")
         if self.threshold_scheme_crossover_n < 0:
             raise ValueError("threshold_scheme_crossover_n must be >= 0")
         if self.combine_batch_max < 1 or self.combine_flush_us < 0:

@@ -93,6 +93,10 @@ EV_PREEXEC_LAUNCH = 23  # speculative execution launched (arg=retry id)
 EV_PREEXEC_AGREE = 24   # f+1 digest agreement reached (arg=votes)
 EV_PREEXEC_CONFLICT = 25  # read-set conflict at commit; fell back to
 #                           normal ordering (seq=consensus slot)
+EV_TUNE = 26            # autotuner knob change (seq=knob id,
+#                         view=old value, arg=new value; the knob-id →
+#                         name table rides every dump via the tuning
+#                         dump provider)
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -108,7 +112,7 @@ EV_NAMES = {
     EV_TRS_SUBSCRIBE: "trs_subscribe", EV_TRS_PUSH: "trs_push",
     EV_TRS_PROOF: "trs_proof", EV_PREEXEC_LAUNCH: "preexec_launch",
     EV_PREEXEC_AGREE: "preexec_agree",
-    EV_PREEXEC_CONFLICT: "preexec_conflict",
+    EV_PREEXEC_CONFLICT: "preexec_conflict", EV_TUNE: "tune",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
@@ -290,6 +294,12 @@ class SlotTracker:
         self._done: "deque[Dict]" = deque(maxlen=self.KEEP)
         self._hists: Dict[str, object] = {}
         self._finalized = 0
+        # per-replica finalized counts: an rid-filtered summary must
+        # report ITS replica's progress (the autotuner's fresh-signal
+        # gate), not the process total — in a multi-replica process a
+        # stalled replica's controller must not mistake its siblings'
+        # slots for fresh local signal
+        self._finalized_by_rid: Dict[int, int] = {}
 
     def _hist(self, stage: str):
         h = self._hists.get(stage)
@@ -375,6 +385,8 @@ class SlotTracker:
             self._hist(stage).record(v_ms * 1e3)      # histograms in us
         with self._mu:
             self._finalized += 1
+            self._finalized_by_rid[rec["rid"]] = \
+                self._finalized_by_rid.get(rec["rid"], 0) + 1
             self._done.append(rec)
 
     def summary(self, rid: Optional[int] = None) -> Dict:
@@ -385,7 +397,8 @@ class SlotTracker:
             done = [d for d in self._done
                     if rid is None or d["rid"] == rid]
             live = len(self._live)
-            finalized = self._finalized
+            finalized = (self._finalized if rid is None
+                         else self._finalized_by_rid.get(rid, 0))
         stages: Dict[str, Dict] = {}
         for stage in STAGES:
             vals = sorted(d["stages_ms"][stage] for d in done)
@@ -412,6 +425,7 @@ class SlotTracker:
             self._live.clear()
             self._done.clear()
             self._finalized = 0
+            self._finalized_by_rid.clear()
 
 
 _tracker = SlotTracker()
@@ -506,6 +520,38 @@ def kernel_profiler() -> KernelProfiler:
 # ---------------------------------------------------------------------
 # dump plane
 # ---------------------------------------------------------------------
+# registered subsystem-state providers: each dump/snapshot calls every
+# provider and attaches its payload under "providers" — the autotuner
+# rides this (knob values + decision log join EV_TUNE events to names),
+# and any future subsystem can without touching the recorder
+_providers_mu = make_lock("flight.providers")
+_providers: Dict[str, object] = {}
+
+
+def register_dump_provider(name: str, fn) -> None:
+    """Attach `fn()`'s JSON-able payload to every snapshot/dump under
+    ``providers[name]`` (idempotent by name: last registration wins)."""
+    with _providers_mu:
+        _providers[name] = fn
+
+
+def unregister_dump_provider(name: str) -> None:
+    with _providers_mu:
+        _providers.pop(name, None)
+
+
+def _provider_payloads() -> Dict:
+    with _providers_mu:
+        items = list(_providers.items())
+    out = {}
+    for name, fn in items:
+        try:
+            out[name] = fn()
+        except Exception:  # noqa: BLE001 — a broken provider must not
+            out[name] = "<provider error>"   # take down the dump plane
+    return out
+
+
 def snapshot(max_events_per_ring: Optional[int] = None) -> Dict:
     """Full recorder state as one JSON-able dict. ``ts_epoch`` /
     ``mono_ns`` anchor the monotonic event clock to wall time so
@@ -544,6 +590,7 @@ def snapshot(max_events_per_ring: Optional[int] = None) -> Dict:
                   "recent": _tracker.recent(limit=SlotTracker.KEEP)},
         "lock_hold_s": hold_stats(),
         "spans": spans,
+        "providers": _provider_payloads(),
     }
 
 
