@@ -35,6 +35,41 @@ from tpubft.kvbc import KeyValueBlockchain
 from tpubft.storage import MemoryDB
 from tpubft.testing.cluster import InProcessCluster
 
+def fsync_probe_ms(dir_path: str = None, samples: int = 5) -> float:
+    """Median cost of one 4KiB write+fsync on the disk under
+    `dir_path` (default: the tempdir the replica DBs land in) —
+    machine-readable context for every row: the shared-disk fsync is
+    nonstationary (2-21ms observed across rounds) and dominates
+    run-to-run variance on the write path, which is exactly what the
+    durability pipeline's group commit amortizes."""
+    import os
+    import statistics as stats
+    import tempfile
+    d = dir_path or tempfile.gettempdir()
+    times = []
+    try:
+        fd, path = tempfile.mkstemp(dir=d, prefix="fsync-probe-")
+        try:
+            payload = b"\x5a" * 4096
+            for _ in range(samples):
+                t0 = time.perf_counter()
+                os.write(fd, payload)
+                os.fsync(fd)
+                times.append((time.perf_counter() - t0) * 1e3)
+        finally:
+            os.close(fd)
+            os.unlink(path)
+    except OSError:
+        return -1.0                       # unprobeable filesystem
+    return round(stats.median(times), 3)
+
+
+def _dur_group_len(runs, groups) -> float:
+    """runs-per-group amortization factor (None until a group landed)."""
+    runs, groups = runs or 0, groups or 0
+    return round(runs / groups, 2) if groups else None
+
+
 CONFIGS = {
     1: dict(f=1, threshold_scheme="multisig-ed25519"),
     2: dict(f=2, threshold_scheme="threshold-bls"),
@@ -167,6 +202,19 @@ def run_config(config: int, backend: str, secs: float,
                      warmup_timeout_ms=60000 if cfg["f"] > 2 else 20000,
                      client_batch=client_batch,
                      op_timeout_ms=op_timeout_ms)
+        row["fsync_probe_ms"] = fsync_probe_ms()
+
+        def _dur(i: int, name: str) -> int:
+            try:   # pipeline-off legs have no durability component
+                return cluster.metric(i, "counters", name,
+                                      component="durability") or 0
+            except KeyError:
+                return 0
+
+        n = 3 * cfg["f"] + 1
+        row["dur_group_len"] = _dur_group_len(
+            sum(_dur(i, "dur_runs") for i in range(n)),
+            sum(_dur(i, "dur_groups") for i in range(n)))
         if extra_overrides:
             row["overrides"] = dict(extra_overrides)
         if profile:
@@ -255,6 +303,19 @@ def run_config_processes(config: int, backend: str, secs: float,
                 storm_thread.join(timeout=10)
         if cfg.get("storm_period_s"):
             row["storm_period_s"] = cfg["storm_period_s"]
+        # probe the filesystem the replica DBs actually live on — the
+        # process rows are the ones where the ledger rides a real disk
+        row["fsync_probe_ms"] = fsync_probe_ms(tmp)
+        runs = groups = 0
+        for r in range(net.n):
+            # ONE snapshot per replica: both counters must come from
+            # the same instant or the ratio can straddle a group
+            # boundary mid-commit
+            snap = (net.metrics(r).snapshot() or {}).get("components", {})
+            counters = (snap.get("durability") or {}).get("counters", {})
+            runs += counters.get("dur_runs") or 0
+            groups += counters.get("dur_groups") or 0
+        row["dur_group_len"] = _dur_group_len(runs, groups)
         if extra_overrides:
             row["overrides"] = dict(extra_overrides)
         return row
@@ -275,6 +336,8 @@ def smoke(secs: float = 2.0, clients: int = 2) -> dict:
             ("lane", {"execution_lane": True}),
             ("nospec", {"execution_lane": True,
                         "speculative_execution": False}),
+            ("nodur", {"execution_lane": True,
+                       "durability_pipeline": False}),
             ("inline", {"execution_lane": False})):
         row = run_config(1, "cpu", secs, clients,
                          extra_overrides=overrides)
@@ -307,6 +370,11 @@ def main() -> None:
                          "lane A/B rows")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fixed shape for CI (lane on vs off)")
+    ap.add_argument("--durability-off", action="store_true",
+                    help="A/B control leg: run with the group-commit "
+                         "durability pipeline OFF (per-run apply + "
+                         "immediate completion) — pair alternating "
+                         "on/off invocations like the PR 9 rows")
     ap.add_argument("--profile", action="store_true",
                     help="attach the flight recorder's per-slot stage "
                          "breakdown (adm_wait/dispatch/prepare/commit/"
@@ -322,6 +390,8 @@ def main() -> None:
         return
     from tpubft.utils.config import parse_config_overrides
     extra = parse_config_overrides(args.override)
+    if args.durability_off:
+        extra["durability_pipeline"] = False
     if args.profile and args.processes:
         raise SystemExit("--profile reads the in-process flight "
                          "recorder; with --processes take per-replica "

@@ -81,12 +81,60 @@ def bench(engine: str, db_kind: str, blocks: int, keys_per_block: int,
     }
 
 
+def bench_group_commit(tmp: str, runs: int = 256,
+                       ops_per_run: int = 16) -> list:
+    """The durability pipeline's storage seam in isolation (ISSUE 15):
+    `runs` run-shaped WriteBatches made durable per-run (one apply +
+    one fsync each — the pre-pipeline durable path) vs group-committed
+    (`NativeDB.write_group` concatenated apply + ONE `sync()` per
+    group) at growing group sizes. Measures exactly what the pipeline
+    amortizes, on THIS host's disk, independent of how hard the
+    consensus plane can drive it."""
+    from tpubft.storage.interfaces import WriteBatch
+    from tpubft.storage.native import NativeDB
+    rows = []
+    for group in (1, 4, 8, 16):
+        path = os.path.join(tmp, f"gc-{group}-{time.time_ns()}.kvlog")
+        db = NativeDB(path, sync_writes=False)
+        batches = []
+        for r in range(runs):
+            wb = WriteBatch()
+            for i in range(ops_per_run):
+                wb.put(b"k-%d-%d" % (r, i), b"v" * 64, b"blk")
+            batches.append(wb)
+        t0 = time.perf_counter()
+        fsyncs = 0
+        for start in range(0, runs, group):
+            chunk = batches[start:start + group]
+            if group == 1:
+                db.write(chunk[0])          # the per-run durable path
+            else:
+                db.write_group(chunk)
+            db.sync()
+            fsyncs += 1
+        dt = time.perf_counter() - t0
+        db.close()
+        rows.append({"mode": "group-commit", "group": group,
+                     "runs": runs, "ops_per_run": ops_per_run,
+                     "fsyncs": fsyncs,
+                     "durable_runs_per_sec": round(runs / dt, 1),
+                     "fsync_ms_per_run": round(dt / runs * 1e3, 3)})
+    return rows
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--blocks", type=int, default=2000)
     ap.add_argument("--keys-per-block", type=int, default=8)
+    ap.add_argument("--group-commit", action="store_true",
+                    help="durability-seam A/B: per-run fsync vs "
+                         "write_group + one fsync per group")
     args = ap.parse_args()
     with tempfile.TemporaryDirectory() as tmp:
+        if args.group_commit:
+            for row in bench_group_commit(tmp):
+                print(json.dumps(row), flush=True)
+            return
         for engine in ("categorized", "v4"):
             for db_kind in ("memory", "native"):
                 print(json.dumps(bench(engine, db_kind, args.blocks,

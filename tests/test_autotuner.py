@@ -454,6 +454,7 @@ EXPECTED_KNOBS = {
     "combine_batch_max", "execution_max_accumulation",
     "admission_high_watermark", "ecdsa_crossover_b",
     "device_min_verify_batch", "st_window_ranges", "breaker_cooldown_ms",
+    "durability_group_max", "durability_window_us",
 }
 
 

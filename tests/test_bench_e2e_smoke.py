@@ -26,10 +26,13 @@ def threadcheck(monkeypatch):
 def test_bench_e2e_smoke(threadcheck):
     from benchmarks.bench_e2e import smoke
     out = smoke(secs=2.0, clients=2)
-    # all three execution modes ordered real traffic: the speculative
-    # lane (default), the lane with speculation off, and legacy inline
+    # all four execution modes ordered real traffic: the speculative
+    # lane (default, group-commit durability on), the lane with
+    # speculation off, the lane with the durability pipeline off, and
+    # legacy inline
     assert out["lane"]["ok"], out
     assert out["nospec"]["ok"], out
+    assert out["nodur"]["ok"], out
     assert out["inline"]["ok"], out
     # racecheck: no dispatcher/executor stall was reported during the
     # run (lock-order inversions raise inside the run itself)

@@ -1,0 +1,77 @@
+"""Tier-1 wiring for the fsync-seam lint (tools/tpulint fsync-seam
+pass, ISSUE 15): fsync / sync-apply call sites are forbidden outside
+tpubft/durability/ and the consensus-metadata carve-out (storage/
+native.py + consensus/persistent.py) — group-commit durability only
+works when the io thread is the ONE place that forces ledger bytes to
+disk. Deliberate exceptions live in tools/tpulint/baseline.toml with a
+spelled-out justification."""
+import os
+import textwrap
+
+from tools.tpulint.passes import fsync_seam
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# the enumerable set of deliberate fsync sites outside the seam —
+# everything here MUST also carry a baseline.toml entry
+_BASELINED = {
+    os.path.join("tpubft", "apps", "counter.py"),
+    os.path.join("tpubft", "kvbc", "snapshots.py"),
+    os.path.join("tpubft", "secrets", "manager.py"),
+}
+
+
+def test_tree_is_clean_modulo_baseline():
+    violations = fsync_seam.find_violations(_ROOT)
+    extra = [(p, ln, sym, msg) for p, ln, sym, msg in violations
+             if p not in _BASELINED]
+    assert extra == [], (
+        "fsync/sync-apply call sites outside the durability seam:\n"
+        + "\n".join(f"{p}:{ln}: {msg}" for p, ln, _s, msg in extra))
+    # and the baselined set cannot silently grow or rot
+    assert {p for p, _ln, _s, _m in violations} == _BASELINED
+
+
+def test_lint_catches_all_forbidden_forms(tmp_path):
+    """os.fsync, os.fdatasync, the raw kvlog_sync symbol, and a
+    zero-arg .sync() are each a finding; arg-taking .sync(...) (some
+    other protocol) is not; the seam modules themselves are exempt."""
+    pkg = tmp_path / "tpubft" / "consensus"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue.py").write_text(textwrap.dedent("""\
+        import os
+
+        def a(fh):
+            os.fsync(fh.fileno())
+
+        def b(fh):
+            os.fdatasync(fh.fileno())
+
+        def c(lib, h):
+            lib.kvlog_sync(h)
+
+        def d(db):
+            db.sync()
+
+        def not_a_finding(obj):
+            obj.sync(timeout=3)     # arg-taking: another protocol
+    """))
+    dur = tmp_path / "tpubft" / "durability"
+    dur.mkdir(parents=True)
+    (dur / "pipeline.py").write_text(
+        "def commit(db):\n    db.sync()\n")
+    nat = tmp_path / "tpubft" / "storage"
+    nat.mkdir(parents=True)
+    (nat / "native.py").write_text(
+        "def sync(lib, h):\n    lib.kvlog_sync(h)\n")
+    violations = fsync_seam.find_violations(str(tmp_path))
+    rel = os.path.join("tpubft", "consensus", "rogue.py")
+    assert {p for p, _ln, _s, _m in violations} == {rel}, violations
+    symbols = sorted(s for _p, _ln, s, _m in violations)
+    assert symbols == [".sync", "kvlog_sync", "os.fdatasync",
+                       "os.fsync"], symbols
+
+
+def test_zero_scan_fails_loudly(tmp_path):
+    violations = fsync_seam.find_violations(str(tmp_path))
+    assert violations and "wrong root" in violations[0][3]

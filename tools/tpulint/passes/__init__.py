@@ -4,8 +4,8 @@ dependent pass runs first."""
 from __future__ import annotations
 
 from tools.tpulint.passes import (blocking, crashpoints, device_seam,
-                                  hotpath, imports_, lockorder, races,
-                                  roles)
+                                  fsync_seam, hotpath, imports_,
+                                  lockorder, races, roles)
 
 # pass id -> module exposing run(ctx) -> List[Finding]
 REGISTRY = {
@@ -16,5 +16,6 @@ REGISTRY = {
     imports_.PASS_ID: imports_,           # imports
     hotpath.PASS_ID: hotpath,             # hotpath
     device_seam.PASS_ID: device_seam,     # device-seam
+    fsync_seam.PASS_ID: fsync_seam,       # fsync-seam (durability)
     crashpoints.PASS_ID: crashpoints,     # crashpoints
 }

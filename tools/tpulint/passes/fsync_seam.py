@@ -1,0 +1,116 @@
+"""Pass: fsync-seam lint (ISSUE 15 — the durability pipeline's
+single-seam guarantee).
+
+Group-commit durability only works if the io thread is the ONE place
+that forces ledger bytes to disk: a stray `os.fsync`, a raw
+`kvlog_sync`, or a call to the `IDBClient.sync()` group boundary from
+anywhere else silently reintroduces the per-run disk tax the pipeline
+exists to amortize — and, worse, can land writes out of group order.
+So, device-seam-style: any fsync/sync-apply call site outside
+
+  * `tpubft/durability/`            — the pipeline (the seam itself),
+  * `tpubft/storage/native.py`      — the engine implementing it (and
+                                      the consensus-metadata
+                                      `sync_families` carve-out),
+  * `tpubft/consensus/persistent.py`— the metadata WAL carve-out
+                                      (FilePersistentStorage), which
+                                      stays synchronous by design
+
+is a finding. Deliberate exceptions (offline snapshot writers, the
+secrets file, the counter app's legacy inline path) live in
+baseline.toml with their justification — enumerable, not invisible.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Tuple
+
+from tools.tpulint.core import Finding, ScanError, load_modules
+
+PASS_ID = "fsync-seam"
+
+# fully-dotted callables that force bytes to disk
+FORBIDDEN_DOTTED = {"os.fsync", "os.fdatasync"}
+# attribute names that reach the engine's sync directly or through the
+# group boundary: `<db>.sync()` (zero-arg — `sync` with args is some
+# other protocol) and the raw ctypes symbol
+SYNC_ATTR = "sync"
+RAW_SYMBOL = "kvlog_sync"
+
+ALLOWED_PREFIXES = (
+    os.path.join("tpubft", "durability") + os.sep,
+)
+ALLOWED_FILES = {
+    os.path.join("tpubft", "storage", "native.py"),
+    os.path.join("tpubft", "consensus", "persistent.py"),
+    # the abstract seam definition (docstrings + the default no-op)
+    os.path.join("tpubft", "storage", "interfaces.py"),
+}
+
+
+def scan_tree(tree: ast.Module,
+              rel: str) -> List[Tuple[str, int, str, str]]:
+    """(rel, line, symbol, message) per violating call site; `symbol`
+    keys the baseline (stable across line churn, like device-seam)."""
+    out: List[Tuple[str, int, str, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        dotted = (f"{fn.value.id}.{fn.attr}"
+                  if isinstance(fn.value, ast.Name) else None)
+        if dotted in FORBIDDEN_DOTTED:
+            out.append((rel, node.lineno, dotted,
+                        f"calls {dotted}() — synchronous disk flush "
+                        f"outside the durability seam; route it through "
+                        f"the pipeline (tpubft/durability/) or baseline "
+                        f"it with a justification"))
+        elif fn.attr == RAW_SYMBOL:
+            out.append((rel, node.lineno, RAW_SYMBOL,
+                        f"calls .{RAW_SYMBOL}() — raw engine sync "
+                        f"bypasses the group-commit seam "
+                        f"(NativeDB.sync is the one wrapper)"))
+        elif fn.attr == SYNC_ATTR and not node.args and not node.keywords:
+            out.append((rel, node.lineno, ".sync",
+                        "calls .sync() — the group-commit fsync "
+                        "boundary belongs to the durability io thread "
+                        "(tpubft/durability/pipeline.py); a per-write "
+                        "sync silently reintroduces the per-run disk "
+                        "tax"))
+    return out
+
+
+def violations_for(mods, syntax) -> List[Tuple[str, int, str, str]]:
+    out: List[Tuple[str, int, str, str]] = []
+    for f in syntax:
+        out.append((f.path, f.line, "syntax", f.message))
+    for sm in mods:
+        if sm.rel in ALLOWED_FILES \
+                or sm.rel.startswith(ALLOWED_PREFIXES):
+            continue
+        out.extend(scan_tree(sm.tree, sm.rel))
+    return sorted(out)
+
+
+def find_violations(root: str) -> List[Tuple[str, int, str, str]]:
+    try:
+        mods, syntax = load_modules(root, ("tpubft",))
+    except ScanError:
+        # a wrong root must FAIL, not report a vacuous OK — same
+        # convention as the device-seam lint
+        return [(os.path.join(root, "tpubft"), 0, "scan",
+                 "no Python modules found to scan — wrong root? "
+                 "(expected <root>/tpubft/**/*.py)")]
+    return violations_for(mods, syntax)
+
+
+def run(ctx) -> List[Finding]:
+    mods, syntax = ctx.load("tpubft")
+    findings: List[Finding] = []
+    for rel, line, symbol, msg in violations_for(mods, syntax):
+        findings.append(Finding(PASS_ID, rel, line, f"{rel}:{symbol}",
+                                msg))
+    return findings

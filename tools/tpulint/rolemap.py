@@ -35,6 +35,13 @@ THREAD_ROLES: Dict[FuncId, FrozenSet[str]] = {
         frozenset({"dispatcher"}),
     ("tpubft/consensus/execution.py", "ExecutionLane", "_loop"):
         frozenset({"exec_lane"}),
+    # group-commit durability io thread (tpubft/durability/): drains
+    # the lane's sealed runs, applies + fsyncs per group, then crosses
+    # into the lane's completed queue (lane condition), the
+    # ClientsManager reply cache (its own lock) and the dispatcher
+    # wakeup queue — all lock-guarded surfaces
+    ("tpubft/durability/pipeline.py", "DurabilityPipeline", "_loop"):
+        frozenset({"durability"}),
     ("tpubft/consensus/admission.py", "AdmissionPipeline", "_run"):
         frozenset({"admission"}),
     ("tpubft/consensus/health.py", "HealthMonitor", "_run"):
@@ -131,7 +138,12 @@ API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
      "push_internal"): frozenset({"transport", "exec_lane",
                                   "dispatcher", "preexec"}),
     ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
-     "push_internal_once"): frozenset({"exec_lane"}),
+     "push_internal_once"): frozenset({"exec_lane", "durability"}),
+    # the pipeline's post-fsync completion hop into the lane's
+    # completed queue (callable reached through the replica attribute,
+    # which the syntactic call graph cannot type)
+    ("tpubft/consensus/execution.py", "ExecutionLane",
+     "complete_durable"): frozenset({"durability"}),
     # admission ingest: called from transport receive threads
     ("tpubft/consensus/admission.py", "AdmissionPipeline", "submit"):
         frozenset({"transport"}),
@@ -179,6 +191,9 @@ ATTR_TYPE_HINTS: Dict[Tuple[str, str, str], Tuple[str, str]] = {
     # the execution lane holds the replica and reaches its thread-safe
     # surfaces (ClientsManager, reserved pages, blockchain accumulation)
     ("tpubft/consensus/execution.py", "ExecutionLane", "_r"):
+        ("tpubft/consensus/replica.py", "Replica"),
+    # the durability pipeline holds the replica the same way
+    ("tpubft/durability/pipeline.py", "DurabilityPipeline", "_r"):
         ("tpubft/consensus/replica.py", "Replica"),
     # admission workers verify through the replica's SigManager and
     # consult the static topology

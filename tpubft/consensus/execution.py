@@ -133,6 +133,20 @@ class ExecutionLane:
         self._busy = False
         self._held = False                # test hook: freeze execution
         self._retry_at = 0.0
+        # durability-pipeline dedup bridge: (client, req_seq) -> reply
+        # for requests executed in SEALED runs whose group fsync has
+        # not landed yet. The at-most-once ClientsManager state only
+        # becomes visible post-fsync (a retransmit must never be
+        # answered from a run that could still be lost), but the LANE
+        # must still dedup across back-to-back runs — the same request
+        # re-proposed into a later slot (view change after an
+        # equivocation, primary retry) would otherwise execute twice
+        # before the first run's group lands: duplicate block,
+        # permanent divergence. Written by the lane thread at seal,
+        # erased by the io thread at completion (strictly AFTER
+        # on_request_executed makes the ClientsManager entry visible,
+        # so there is no uncovered window).
+        self._inflight: Dict[Tuple[int, int], object] = {}
         self._spec: Optional[_SpecRun] = None
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -281,6 +295,20 @@ class ExecutionLane:
                     return False
                 self._cond.wait(min(remaining, 0.2))
         return True
+
+    def complete_durable(self, run: CompletedRun) -> None:
+        """Durability-pipeline completion hop (io thread): the run's
+        group fsync landed — only now does it reach the dispatcher's
+        integration queue (replies, `last_executed`, checkpoint votes).
+        The caller (the pipeline) made the ClientsManager at-most-once
+        entries visible FIRST, so dropping the in-flight dedup entries
+        here leaves no uncovered window. On the legacy path _apply_run
+        appends directly."""
+        with self._cond:
+            for key in run.reply_keys:
+                self._inflight.pop(key, None)
+            self._completed.append(run)
+            self._cond.notify_all()
 
     def pop_completed(self) -> List[CompletedRun]:
         out = []
@@ -605,15 +633,26 @@ class ExecutionLane:
                    pages_wb: WriteBatch, executed_now, blockchain,
                    acc: bool, span,
                    spec_overlap_ms: Optional[float] = None) -> None:
-        """Coalesced durable apply: ONE ledger commit + ONE pages batch
-        per run (a single atomic batch when they share a DB).
-        Everything up to and including the LEDGER write is retriable
+        """Coalesced apply: ONE ledger commit + ONE pages batch per run
+        (a single atomic batch when they share a DB). Everything up to
+        and including the LEDGER commit point is retriable
         (end_accumulation rolls the head back on failure); everything
         AFTER it is the point of no return — a post-commit exception
         must never requeue the run, or the retry would re-execute
-        requests whose blocks are already durable (duplicate blocks,
-        permanent state divergence)."""
+        requests whose blocks are already committed (duplicate blocks,
+        permanent state divergence).
+
+        With the durability pipeline (ReplicaConfig.durability_pipeline,
+        the default) the run's batch is SEALED, not written: the
+        overlay moves into the pending store (still readable by every
+        thread), the io thread group-commits it across runs with one
+        fsync per group, and only then do replies, `last_executed` and
+        the at-most-once cache advance — this thread never touches the
+        disk and moves straight to the next run. Without the pipeline
+        the legacy per-run write + immediate completion path runs
+        byte-identically to before."""
         r = self._r
+        pipe = getattr(r, "durability", None)
         if spec_overlap_ms is not None:
             # the speculative seal seam: a SIGKILL here — run fully
             # commit-confirmed, nothing yet durable — must replay the
@@ -622,11 +661,26 @@ class ExecutionLane:
         crashpoint("exec.pre_apply", rid=r.id)
         t0 = time.perf_counter()
         folded = False
+        deferred = None                   # (run_no, batch, raw base db)
         if acc:
             folded = (pages_wb.ops
                       and r.res_pages.shares_db(
                           getattr(blockchain, "_base_db", None)))
-            blockchain.end_accumulation(extra=pages_wb if folded else None)
+            # deferral requires the WHOLE run to ride one deferred
+            # batch: with reply pages in a SEPARATE store (not folded)
+            # the pages write would land at seal while the ledger batch
+            # waited in memory — a crash in that window persists
+            # "request executed" without its block, and replay would
+            # skip it forever. Fall back to the immediate apply there
+            # (ledger first, pages second, same thread — the legacy
+            # order); the seal below still groups the fsyncs.
+            defer = (pipe is not None
+                     and getattr(blockchain, "durability_attached", False)
+                     and (folded or not pages_wb.ops))
+            blockchain.end_accumulation(
+                extra=pages_wb if folded else None, defer=defer)
+            if defer:
+                deferred = blockchain.take_deferred()
         try:
             if not folded:
                 # without accumulation the handler's effects applied
@@ -652,11 +706,14 @@ class ExecutionLane:
                 if spec_overlap_ms is not None:
                     flight.record(flight.EV_SPEC_SEAL, seq=seq,
                                   arg=run_len)
-            # the run is durable: NOW the at-most-once/reply-cache
-            # records become visible (crash before this point replays
-            # the suffix; the persisted ring deduplicates it)
-            for client, req_seq, reply in executed_now:
-                r.clients.on_request_executed(client, req_seq, reply)
+            # LEGACY path: the run is durable — NOW the at-most-once/
+            # reply-cache records become visible (crash before this
+            # point replays the suffix; the persisted ring deduplicates
+            # it). With the pipeline that visibility moves to the io
+            # thread, strictly AFTER the group's fsync.
+            if pipe is None:
+                for client, req_seq, reply in executed_now:
+                    r.clients.on_request_executed(client, req_seq, reply)
             # checkpoint-boundary snapshot: digests taken now, before
             # the next run mutates state
             if result.last % self._ckpt_window == 0:
@@ -690,11 +747,42 @@ class ExecutionLane:
                           "[%d..%d] (run still completes)",
                           result.first, result.last)
         finally:
-            # the run IS completed (durably applied) no matter what the
-            # post-commit bookkeeping did — hand it to the dispatcher
-            with self._cond:
-                self._completed.append(result)
-            r.incoming.push_internal_once("exec_done")
+            # the run IS committed no matter what the post-commit
+            # bookkeeping did — hand it over: to the durability
+            # pipeline (completion follows its group fsync) or, on the
+            # legacy path, straight to the dispatcher
+            if pipe is not None:
+                from tpubft.durability import SealedRun
+                from tpubft.kvbc.blockchain import raw_base
+                sync_dbs = []
+                if deferred is None and blockchain is not None:
+                    # nothing deferred (empty batch, or a ledger
+                    # without the accumulation bracket whose writes
+                    # applied directly): the base still holds unsynced
+                    # buffers the group fsync must land
+                    db = raw_base(getattr(blockchain, "_db", None))
+                    if db is not None:
+                        sync_dbs.append(db)
+                if not folded and pages_wb.ops:
+                    pdb = raw_base(r.res_pages.db)
+                    if not any(pdb is d for d in sync_dbs):
+                        sync_dbs.append(pdb)
+                run_no, batch, target = (deferred if deferred is not None
+                                         else (None, None, None))
+                # publish the in-flight dedup entries BEFORE the seal:
+                # from the moment the pipeline owns the run, the next
+                # run may execute — it must already see these
+                with self._cond:
+                    for client, req_seq, reply in executed_now:
+                        self._inflight[(client, req_seq)] = reply
+                pipe.seal(SealedRun(
+                    run=result, executed_now=list(executed_now),
+                    batch=batch, run_no=run_no, db=target,
+                    sync_dbs=tuple(sync_dbs)))
+            else:
+                with self._cond:
+                    self._completed.append(result)
+                r.incoming.push_internal_once("exec_done")
 
     def _execute_slot(self, seq: int, pp, pages_wb: WriteBatch,
                       result: CompletedRun,
@@ -707,6 +795,22 @@ class ExecutionLane:
         for req in pp.client_requests():
             client = req.sender_id
             key = (client, req.req_seq_num)
+            # sealed-but-not-durable dedup (pipeline mode): the request
+            # already executed in a run awaiting its group fsync — the
+            # ClientsManager entry is deliberately not visible yet, but
+            # executing again would append a duplicate block. Re-issue
+            # the stashed reply with THIS run (it rides this run's own
+            # durability gate). READ ORDER MATTERS: the io thread
+            # publishes the ClientsManager entry BEFORE popping the
+            # in-flight entry, so checking _inflight FIRST and the
+            # manager second can never observe the uncovered
+            # none-visible-yet window (checking the manager first
+            # could: miss there, completion lands, miss here too).
+            # GIL-atomic read; see _inflight.
+            stashed = self._inflight.get(key)
+            if stashed is not None:
+                result.replies.append((client, stashed.pack()))
+                continue
             if key in seen or r.clients.was_executed(client,
                                                      req.req_seq_num):
                 cached = r.clients.cached_reply(client, req.req_seq_num)

@@ -570,6 +570,7 @@ class Replica(IReceiver):
             cfg.speculative_execution and cfg.execution_lane
             and not cfg.time_service_enabled
             and _bc is not None and hasattr(_bc, "begin_accumulation"))
+        self.durability = None
         if cfg.execution_lane:
             from tpubft.consensus.execution import ExecutionLane
             self.exec_lane = ExecutionLane(
@@ -584,6 +585,39 @@ class Replica(IReceiver):
                 "exec_lane", cfg.execution_drain_timeout_ms / 1e3,
                 busy_fn=lambda: not self.exec_lane.idle(),
                 detail_fn=lambda: {"depth": self.exec_lane.depth})
+        # --- group-commit durability pipeline (tpubft/durability/):
+        # the lane seals runs, a dedicated io thread group-commits
+        # them across runs (one concatenated apply + one fsync per
+        # group) and publishes the durability watermark that gates
+        # replies / last_executed / the reply cache. The ledger (when
+        # the handler has one with the accumulation bracket) installs
+        # the pending-read overlay so sealed-but-unapplied runs stay
+        # observable process-wide; reserved pages sharing the ledger
+        # DB rebind onto the same view so folded reply pages are too.
+        if cfg.execution_lane and cfg.durability_pipeline:
+            from tpubft.durability import DurabilityPipeline
+            self.durability = DurabilityPipeline(
+                self, group_max=cfg.durability_group_max,
+                window_us=cfg.durability_window_us)
+            _bc = getattr(handler, "blockchain", None)
+            if _bc is not None and hasattr(_bc, "attach_durability"):
+                view = _bc.attach_durability(
+                    self.durability.pending,
+                    drain_fn=self.durability.drain)
+                if self.res_pages.shares_db(view.base):
+                    self.res_pages.rebind(view)
+            # watermark-lag stall probe: busy while sealed runs await
+            # their group fsync; a disk that stops landing groups is
+            # reported with the same budget as the lane's drain barrier
+            self.health.register_probe(
+                "durability", cfg.execution_drain_timeout_ms / 1e3,
+                busy_fn=lambda: self.durability.lag > 0,
+                detail_fn=lambda: {"lag": self.durability.lag,
+                                   "wm": self.durability.watermark})
+            self._diag.register_status(f"replica{self.id}.durability",
+                                       self.durability.render)
+            self._diag.register_status("durability",
+                                       self.durability.render)
 
         # --- closed-loop autotuner (tpubft/tuning/): drives the perf
         # knobs above (flush windows, batch caps, accumulation depth,
@@ -763,6 +797,8 @@ class Replica(IReceiver):
                                           self._resume_view_change)
         if self.in_view_change and (self.pending_view or 0) > self.view:
             self.incoming.push_internal("resume_vc", None)
+        if self.durability is not None:
+            self.durability.start()     # before the lane: seals flow in
         if self.exec_lane is not None:
             self.exec_lane.start()
         if self.admission is not None:
@@ -791,11 +827,22 @@ class Replica(IReceiver):
             # no drain: pending slots are committed state that recovery
             # replays — stop is crash-equivalent for the lane
             self.exec_lane.stop()
+        if self.durability is not None:
+            # after the lane (its last seal must be accepted): a clean
+            # stop flushes sealed runs to disk — whatever a wedged disk
+            # leaves behind is the crash case recovery already replays
+            self.durability.stop()
         if self.admission is not None:
             self.admission.stop()
         if self.thin_replica is not None:
             self.thin_replica.stop()
         if self.tuning is not None:
+            if self.cfg.autotune_seed_file:
+                # clean shutdown: write the converged operating point
+                # back to the seed file so the next boot of this host
+                # starts warm (ROADMAP 8d); crash paths never get here,
+                # so a half-tuned episode cannot poison the seed
+                self.tuning.write_seed(self.cfg.autotune_seed_file)
             self.tuning.stop()
         self.health.stop()
         self.dispatcher.stop()
@@ -2186,16 +2233,30 @@ class Replica(IReceiver):
         self._abort_speculation("drain")
         if timeout is None:
             timeout = self.cfg.execution_drain_timeout_ms / 1e3
+        deadline = time.monotonic() + timeout
         ok = self.exec_lane.drain(timeout)
         if not ok:
             log.warning("execution lane failed to drain in %.0fs "
                         "(depth=%d)", timeout, self.exec_lane.depth)
+        if ok and self.durability is not None:
+            # the lane drained = every run SEALED; the barrier callers
+            # need them DURABLE and integrated (last_executed current,
+            # pending overlay empty) before wiping the window / writing
+            # the ledger directly — flush-and-wait the group pipeline
+            # on the REMAINING budget (one barrier, one deadline)
+            remaining = max(0.05, deadline - time.monotonic())
+            ok = self.durability.drain(remaining)
+            if not ok:
+                log.warning("durability pipeline failed to drain in "
+                            "%.1fs (lag=%d)", remaining,
+                            self.durability.lag)
         # apply WITHOUT the trailing re-pump: refilling the lane here
         # would defeat the barrier (the caller is about to wipe the
         # window / adopt transferred state); newly-unblocked slots are
         # picked up by the next commit/apply event
         self._apply_exec_runs(repump=False)
-        return ok and self.exec_lane.idle()
+        return ok and self.exec_lane.idle() \
+            and (self.durability is None or self.durability.idle())
 
     def record_exec_run(self, run_len: int, commit_ms: float) -> None:
         """Lane-thread metrics hook (Counter/Gauge/histograms are
@@ -2245,13 +2306,11 @@ class Replica(IReceiver):
             if run.last > self.last_executed:
                 self.last_executed = run.last
                 self.m_last_executed.set(run.last)
-            with self._tran() as st:
-                st.last_executed_seq = self.last_executed
-            crashpoint("meta.watermark", rid=self.id)
             self._last_progress = time.monotonic()
             # slot integrated + replies on the wire: the `reply` stage
-            # ends here (the lane recorded EV_EXEC_APPLY at its durable
-            # commit), finalizing each slot's lifecycle record
+            # ends here (the lane recorded EV_EXEC_APPLY at its apply/
+            # seal; with the durability pipeline the group-fsync wait
+            # shows up in this stage), finalizing each slot's record
             for seq in range(run.first, run.last + 1):
                 flight.record(flight.EV_REPLY, seq=seq)
             if run.checkpoint is not None:
@@ -2259,6 +2318,15 @@ class Replica(IReceiver):
                 self._send_checkpoint(seq, state_digest=state_digest,
                                       pages_digest=pages_digest,
                                       block_id=height)
+        # ONE metadata watermark persist per integration event — the
+        # synchronous consensus-metadata fsync (the carve-out) now
+        # covers every run the event delivered instead of paying the
+        # disk once per run; with the durability pipeline the runs
+        # integrate in group-sized batches, so the dispatcher's fsync
+        # rate drops by the group factor too
+        with self._tran() as st:
+            st.last_executed_seq = self.last_executed
+        crashpoint("meta.watermark", rid=self.id)
         self._maybe_announce_restart_ready()
         self._try_send_pre_prepare()
         if repump:

@@ -24,6 +24,12 @@ class ReservedPages:
     def __init__(self, db: IDBClient) -> None:
         self._db = db
 
+    @property
+    def db(self) -> IDBClient:
+        """The backing store (read-only exposure: the execution lane
+        needs it as a group-fsync target on the unfolded path)."""
+        return self._db
+
     @staticmethod
     def _key(category: str, index: int) -> bytes:
         cb = category.encode()
@@ -59,6 +65,13 @@ class ReservedPages:
         """True when this page store writes to `other_db` — the lane uses
         this to fold the pages batch into the ledger commit atomically."""
         return self._db is other_db
+
+    def rebind(self, db: IDBClient) -> None:
+        """Swap the backing handle — used when the ledger installs its
+        durability pending view over a SHARED db, so page reads/digests
+        observe folded-but-not-yet-applied reply pages exactly like
+        ledger readers observe sealed blocks."""
+        self._db = db
 
     def all_pages(self) -> List[Tuple[bytes, bytes]]:
         return list(self._db.range_iter(_FAMILY))

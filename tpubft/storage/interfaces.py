@@ -98,6 +98,26 @@ class IDBClient(abc.ABC):
     @abc.abstractmethod
     def close(self) -> None: ...
 
+    def sync(self) -> None:
+        """Force everything written so far onto stable storage (the
+        group-commit fsync seam — one call durably lands every batch
+        applied since the previous sync). Backends without a durability
+        boundary (memory stores) are a no-op; NativeDB overrides with a
+        real fsync. Callers outside tpubft/durability/ are lint-banned
+        (tools/tpulint fsync-seam pass): amortizing this call is the
+        durability pipeline's whole job, and a stray per-write sync
+        silently reintroduces the per-run disk tax."""
+
+    def write_group(self, batches: Sequence[WriteBatch]) -> None:
+        """Apply several batches as one group, in order (the durability
+        pipeline's group-concatenation seam). The default preserves
+        per-batch atomicity only; NativeDB overrides by concatenating
+        the group into ONE engine record — one apply, one CRC, and (in
+        sync_writes mode) one fsync for the whole group."""
+        for b in batches:
+            if b.ops:
+                self.write(b)
+
     def scan_all(self) -> "Iterator[Tuple[bytes, bytes, bytes]]":
         """Iterate EVERY (family, key, value) in the store — the
         whole-state snapshot walk (reference: RocksDB checkpoint /

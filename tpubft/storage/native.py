@@ -81,6 +81,7 @@ class NativeDB(IDBClient):
         if not self._h:
             raise StorageError(f"kvlog_open failed for {path}")
         self._compact_bytes = compact_bytes
+        self._sync_writes = sync_writes
         self._sync_prefixes: Tuple[bytes, ...] = () if sync_writes else \
             tuple(bytes([len(f)]) + f for f in sync_families)
         # ctypes releases the GIL around C calls, and the execution lane
@@ -138,6 +139,40 @@ class NativeDB(IDBClient):
                             > self._compact_bytes)
         if need_compact:
             self.compact()
+
+    def write_group(self, batches) -> None:
+        """Group-commit apply seam (tpubft/durability/): concatenate the
+        group's batches into ONE kvlog record — one payload encode, one
+        apply under the handle lock, one CRC (so the whole group is
+        atomic under torn-tail recovery), and in sync_writes mode one
+        fsync instead of one per batch. The consensus-metadata carve-out
+        applies to the union of the group's ops, exactly as if they had
+        been one batch."""
+        merged = WriteBatch()
+        for b in batches:
+            merged.ops.extend(b.ops)
+        if merged.ops:
+            self.write(merged)
+
+    @property
+    def syncs_on_write(self) -> bool:
+        """True in sync_writes mode: every apply already fsyncs, so the
+        durability pipeline's explicit group `sync()` would pay the
+        disk twice per group — the pipeline skips it."""
+        return self._sync_writes
+
+    def sync(self) -> None:
+        """One fsync covering every batch applied so far — the
+        durability pipeline's group-commit boundary. Held under the
+        handle lock: kvlog_sync only reads the fd, but close() frees
+        the handle and must never race an in-flight C call (same rule
+        as every other handle op). Writers queued behind a slow sync
+        pay the disk once per GROUP, not per run — the amortization the
+        pipeline exists to buy."""
+        with self._write_mu:
+            rc = self._lib.kvlog_sync(self._handle())
+            if rc != 0:
+                raise StorageError(f"kvlog_sync rc={rc}")
 
     def range_iter(self, family: bytes = DEFAULT_FAMILY,
                    start: Optional[bytes] = None,

@@ -728,6 +728,86 @@ def scenario_crashpoint_exec_post_apply(ctx: ScenarioContext) -> dict:
             "recovered_value": total}
 
 
+def scenario_group_commit_crash(ctx: ScenarioContext) -> dict:
+    """Crashpoint drill — dur.group_fsync (ISSUE 15): a replica's
+    durability io thread dies between the group's apply and its fsync —
+    runs executed, batch maybe-on-disk, watermark never published, no
+    reply sent, `last_executed` never advanced. The frozen replica must
+    NOT advance its watermark past the unsynced group (a reply can
+    never precede its group's fsync), and recovery from the on-disk
+    state must replay the committed suffix EXACTLY ONCE (the reserved-
+    pages at-most-once state dedups whatever did land) — no double
+    apply, no ledger divergence, `last_executed` monotone across the
+    crash-restart."""
+    from tpubft.apps import counter
+    from tpubft.comm.loopback import LoopbackBus
+    from tpubft.consensus.persistent import FilePersistentStorage
+    from tpubft.consensus.replica import Replica
+    from tpubft.testing import crashpoints as cp
+    from tpubft.utils.config import ReplicaConfig
+    sf, hf = _persistent_factories(ctx)
+    victim = ctx.choice("victim", (1, 2, 3))
+    hit = threading.Event()
+
+    def crash_here() -> None:
+        hit.set()
+        cp.park()                 # SIGKILL analog: not one more statement
+
+    with _counter_cluster(ctx, storage_factory=sf,
+                          handler_factory=hf) as cluster:
+        cl = cluster.client()
+        first = ctx.randint("add1", 1, 50)
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(first),
+                          timeout_ms=30000)) == first
+        ctx.wait_until(lambda: cluster.replicas[victim].last_executed >= 1,
+                       10, what="victim's first group landed")
+        frozen_at = cluster.replicas[victim].last_executed
+        ctx.event("arm_crashpoint", point="dur.group_fsync",
+                  replica=victim)
+        cp.arm("dur.group_fsync", rid=victim, action=crash_here)
+        second = ctx.randint("add2", 1, 50)
+        total = first + second
+        assert counter.decode_reply(
+            cl.send_write(counter.encode_add(second),
+                          timeout_ms=20000)) == total
+        ctx.wait_until(hit.is_set, 15, what="crashpoint fired")
+        ctx.event("crashed", replica=victim, point="dur.group_fsync")
+        # the unsynced group must never surface: the frozen replica's
+        # watermark (and so last_executed) stays where durability
+        # stopped, while the healthy quorum acked the write
+        assert cluster.replicas[victim].last_executed == frozen_at, (
+            "last_executed advanced past a group that never fsynced — "
+            "a reply could have preceded its group's durability")
+        # ---- recovery: restore the victim standalone from its durable
+        # state (WAL + counter file + surviving reserved pages), lane
+        # off so the committed-suffix replay happens in __init__ ----
+        t0 = time.monotonic()
+        cfg = ReplicaConfig(replica_id=victim, f_val=1,
+                            num_of_client_proxies=2,
+                            execution_lane=False, **_FAST_VC)
+        recovered = Replica(
+            cfg, cluster.keys.for_node(victim),
+            LoopbackBus().create(victim),
+            hf(victim),
+            storage=FilePersistentStorage(
+                os.path.join(ctx.tmpdir, f"r{victim}.wal")),
+            reserved_pages=cluster._pages_dbs[victim])
+        recovery = time.monotonic() - t0
+        assert recovered.handler.value == total, (
+            f"replay divergence after the group-fsync crash: recovered "
+            f"value {recovered.handler.value} != {total} "
+            f"(double-applied?)")
+        assert recovered.last_executed >= 2, \
+            "recovery did not replay the committed suffix"
+        assert recovered.last_executed >= frozen_at, \
+            "last_executed regressed across the crash-restart"
+        cp.disarm_all()
+        cp.release_parked()
+    return {"recovery_s": round(recovery, 3), "recovered_value": total,
+            "frozen_at": frozen_at}
+
+
 def scenario_crashpoint_vc_persist(ctx: ScenarioContext) -> dict:
     """Crashpoint drill 2 — vc.persist: a replica dies after persisting
     its view-change intent but BEFORE broadcasting the ViewChangeMsg.
@@ -905,6 +985,9 @@ def smoke_matrix() -> List[ScenarioSpec]:
         ScenarioSpec("crashpoint-vc-persist",
                      scenario_crashpoint_vc_persist,
                      "inproc", 90, tags=("crashpoint", "view-change",
+                                         "recovery")),
+        ScenarioSpec("group-commit-crash", scenario_group_commit_crash,
+                     "inproc", 60, tags=("crashpoint", "durability",
                                          "recovery")),
     ]
 
@@ -1092,6 +1175,45 @@ def proc_crashpoint_exec_drill(ctx: ScenarioContext) -> dict:
     return {"recovery_s": round(recovery, 3), "exit_code": code}
 
 
+def proc_crashpoint_dur_drill(ctx: ScenarioContext) -> dict:
+    """Process crashpoint drill (ISSUE 15): a replica restarted with
+    TPUBFT_CRASHPOINT=dur.group_fsync dies AT the durability seam —
+    group applied, fsync never issued, watermark never published (exit
+    code 173 proves it was the seam). A clean restart must replay the
+    committed suffix exactly once: reads stay consistent clusterwide
+    and the recovered replica catches back up to the quorum's
+    watermark, digest-identical."""
+    from tpubft.testing.crashpoints import CRASH_EXIT_CODE, ENV_VAR
+    victim = ctx.choice("victim", (1, 2, 3))
+    with _net(ctx) as net:
+        kv = net.skvbc_client(0)
+        assert _commit(kv, b"pre", b"1")
+        ctx.event("restart_with_crashpoint", replica=victim,
+                  point="dur.group_fsync")
+        net.restart_replica(victim,
+                            extra_env={ENV_VAR: "dur.group_fsync"})
+        net.wait_for_replicas_up(replicas=[victim])
+        # the victim dies on its first group commit after the restart
+        assert _commit(kv, b"boom", b"2", timeout_ms=15000)
+        code = net.wait_exit(victim, timeout=60)
+        assert code == CRASH_EXIT_CODE, \
+            f"victim exited {code}, not at the dur.group_fsync seam"
+        ctx.event("crashed", replica=victim, point="dur.group_fsync")
+        ctx.event("restart", replica=victim)
+        t0 = time.monotonic()
+        net.start_replica(victim)           # clean env: no crashpoint
+        net.wait_for_replicas_up(replicas=[victim])
+        assert _commit(kv, b"post", b"3", timeout_ms=15000)
+        target = net.last_executed(0) or 0
+        net.wait_for(lambda: (net.last_executed(victim) or 0) >= target,
+                     timeout=60)
+        recovery = time.monotonic() - t0
+        assert kv.read([b"pre", b"boom", b"post"]) == {
+            b"pre": b"1", b"boom": b"2", b"post": b"3"}, \
+            "ledger divergence after the group-fsync crash"
+    return {"recovery_s": round(recovery, 3), "exit_code": code}
+
+
 def proc_crashpoint_vc_drill(ctx: ScenarioContext) -> dict:
     """Process crashpoint drill: a backup dies at vc.persist while the
     old primary is isolated — after a clean restart it must RESUME the
@@ -1196,6 +1318,9 @@ def full_matrix() -> List[ScenarioSpec]:
         ScenarioSpec("proc-crashpoint-vc-drill",
                      proc_crashpoint_vc_drill, "process", 300,
                      tags=("crashpoint", "view-change", "recovery")),
+        ScenarioSpec("proc-crashpoint-dur-drill",
+                     proc_crashpoint_dur_drill, "process", 300,
+                     tags=("crashpoint", "durability", "recovery")),
         ScenarioSpec("proc-breaker-trip-mid-viewchange",
                      proc_breaker_trip_mid_viewchange, "process", 300,
                      tags=("compound", "degraded", "view-change")),

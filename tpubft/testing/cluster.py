@@ -66,12 +66,24 @@ class InProcessCluster:
         storage = (self.storage_factory(r) if self.storage_factory else None)
         # reserved pages survive an in-process restart (deployed replicas
         # keep them in the ledger db): restart/crash tests must exercise
-        # the page reload paths, not silently start from empty pages
-        pages = self._pages_dbs.get(r)
-        if pages is None:
-            from tpubft.consensus.reserved_pages import ReservedPages
-            from tpubft.storage.memorydb import MemoryDB
-            pages = self._pages_dbs[r] = ReservedPages(MemoryDB())
+        # the page reload paths, not silently start from empty pages.
+        # Blockchain-backed handlers share the LEDGER's db — the same
+        # deliberate wiring as KvbcReplica, so the lane folds reply
+        # pages into the run batch (atomic apply, and the durability
+        # pipeline's deferred-seal path stays exercised in-process);
+        # page persistence across restart then rides the handler db.
+        from tpubft.consensus.reserved_pages import ReservedPages
+        from tpubft.kvbc.blockchain import raw_base
+        _bc = getattr(handler, "blockchain", None)
+        _bc_db = raw_base(getattr(_bc, "_db", None)
+                          if _bc is not None else None)
+        if _bc_db is not None:
+            pages = self._pages_dbs[r] = ReservedPages(_bc_db)
+        else:
+            pages = self._pages_dbs.get(r)
+            if pages is None:
+                from tpubft.storage.memorydb import MemoryDB
+                pages = self._pages_dbs[r] = ReservedPages(MemoryDB())
         node_keys = self.keys.for_node(r)
         comm = self.bus.create(r)
         strategy = self.byzantine.get(r)

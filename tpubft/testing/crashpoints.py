@@ -84,6 +84,14 @@ REGISTRY: Dict[str, str] = {
         "applied run but before replies/checkpoint votes go out: clients "
         "retry into the reply cache; peers' checkpoint quorum proceeds "
         "without our vote"),
+    "dur.group_fsync": (
+        "durability io thread, after the group's concatenated apply but "
+        "BEFORE its fsync and watermark publication: every run of the "
+        "group is executed and maybe-on-disk (the OS owns the buffers) "
+        "but no reply went out and last_executed never advanced — "
+        "recovery replays the committed suffix from consensus metadata "
+        "and the reserved-pages at-most-once state deduplicates "
+        "whatever did land (exactly-once, no ledger divergence)"),
 }
 
 _mu = threading.Lock()

@@ -269,6 +269,27 @@ class TuningController:
     # ------------------------------------------------------------------
     # surfaces
     # ------------------------------------------------------------------
+    def write_seed(self, path: str) -> Optional[str]:
+        """Persist the converged operating point as a knob-registry
+        seed file (ROADMAP 8d): every knob's CURRENT value, frozen pins
+        preserved, in exactly the format `load_seed` re-baselines from
+        — so the next boot starts warm at this host's measured optimum
+        instead of re-walking from cold defaults. Called on clean
+        replica shutdown when `autotune_seed_file` is configured; a
+        write failure is logged, never raised (shutdown must finish)."""
+        from tpubft.tuning.knobs import write_seed as _write
+        snap = self.registry.snapshot()
+        knobs = {name: ({"value": s["value"], "frozen": True}
+                        if s["frozen"] else s["value"])
+                 for name, s in snap.items()}
+        try:
+            return _write(path, knobs,
+                          note=f"converged operating point written by "
+                               f"{self._name} on clean shutdown")
+        except Exception:  # noqa: BLE001 — see docstring
+            log.exception("seed write-back to %s failed", path)
+            return None
+
     def decisions(self, limit: int = 50) -> List[Dict]:
         with self._mu:
             return list(self._decisions)[-limit:]

@@ -178,6 +178,38 @@ def ecdsa_crossover_policy() -> Policy:
     return policy
 
 
+def durability_amortize_policy() -> Policy:
+    """Group-commit window/size (ISSUE 15): widen while the measured
+    fsync cost PER RUN keeps falling (grouping is still amortizing the
+    disk — the exact analog of the kernel-batch amortization rule,
+    with the probed fsync as the 'kernel'); shrink as soon as `reply`
+    dominates the slot breakdown — with the pipeline, the group-fsync
+    wait is accounted to the reply stage, so a dominant reply share
+    means durability batching is costing more latency than the
+    amortization buys back."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if not fresh_slots(cur, prev):
+            return HOLD
+        if stage_fraction(cur, "reply") > DOMINANT_FRAC:
+            return SHRINK
+        runs = cur.counters.get("dur_runs_delta", 0.0)
+        us = cur.counters.get("dur_fsync_us_delta", 0.0)
+        if prev is None or runs <= 0:
+            return HOLD
+        prev_runs = prev.counters.get("dur_runs_delta", 0.0)
+        prev_us = prev.counters.get("dur_fsync_us_delta", 0.0)
+        if prev_runs <= 0 or prev_us <= 0:
+            return HOLD
+        cost, prev_cost = us / runs, prev_us / prev_runs
+        if cost <= prev_cost * FALLING_RATIO:
+            return GROW
+        return HOLD
+
+    return policy
+
+
 def admission_watermark_policy() -> Policy:
     """Grow the shed watermark while the plane is shedding but
     admission wait is NOT the bottleneck (the queue would drain if

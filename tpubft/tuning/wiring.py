@@ -35,6 +35,7 @@ from tpubft.tuning.controller import TuningController
 from tpubft.tuning.knobs import Knob, KnobRegistry, load_seed
 from tpubft.tuning.policies import (admission_watermark_policy,
                                     batch_amortize_policy,
+                                    durability_amortize_policy,
                                     ecdsa_crossover_policy,
                                     exec_accumulation_policy)
 from tpubft.utils import flight
@@ -115,6 +116,22 @@ def build_replica_tuning(replica, cfg) -> TuningController:
         controller.add_policy("execution_max_accumulation",
                               exec_accumulation_policy())
 
+    # --- durability pipeline (ISSUE 15): group-commit window + size
+    # from the measured per-run fsync cost vs the reply-stage share
+    # (the group-fsync wait is accounted to `reply` in the slot
+    # breakdown) ---
+    if getattr(replica, "durability", None) is not None:
+        K("durability_group_max", cfg.durability_group_max, 1, 64,
+          replica.durability.set_group_max,
+          "fsync us/run falling vs reply p50 share", "runs")
+        controller.add_policy("durability_group_max",
+                              durability_amortize_policy())
+        K("durability_window_us", cfg.durability_window_us, 0,
+          MAX_FLUSH_US, replica.durability.set_window_us,
+          "fsync us/run falling vs reply p50 share", "us")
+        controller.add_policy("durability_window_us",
+                              durability_amortize_policy())
+
     # --- admission backpressure: shed watermark (low follows at
     # high/3, preserving the construction-time hysteresis shape) ---
     if replica.admission is not None and cfg.admission_high_watermark:
@@ -179,6 +196,8 @@ def _depths(replica) -> dict:
         d["exec_lane"] = replica.exec_lane.depth
     if replica.admission is not None:
         d["admission"] = replica.admission.depth
+    if getattr(replica, "durability", None) is not None:
+        d["dur_lag"] = replica.durability.lag
     return d
 
 
@@ -187,4 +206,6 @@ def _counters(replica) -> dict:
          "ecdsa_host_us": replica.sig.ecdsa_host_us.value}
     if replica.admission is not None:
         c["adm_shedding"] = 1 if replica.admission.shedding else 0
+    if getattr(replica, "durability", None) is not None:
+        c.update(replica.durability.stats())
     return c

@@ -273,6 +273,23 @@ class ReplicaConfig:
     # the lane's stall threshold, so a drain that would time out is
     # reported (stack dump + verdict) instead of silently eaten.
     execution_drain_timeout_ms: int = 30000
+    # group-commit durability pipeline (tpubft/durability/): the
+    # execution lane SEALS each run's ledger WriteBatch + reply pages
+    # into a dedicated io thread that group-commits across runs — one
+    # concatenated apply + ONE fsync per group — and publishes a
+    # monotone durability watermark; replies, last_executed and the
+    # at-most-once reply cache advance only behind it. The consensus-
+    # metadata carve-out (db_sync_metadata) stays synchronous on the
+    # dispatcher. Requires the execution lane; False = the legacy
+    # per-run apply with immediate completion.
+    durability_pipeline: bool = True
+    # max runs fsynced per group (1 degenerates to the per-run durable
+    # apply — the bench_e2e --durability-off A/B control's shape)
+    durability_group_max: int = 8
+    # how long the io thread holds a partial group open for more runs,
+    # measured from the group's FIRST sealed run (bounds the extra
+    # reply latency durability batching can add; autotuned live)
+    durability_window_us: int = 1000
     # speculative execution ahead of the threshold combine: the
     # dispatcher hands a slot to the execution lane as SPECULATIVE at
     # prepare-quorum (slow path) or PrePrepare acceptance (fast paths,
@@ -357,6 +374,10 @@ class ReplicaConfig:
                              "disable overload shedding)")
         if self.execution_drain_timeout_ms < 1:
             raise ValueError("execution_drain_timeout_ms must be >= 1")
+        if self.durability_group_max < 1:
+            raise ValueError("durability_group_max must be >= 1")
+        if self.durability_window_us < 0:
+            raise ValueError("durability_window_us must be >= 0")
         if self.breaker_failure_threshold < 1:
             raise ValueError("breaker_failure_threshold must be >= 1")
         if self.health_poll_ms < 1 or self.health_stall_ms < 1:

@@ -97,6 +97,9 @@ EV_TUNE = 26            # autotuner knob change (seq=knob id,
 #                         view=old value, arg=new value; the knob-id →
 #                         name table rides every dump via the tuning
 #                         dump provider)
+EV_DUR_GROUP = 27       # durability group committed (io thread;
+#                         seq=new watermark, arg=runs in the group —
+#                         one event per group fsync)
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -113,6 +116,7 @@ EV_NAMES = {
     EV_TRS_PROOF: "trs_proof", EV_PREEXEC_LAUNCH: "preexec_launch",
     EV_PREEXEC_AGREE: "preexec_agree",
     EV_PREEXEC_CONFLICT: "preexec_conflict", EV_TUNE: "tune",
+    EV_DUR_GROUP: "dur_group",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
