@@ -96,6 +96,62 @@ def run_width(d: int, batch: int, msm_k: int,
             "msm_first_s": round(compile_and_first_s, 1)}
 
 
+def run_dispatch_ab(d: int, batch: int, platform: str = "cpu") -> dict:
+    """Sharded-vs-single A/B through the PRODUCTION dispatch plane
+    (ISSUE 16): the same ed25519 flood routed twice by the live mesh
+    tier — once with the CryptoMesh capped at one chip (the pre-mesh
+    single-device path) and once at full width. Correctness-gated: the
+    two verdict vectors must be byte-identical before any rate is
+    reported. On a real mesh the acceptance bar is >= 1.6x at 2 shards;
+    on the virtual CPU host mesh every shard multiplexes one core, so
+    the row is annotated degraded and only the byte-identity + the
+    bounded sharding overhead are the signal."""
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from benchmarks.common import setup_cache
+    setup_cache()
+    import numpy as np
+
+    from tpubft.crypto import cpu as ccpu
+    from tpubft.ops import dispatch
+    from tpubft.ops import ed25519 as ops
+
+    signer = ccpu.Ed25519Signer.generate(seed=b"scale-ab")
+    pk = signer.public_bytes()
+    items = [(b"ab-%d" % i, signer.sign(b"ab-%d" % i), pk)
+             for i in range(batch)]
+    mgr = dispatch.crypto_mesh()
+    mgr.reset()
+
+    def leg(cap: int):
+        mgr.set_shard_count(cap)
+        out = np.asarray(ops.verify_batch(items))       # compile + warm
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = np.asarray(ops.verify_batch(items))
+        return out, batch / ((time.perf_counter() - t0) / reps)
+
+    single, single_rate = leg(1)
+    shards = 0
+    try:
+        mgr.set_shard_count(0)
+        shards = dispatch.mesh_shards()
+        sharded, sharded_rate = leg(0)
+    finally:
+        mgr.set_shard_count(0)
+    assert single.tobytes() == sharded.tobytes(), \
+        "A/B verdict vectors diverged between shard widths"
+    assert bool(single.all()), "valid flood failed to verify"
+    return {"mode": "dispatch-ab", "devices": d, "batch": batch,
+            "platform": jax.default_backend(), "shards": shards,
+            "single_rate": round(single_rate, 1),
+            "sharded_rate": round(sharded_rate, 1),
+            "speedup": round(sharded_rate / max(single_rate, 1e-9), 3),
+            "verdicts_identical": True}
+
+
 def _annotate_degraded(row: dict, probe_error, stderr_tail: str) -> dict:
     """bench.py's artifact convention (PR 4): a row produced on the CPU
     backend is not comparable to a real-chip row and must say so in a
@@ -121,6 +177,10 @@ def main() -> None:
     ap.add_argument("--msm-k", type=int, default=64)
     ap.add_argument("--one-width", type=int, default=0,
                     help="internal: run this width in-process")
+    ap.add_argument("--dispatch-ab", action="store_true",
+                    help="sharded-vs-single A/B through the production "
+                         "dispatch plane (mesh cap 1 vs full width), "
+                         "correctness-gated on byte-identical verdicts")
     ap.add_argument("--platform", default="cpu",
                     choices=("cpu", "native"),
                     help="cpu = virtual host-device mesh (1-host "
@@ -128,8 +188,13 @@ def main() -> None:
                          "(the actual scaling slope)")
     args = ap.parse_args()
     if args.one_width:
-        print(json.dumps(run_width(args.one_width, args.batch, args.msm_k,
-                                   platform=args.platform)))
+        if args.dispatch_ab:
+            print(json.dumps(run_dispatch_ab(args.one_width, args.batch,
+                                             platform=args.platform)))
+        else:
+            print(json.dumps(run_width(args.one_width, args.batch,
+                                       args.msm_k,
+                                       platform=args.platform)))
         return
     probe_error = None
     if args.platform == "native":
@@ -147,11 +212,13 @@ def main() -> None:
             env["XLA_FLAGS"] = (
                 env.get("XLA_FLAGS", "")
                 + f" --xla_force_host_platform_device_count={d}").strip()
-        r = subprocess.run(
-            [sys.executable, "-m", "benchmarks.bench_scaling",
-             "--one-width", str(d), "--batch", str(args.batch),
-             "--msm-k", str(args.msm_k), "--platform", args.platform],
-            env=env, capture_output=True, text=True, timeout=1800)
+        cmd = [sys.executable, "-m", "benchmarks.bench_scaling",
+               "--one-width", str(d), "--batch", str(args.batch),
+               "--msm-k", str(args.msm_k), "--platform", args.platform]
+        if args.dispatch_ab:
+            cmd.append("--dispatch-ab")
+        r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                           timeout=1800)
         if r.returncode != 0:
             print(json.dumps({"devices": d, "degraded": True,
                               "probe_error": "width subprocess exited "

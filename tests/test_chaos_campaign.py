@@ -170,6 +170,30 @@ def test_bench_chaos_replay_red_second_pass_fails(capsys):
     assert line["replay_failed"] == ["flaky"]  # but the rerun went red
 
 
+@pytest.mark.slow
+def test_mesh_chip_fault_scenario_replays_identically():
+    # slow: runs the full chip-kill flood twice (~50s warm on the 1-core
+    # tier-1 host); the scenario also runs in the smoke matrix and its
+    # replay determinism is checked by `bench_chaos --replay-check`.
+    """The multi-chip crypto-plane chaos scenario (ISSUE 16): a mesh
+    chip dies mid-ed25519-flood, the per-chip breaker evicts it and the
+    flood rebalances onto the survivors with no scalar trip and no
+    dropped verdicts, then the healed chip re-admits at cooldown. Run
+    twice: green both times, digest-identical schedule."""
+    by_name = cmp.matrix_by_name()
+    spec = by_name["mesh-chip-fault-flood"]
+    first = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
+    assert first["failed"] == 0, json.dumps(first["scenarios"], indent=1)
+    second = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
+    assert second["failed"] == 0, json.dumps(second["scenarios"],
+                                             indent=1)
+    assert first["event_log_digest"] == second["event_log_digest"]
+    stats = first["scenarios"][0]["stats"]
+    if not stats.get("degraded"):        # multi-device host: the plane
+        assert stats["shards_after_eviction"] >= 1   # really rebalanced
+        assert stats["rebalance_ms"] > 0.0
+
+
 def test_thin_replica_failover_scenario_replays_identically():
     """The read-tier chaos scenario (ISSUE 12): a thin-replica
     subscriber survives its data server's kill by rotating to another

@@ -5,12 +5,18 @@ Every kernel call site goes through the breaker-guarded
 gate — import, call, or attribute — outside tpubft/ops/dispatch.py
 bypasses failure classification, the OPEN fast-fail, and half-open
 probe accounting. tools/check_device_seam.py remains the CLI shim.
+
+ISSUE 16 extends the same confinement to the mesh fan-out plane: a raw
+`shard_map` call site outside tpubft/parallel/sharding.py (which owns
+the CryptoMesh + every sharded kernel builder) or tpubft/ops/dispatch.py
+(the mesh_launch tier) bypasses per-chip breaker eviction and the
+launch-failure rebalance loop, so it is rejected by construction too.
 """
 from __future__ import annotations
 
 import ast
 import os
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from tools.tpulint.core import Finding, ScanError, load_modules
 
@@ -20,10 +26,30 @@ FORBIDDEN = "device_dispatch"
 # the one module allowed to touch the raw gate (it defines it and wraps
 # it in the breaker-guarded device_section)
 ALLOWED = {os.path.join("tpubft", "ops", "dispatch.py")}
+_SEAM_MSG = ("kernel call sites must use the breaker-guarded "
+             "device_section(kind) seam (tpubft/ops/dispatch.py)")
+
+MESH_FORBIDDEN = "shard_map"
+# the sharding module owns every sharded kernel builder; the dispatch
+# module owns the mesh_launch tier that routes to them
+MESH_ALLOWED = {os.path.join("tpubft", "parallel", "sharding.py"),
+                os.path.join("tpubft", "ops", "dispatch.py")}
+_MESH_MSG = ("mesh fan-out must go through tpubft/parallel/sharding.py "
+             "(CryptoMesh kernel builders) and the ops/dispatch "
+             "mesh_launch tier — a raw shard_map call site bypasses "
+             "per-chip breaker eviction and launch-failure rebalance")
+
+# (forbidden name, allowed module set, rationale) — the default rule
+# set the pass and the bare CLI apply
+RULES: Tuple[Tuple[str, set, str], ...] = (
+    (FORBIDDEN, ALLOWED, _SEAM_MSG),
+    (MESH_FORBIDDEN, MESH_ALLOWED, _MESH_MSG),
+)
 
 
 def scan_tree(tree: ast.Module, rel: str,
-              forbidden: str = FORBIDDEN) -> List[Tuple[str, int, str]]:
+              forbidden: str = FORBIDDEN,
+              message: str = _SEAM_MSG) -> List[Tuple[str, int, str]]:
     out: List[Tuple[str, int, str]] = []
     for node in ast.walk(tree):
         hit = None
@@ -35,27 +61,37 @@ def scan_tree(tree: ast.Module, rel: str,
                 and any(a.name == forbidden for a in node.names):
             hit = f"imports {forbidden}"
         if hit:
-            out.append((rel, node.lineno,
-                        f"{hit} — kernel call sites must use the "
-                        f"breaker-guarded device_section(kind) seam "
-                        f"(tpubft/ops/dispatch.py)"))
+            out.append((rel, node.lineno, f"{hit} — {message}"))
     return out
 
 
-def violations_for(mods, syntax, forbidden: str = FORBIDDEN,
+def _rules_for(forbidden: Optional[str], allowed) \
+        -> Tuple[Tuple[str, set, str], ...]:
+    """Explicit (forbidden, allowed) narrows to ONE rule — the legacy
+    CLI shim pins the device_dispatch rule this way; the defaults apply
+    the full rule set."""
+    if forbidden is None:
+        return RULES
+    for name, allow, msg in RULES:
+        if name == forbidden:
+            return ((name, allow if allowed is None else allowed, msg),)
+    return ((forbidden, allowed or set(), _SEAM_MSG),)
+
+
+def violations_for(mods, syntax, forbidden: Optional[str] = None,
                    allowed=None) -> List[Tuple[str, int, str]]:
-    allowed = ALLOWED if allowed is None else allowed
     out: List[Tuple[str, int, str]] = []
     for f in syntax:
         out.append((f.path, f.line, f.message))
-    for sm in mods:
-        if sm.rel in allowed:
-            continue
-        out.extend(scan_tree(sm.tree, sm.rel, forbidden))
+    for name, allow, msg in _rules_for(forbidden, allowed):
+        for sm in mods:
+            if sm.rel in allow:
+                continue
+            out.extend(scan_tree(sm.tree, sm.rel, name, msg))
     return sorted(out)
 
 
-def find_violations(root: str, forbidden: str = FORBIDDEN,
+def find_violations(root: str, forbidden: Optional[str] = None,
                     allowed=None) -> List[Tuple[str, int, str]]:
     try:
         mods, syntax = load_modules(root, ("tpubft",))
@@ -73,6 +109,6 @@ def run(ctx) -> List[Finding]:
     mods, syntax = ctx.load("tpubft")     # cached parse; loud zero-scan
     findings: List[Finding] = []
     for rel, line, msg in violations_for(mods, syntax):
-        findings.append(Finding(PASS_ID, rel, line,
-                                f"{rel}:{FORBIDDEN}", msg))
+        key = MESH_FORBIDDEN if MESH_FORBIDDEN in msg else FORBIDDEN
+        findings.append(Finding(PASS_ID, rel, line, f"{rel}:{key}", msg))
     return findings

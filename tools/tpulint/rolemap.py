@@ -169,6 +169,20 @@ API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
     # handler threads; published by the dispatcher (_store_checkpoint)
     ("tpubft/consensus/replica.py", "Replica", "thin_replica_anchor"):
         frozenset({"thinreplica_srv"}),
+    # mesh-rebuild path (ISSUE 16): the crypto-mesh manager's plan /
+    # eviction state is mutated from every kernel-calling thread (any
+    # verify seam can hit on_launch_failure and rebuild the plan) and
+    # from the autotuner, whose `crypto_shard_count` knob stores
+    # set_shard_count as a callable attribute (Knob.apply_fn) the
+    # syntactic call graph cannot see through
+    ("tpubft/parallel/sharding.py", "CryptoMesh", "set_shard_count"):
+        frozenset({"tuner"}),
+    ("tpubft/parallel/sharding.py", "CryptoMesh", "plan"):
+        frozenset({"dispatcher", "exec_lane", "admission", "batcher",
+                   "sig_combine", "durability"}),
+    ("tpubft/parallel/sharding.py", "CryptoMesh", "on_launch_failure"):
+        frozenset({"dispatcher", "exec_lane", "admission", "batcher",
+                   "sig_combine"}),
 }
 
 # -- callback registrars: arg positions/kwargs that receive a function
@@ -231,6 +245,14 @@ RETURN_TYPE_HINTS: Dict[str, Tuple[str, str]] = {
         ("tpubft/utils/flight.py", "SlotTracker"),
     "tpubft.utils.flight.kernel_profiler":
         ("tpubft/utils/flight.py", "KernelProfiler"),
+    # crypto-mesh manager (ISSUE 16): lets `crypto_mesh().plan()` /
+    # `mesh_manager().on_launch_failure(...)` chains resolve so the
+    # static-race pass covers the plan/eviction state guarded by the
+    # manager's `crypto_mesh` lock
+    "tpubft.parallel.sharding.mesh_manager":
+        ("tpubft/parallel/sharding.py", "CryptoMesh"),
+    "tpubft.ops.dispatch.crypto_mesh":
+        ("tpubft/parallel/sharding.py", "CryptoMesh"),
 }
 
 # modules excluded from the concurrency passes (thread-roles,

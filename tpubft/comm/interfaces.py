@@ -75,6 +75,16 @@ class ICommunication(abc.ABC):
         for d in dests:
             self.send(d, data)
 
+    def send_burst(self, msgs: "Iterable[Tuple[NodeNum, bytes]]") -> None:
+        """Burst send: many (dest, payload) pairs handed to the
+        transport in one call, the sending mirror of
+        `IReceiver.on_new_messages` — a batching transport (udp
+        sendmmsg) can push the whole burst through one syscall. Used by
+        the durability pipeline to release a committed group's replies
+        as a single wire burst. Default: per-message sends."""
+        for dest, data in msgs:
+            self.send(dest, data)
+
     def get_connection_status(self, node: NodeNum) -> ConnectionStatus:
         return ConnectionStatus.UNKNOWN
 

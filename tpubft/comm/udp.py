@@ -119,6 +119,38 @@ class PlainUdpCommunication(ICommunication):
         except OSError:
             pass  # best-effort, like UDP itself
 
+    def send_burst(self, msgs) -> None:
+        """Burst send from ANY thread (the durability pipeline's io
+        thread releases a committed group's replies here): builds the
+        sendmmsg record batch locally — no shared buffer, so it never
+        races the flusher thread's `_batch` — and pushes it through the
+        same one-syscall path as the dispatcher's flush. Destinations
+        without a packed IPv4 prefix (or without netio) fall back to
+        per-datagram sendto, same as send()."""
+        if not self._running or self._sock is None:
+            return
+        records: list = []
+        for dest, data in msgs:
+            if len(data) > self.max_message_size:
+                continue  # oversize datagram: dropped (reference drops)
+            pkt = self._cfg.self_id.to_bytes(_HDR, "little") + data
+            pfx = self._addr_pfx.get(dest)
+            if self._netio is not None and pfx is not None:
+                records.append(pfx + len(pkt).to_bytes(4, "little") + pkt)
+                if len(records) >= 256:
+                    self._send_records(records)  # bound buffered memory
+                    records = []
+                continue
+            addr = self._cfg.endpoints.get(dest)
+            if addr is None:
+                continue
+            try:
+                self._sock.sendto(pkt, addr)
+            except OSError:
+                pass  # best-effort, like UDP itself
+        if records:
+            self._send_records(records)
+
     def flush(self) -> None:
         """Called by the owning dispatcher at the end of each iteration;
         the first caller becomes the (single) batching thread."""
@@ -129,6 +161,9 @@ class PlainUdpCommunication(ICommunication):
 
     def _drain(self) -> None:
         batch, self._batch = self._batch, []
+        self._send_records(batch)
+
+    def _send_records(self, batch: list) -> None:
         if not self._running or self._sock is None:
             return
         blob = b"".join(batch)

@@ -78,6 +78,10 @@ class CompletedRun:
     n_requests: int                       # executed (non-dedup) requests
     replies: List[Tuple[int, bytes]] = field(default_factory=list)
     reply_keys: List[Tuple[int, int]] = field(default_factory=list)
+    # set by the durability pipeline when it already pushed `replies`
+    # as part of the group-boundary send burst — the dispatcher's
+    # integration pass must not send them a second time
+    replies_sent: bool = False
     # (seq, state_digest, pages_digest, block_id) when `last` is a
     # checkpoint boundary — snapshotted at the boundary, before the
     # next run ran. block_id is the ledger height the state digest

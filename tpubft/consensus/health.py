@@ -199,8 +199,17 @@ class HealthMonitor:
             agg = DEGRADED
         else:
             agg = HEALTHY
-        return {"verdict": agg, "stalled": stalled, "probes": probes,
-                "breakers": breakers, "degraded": degraded}
+        out = {"verdict": agg, "stalled": stalled, "probes": probes,
+               "breakers": breakers, "degraded": degraded}
+        # mesh summary (ISSUE 16): chip inventory / evictions ride the
+        # health payload so a shrunken crypto plane is visible without
+        # decoding the per-chip `device.chip<N>` breaker rows. Only
+        # reported once the mesh manager exists — constructing it here
+        # would force a jax backend probe on chip-less deployments.
+        from tpubft.parallel import sharding as _sh
+        if _sh._MESH_MGR is not None:
+            out["mesh"] = _sh._MESH_MGR.snapshot()
+        return out
 
     def render(self) -> str:
         """`status get health` payload."""

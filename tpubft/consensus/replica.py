@@ -2300,8 +2300,12 @@ class Replica(IReceiver):
                     info.span = None
             for key in run.reply_keys:
                 self._forwarded.pop(key, None)
-            for client, raw in run.replies:
-                self.comm.send(client, raw)
+            # replies already on the wire when the durability pipeline
+            # released them as the group-boundary burst (ISSUE 16) —
+            # sending again here would duplicate every reply datagram
+            if not getattr(run, "replies_sent", False):
+                for client, raw in run.replies:
+                    self.comm.send(client, raw)
             self.m_executed.inc(run.n_requests)
             if run.last > self.last_executed:
                 self.last_executed = run.last

@@ -84,6 +84,12 @@ class SigManager:
         # (dispatch count, not verdicts — failures land in sig_failures)
         self.sigs_device_dispatched = self.metrics.register_counter(
             "sigs_device_dispatched")
+        # of those, items whose ride went out over a multi-chip mesh
+        # (ISSUE 16): sharded == dispatched on a healthy mesh, so
+        # dispatched-minus-sharded exposes single-chip regressions
+        # (evictions, capped `crypto_shard_count`) on live telemetry
+        self.mesh_sharded_verifies = self.metrics.register_counter(
+            "mesh_sharded_verifies")
         # verified-signature memo: bounded LRU of (principal, current
         # pubkey, sha256(data), sig) that already verified under the
         # CURRENT key. Retransmissions and view-change re-validation
@@ -473,6 +479,9 @@ class SigManager:
                     f"for {len(entries)} items")
         # counts only what actually reached the device dispatch
         self.sigs_device_dispatched.inc(len(entries))
+        from tpubft.ops.dispatch import mesh_shards
+        if mesh_shards() > 1:
+            self.mesh_sharded_verifies.inc(len(entries))
         out = [False] * len(items)
         via_grace = [False] * len(items)
         for i, ok in zip(keyed, verdicts):

@@ -78,8 +78,17 @@ def set_ecdsa_crossover(b: Optional[int]) -> None:
 
 def ecdsa_crossover() -> int:
     """The effective crossover (override > env > platform default) —
-    the autotuner seeds its knob default from this."""
-    return _ecdsa_device_crossover()
+    the autotuner seeds its knob default from this. The static tiers
+    (env/platform) scale DOWN by the healthy mesh width: d chips
+    amortize the RLC launch at ~1/d the batch, so the device tier wins
+    sooner. The autotuner override is exempt — its policy already
+    measures the mesh-backed per-item cost, so dividing again would
+    double-count the mesh."""
+    base = _ecdsa_device_crossover()
+    if _crossover_override is not None or base <= 1:
+        return base
+    from tpubft.ops import dispatch
+    return max(1, base // max(1, dispatch.mesh_shards()))
 
 
 def _ecdsa_device_crossover() -> int:
@@ -132,7 +141,7 @@ def verify_batch_mixed(items: Sequence[Tuple[str, bytes, bytes, bytes]]
         elif scheme in ("ecdsa-secp256k1", "secp256k1",
                         "ecdsa-secp256r1", "secp256r1", "ecdsa-p256"):
             curve = ("secp256k1" if "k1" in scheme else "secp256r1")
-            if len(sub) >= _ecdsa_device_crossover():
+            if len(sub) >= ecdsa_crossover():
                 from tpubft.ops import ecdsa as ops_ecdsa
                 verdicts = [bool(x) for x in ops_ecdsa.rlc_verify_batch(
                     curve, [(d, s, pk) for _, pk, d, s in sub])]

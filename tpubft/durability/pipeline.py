@@ -465,6 +465,7 @@ class DurabilityPipeline:
         # checkpoint votes). Same discipline as the lane's post-commit
         # swallow.
         lane = getattr(r, "exec_lane", None)
+        burst: List[Tuple[int, bytes]] = []
         for s in group:
             try:
                 # at-most-once/reply-cache visibility strictly AFTER
@@ -476,6 +477,23 @@ class DurabilityPipeline:
                 log.exception("post-durability reply-cache publish "
                               "failed for run [%d..%d]",
                               s.run.first, s.run.last)
+            # group reply release (ISSUE 16): collect the whole
+            # committed group's replies into ONE transport burst —
+            # per-run sends from the dispatcher paid a syscall per
+            # datagram per run even when a group committed many runs at
+            # one fsync boundary. The flag must be set BEFORE
+            # complete_durable hands the run over (the lane's lock gives
+            # the happens-before), or the dispatcher double-sends.
+            burst.extend(getattr(s.run, "replies", ()))
+            s.run.replies_sent = True
+        comm = getattr(r, "comm", None)
+        if burst and comm is not None:
+            try:
+                comm.send_burst(burst)
+            except Exception:  # noqa: BLE001 — replies are best-effort;
+                log.exception("group reply burst failed "  # retransmits
+                              "(%d replies)", len(burst))  # recover
+        for s in group:
             if lane is not None:
                 try:
                     lane.complete_durable(s.run)

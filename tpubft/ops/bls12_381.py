@@ -57,20 +57,10 @@ def msm_kernel(bits: jnp.ndarray, px: jnp.ndarray, py: jnp.ndarray,
     return out.x, out.y, out.z
 
 
-def msm(points: Sequence, scalars: Sequence[int]):
-    """Host-facing MSM: G1 affine int points + int scalars -> affine point.
-    Drop-in for the reference fastMultExp (FastMultExp.cpp:27-59).
-    Multi-device hosts shard the points over the mesh (each device
-    ladders its shard; one tiny all_gather combines — SURVEY §5.7)."""
-    import jax
-    if len(jax.devices()) > 1 and len(points) >= 2 * len(jax.devices()):
-        from tpubft.parallel.sharding import sharded_msm
-        return sharded_msm(points, scalars)
+def _prep_msm(points: Sequence, scalars: Sequence[int], m: int):
+    """Pad an n-point MSM to m slots (identity padding) -> device arrays."""
     cv = g1_curve()
     n = len(points)
-    if n == 0:
-        return None
-    m = _pad_pow2(n)
     infinity = np.zeros(m, bool)
     pts: List[Tuple[int, int]] = []
     ks: List[int] = []
@@ -84,13 +74,48 @@ def msm(points: Sequence, scalars: Sequence[int]):
             infinity[i] = True
     px, py = cv.affine_to_device(pts)
     bits = _bits_msb_batch(ks)
+    return bits, px, py, infinity
+
+
+def _msm_launch(plan, points: Sequence, scalars: Sequence[int]):
+    """One MSM launch under a MeshPlan (None / meshless plan = the
+    single-device kernel — also the post-eviction landing spot when
+    the retry loop hands us a one-chip plan)."""
     from tpubft.ops.dispatch import device_section
-    with device_section("bls_msm", batch=len(pts)):
-        x, y, z = msm_kernel(jnp.asarray(bits), jnp.asarray(px),
-                             jnp.asarray(py), jnp.asarray(infinity))
+    n = len(points)
+    if plan is not None and plan.mesh is not None:
+        from tpubft.parallel import sharding
+        shards = plan.n
+        m = sharding.shard_rows(n, shards) * shards
+        kern = sharding.mesh_manager().cached_kernel(
+            "bls_msm", plan, sharding.sharded_msm_kernel)
+    else:
+        shards, m = 1, _pad_pow2(n)
+        kern = msm_kernel
+    bits, px, py, infinity = _prep_msm(points, scalars, m)
+    with device_section("bls_msm", batch=m, shards=shards):
+        x, y, z = kern(jnp.asarray(bits), jnp.asarray(px),
+                       jnp.asarray(py), jnp.asarray(infinity))
         x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
     # host-side affine conversion stays OUTSIDE the gate (dispatch.py rule)
     return _to_affine_host(x[:, 0], y[:, 0], z[:, 0])
+
+
+def msm(points: Sequence, scalars: Sequence[int]):
+    """Host-facing MSM: G1 affine int points + int scalars -> affine point.
+    Drop-in for the reference fastMultExp (FastMultExp.cpp:27-59).
+    Multi-chip hosts shard the points over the healthy mesh (each device
+    ladders its shard; one tiny all_gather combines — SURVEY §5.7),
+    with per-chip fault isolation via dispatch.mesh_launch."""
+    n = len(points)
+    if n == 0:
+        return None
+    from tpubft.ops import dispatch
+    plan = dispatch.mesh_plan()
+    if plan.mesh is not None and n >= 2 * plan.n:
+        return dispatch.mesh_launch(
+            "bls_msm", lambda p: _msm_launch(p, points, scalars))
+    return _msm_launch(None, points, scalars)
 
 
 def _to_affine_host(x_limbs, y_limbs, z_limbs):
@@ -133,12 +158,36 @@ def msm_batch(segments: Sequence[Tuple[Sequence, Sequence[int]]]) -> List:
     one launch per segment (the per-slot combine tax the fused
     combine plane removes). Returns one affine point (or None for the
     identity) per segment. Segment count and width are padded to
-    powers of two so the jit cache stays at O(log² sizes) programs."""
-    cv = g1_curve()
+    powers of two so the jit cache stays at O(log² sizes) programs.
+    Wide segments (share width >= 2 per chip) shard the share axis
+    over the healthy mesh."""
     s = len(segments)
     if s == 0:
         return []
-    kmax = _pad_pow2(max(1, max(len(p) for p, _ in segments)))
+    kwidth = max(1, max(len(p) for p, _ in segments))
+    from tpubft.ops import dispatch
+    plan = dispatch.mesh_plan()
+    if plan.mesh is not None and kwidth >= 2 * plan.n:
+        return dispatch.mesh_launch(
+            "bls_msm", lambda p: _msm_batch_launch(p, segments))
+    return _msm_batch_launch(None, segments)
+
+
+def _msm_batch_launch(plan,
+                      segments: Sequence[Tuple[Sequence, Sequence[int]]]
+                      ) -> List:
+    cv = g1_curve()
+    s = len(segments)
+    kwidth = max(1, max(len(p) for p, _ in segments))
+    if plan is not None and plan.mesh is not None:
+        from tpubft.parallel import sharding
+        shards = plan.n
+        kmax = sharding.shard_rows(kwidth, shards) * shards
+        kern = sharding.mesh_manager().cached_kernel(
+            "bls_msm.batch", plan, sharding.sharded_msm_batch_kernel)
+    else:
+        shards, kmax = 1, _pad_pow2(kwidth)
+        kern = msm_batch_kernel
     smax = _pad_pow2(s)
     infinity = np.ones((smax, kmax), bool)
     pts: List[Tuple[int, int]] = []
@@ -160,9 +209,9 @@ def msm_batch(segments: Sequence[Tuple[Sequence, Sequence[int]]]) -> List:
     py = py.reshape(py.shape[0], smax, kmax)
     bits = _bits_msb_batch(ks).reshape(SCALAR_BITS, smax, kmax)
     from tpubft.ops.dispatch import device_section
-    with device_section("bls_msm", batch=total):
-        x, y, z = msm_batch_kernel(jnp.asarray(bits), jnp.asarray(px),
-                                   jnp.asarray(py), jnp.asarray(infinity))
+    with device_section("bls_msm", batch=total, shards=shards):
+        x, y, z = kern(jnp.asarray(bits), jnp.asarray(px),
+                       jnp.asarray(py), jnp.asarray(infinity))
         x, y, z = np.asarray(x), np.asarray(y), np.asarray(z)
     return [_to_affine_host(x[:, j, 0], y[:, j, 0], z[:, j, 0])
             for j in range(s)]

@@ -64,12 +64,14 @@ class _Section:
     time, breaker state). Raises BreakerOpen without touching the
     device when tripped."""
 
-    __slots__ = ("_attempt", "_kind", "_batch", "_kid", "_t0", "_rec")
+    __slots__ = ("_attempt", "_kind", "_batch", "_kid", "_t0", "_rec",
+                 "_shards")
 
-    def __init__(self, kind: str, batch: int) -> None:
+    def __init__(self, kind: str, batch: int, shards: int = 1) -> None:
         self._attempt = _breaker.attempt(kind)
         self._kind = kind
         self._batch = batch
+        self._shards = max(1, shards)
         # the TPUBFT_FLIGHT=0 off switch covers the kernel profiler
         # too: a disabled recorder must cost this seam nothing beyond
         # the enabled() check (decided once per section — consistent
@@ -105,12 +107,77 @@ class _Section:
             # breaker shows up as such in the kernel profile)
             flight.record(flight.EV_DEV_EXIT, view=self._kid,
                           arg=int(elapsed_ns // 1000))
-            flight.kernel_profiler().record(self._kind, self._batch,
-                                            elapsed_ns, _breaker.state)
+            prof = flight.kernel_profiler()
+            prof.record(self._kind, self._batch, elapsed_ns,
+                        _breaker.state)
+            if self._shards > 1:
+                # per-shard view of the same launch: the shards run in
+                # lockstep, so wall time is shared and the per-shard
+                # batch is the rebalanced slice — this is the profile
+                # the `crypto_shard_count` tuning policy (and an
+                # operator reading `status get kernels`) compares
+                # against the unsharded kind
+                prof.record(f"{self._kind}.shard",
+                            max(1, -(-self._batch // self._shards)),
+                            elapsed_ns, _breaker.state)
         return suppressed
 
 
-def device_section(kind: str, batch: int = 0) -> _Section:
+def device_section(kind: str, batch: int = 0, shards: int = 1) -> _Section:
     """Guarded device seam. `batch` annotates the kernel profile /
-    flight ring with the call's batch size (0 = not reported)."""
-    return _Section(kind, batch)
+    flight ring with the call's batch size (0 = not reported);
+    `shards > 1` marks a mesh launch and adds a `<kind>.shard` profile
+    row with the per-shard batch size."""
+    return _Section(kind, batch, shards)
+
+
+# ---------------------------------------------------------------------
+# mesh tier (ISSUE 16): multi-chip routing for the batched kernels
+# ---------------------------------------------------------------------
+
+def crypto_mesh():
+    """The process-wide CryptoMesh control plane (health plane, chaos
+    tooling and the `crypto_shard_count` knob actuator reach it here —
+    ops modules only use `mesh_plan`/`mesh_launch` below)."""
+    from tpubft.parallel.sharding import mesh_manager
+    return mesh_manager()
+
+
+def mesh_plan():
+    """Current routing decision (probes cooled-down chips for
+    re-admission as a side effect). `plan.mesh is None` on single-chip
+    hosts — callers take their unsharded kernel path."""
+    return crypto_mesh().plan()
+
+
+def mesh_shards() -> int:
+    """Shard count the next mesh launch would use (1 = no mesh)."""
+    return crypto_mesh().plan().n
+
+
+def mesh_launch(kind: str, launch):
+    """Run one sharded launch with per-chip fault isolation:
+    `launch(plan)` is called with the current MeshPlan; if it raises,
+    every chip in the plan is probed and any chip failing its probe is
+    EVICTED (its `device.chip<N>` breaker trips), the mesh is rebuilt
+    over the survivors, and the launch retries there — so a single sick
+    chip degrades the plane to the surviving shards, never to scalar.
+    Only when no chip can be blamed (or none are left) does the error
+    propagate to the caller's fallback tier. The launch callable must
+    handle `plan.mesh is None` (run its unsharded kernel) so the
+    retry loop stays total.
+
+    BreakerOpen passes straight through: the GLOBAL device breaker
+    tripping means the whole plane is degraded — that is the caller's
+    scalar-fallback signal, not a rebalancing opportunity."""
+    mgr = crypto_mesh()
+    while True:
+        plan = mgr.plan()
+        try:
+            mgr.raise_if_faulted(plan)
+            return launch(plan)
+        except BreakerOpen:
+            raise
+        except Exception:
+            if not mgr.on_launch_failure(plan, kind):
+                raise

@@ -264,13 +264,15 @@ def prepare_rlc_batch(curve_name: str,
                             a_m, chk.valid)
 
 
-def make_rlc_kernel(curve_name: str):
-    cv = get_curve(curve_name)
+def rlc_fold_body(cv: Curve):
+    """The RLC aggregate fold as a traceable body (no jit): shared by
+    the single-device kernel below and the per-shard local function in
+    tpubft/parallel/sharding.sharded_rlc_kernel, so the mesh path folds
+    EXACTLY the arithmetic the bisection re-launches verify against."""
     f = cv.f
 
-    @jax.jit
-    def kernel(u1_bits, u2_bits, qx, qy, xr_m, xrpn_m, wrap_ok, active,
-               a_m):
+    def body(u1_bits, u2_bits, qx, qy, xr_m, xrpn_m, wrap_ok, active,
+             a_m):
         batch = qx.shape[1:]
         q = cv.from_affine(qx, qy)
         g = cv.generator(batch)
@@ -295,7 +297,11 @@ def make_rlc_kernel(curve_name: str):
             w = f.norm(f.add(w[..., :h], w[..., h:]))
         return jnp.all(f.canonical_raw(w) == 0)
 
-    return kernel
+    return body
+
+
+def make_rlc_kernel(curve_name: str):
+    return jax.jit(rlc_fold_body(get_curve(curve_name)))
 
 
 _RLC_KERNELS = {}
@@ -321,6 +327,57 @@ def _rlc_launch(curve_name: str, prep: PreparedRlcBatch,
         return bool(np.asarray(ok))
 
 
+# the RLC aggregate rides the mesh only past this per-shard lane
+# count: each extra mesh width is another compiled ladder program, and
+# small flushes amortize fine on one chip
+_MESH_MIN_ROWS = 32
+
+
+def _rlc_mesh_round(plan, curve_name: str, prep: PreparedRlcBatch,
+                    idxs: Sequence[int]) -> List[List[int]]:
+    """One sharded aggregate round: returns the list of index subsets
+    (one per FAILING shard) that still need bisection — empty means
+    every shard's partial sum was zero and the whole batch passes.
+    The per-shard verdict bits replace the all-reduce: the aggregate
+    verdict is their AND, and a failing aggregate names the guilty
+    shard for free, so bisection re-launches only inside it. Falls
+    back to the unsharded aggregate when eviction shrank the plan to
+    one chip."""
+    if plan is None or plan.mesh is None:
+        return [] if _rlc_launch(curve_name, prep, idxs) else [list(idxs)]
+    from tpubft.parallel import sharding
+    if curve_name not in _RLC_KERNELS:
+        _RLC_KERNELS[curve_name] = make_rlc_kernel(curve_name)
+    d = plan.n
+    rows = sharding.shard_rows(len(idxs), d)
+    m = rows * d
+    sel = list(idxs) + [idxs[0]] * (m - len(idxs))
+    active = np.zeros(m, bool)
+    active[:len(idxs)] = prep.host_valid[list(idxs)]
+    kern = sharding.mesh_manager().cached_kernel(
+        f"ecdsa_rlc.{curve_name}", plan,
+        lambda mesh: sharding.sharded_rlc_kernel(curve_name, mesh))
+    from tpubft.ops.dispatch import device_section
+    with device_section("ecdsa", batch=len(idxs), shards=d):
+        ok = np.asarray(kern(
+            prep.u1_bits[:, sel], prep.u2_bits[:, sel],
+            prep.qx[:, sel], prep.qy[:, sel],
+            prep.xr_m[:, sel], prep.xrpn_m[:, sel],
+            prep.wrap_ok[sel], jnp.asarray(active), prep.a_m[:, sel]))
+        if ok.shape[0] < d:
+            raise RuntimeError(
+                f"sharded rlc kernel returned {ok.shape[0]} shard "
+                f"verdicts for a mesh of {d}")
+    failing = []
+    for j in range(d):
+        if not ok[j]:
+            sub = [idxs[k] for k in range(j * rows,
+                                          min((j + 1) * rows, len(idxs)))]
+            if sub:
+                failing.append(sub)
+    return failing
+
+
 def rlc_verify_batch(curve_name: str,
                      items: Sequence[Tuple[bytes, bytes, bytes]]
                      ) -> np.ndarray:
@@ -328,7 +385,10 @@ def rlc_verify_batch(curve_name: str,
     flush; on aggregate failure, binary bisection re-launches halves
     (b forged items cost O(b*log B) launches, reference
     BlsBatchVerifier::batchVerifyRecursive) so only guilty items fail.
-    Verdicts are identical to `verify_batch` / the scalar loop."""
+    Big flushes shard the aggregate over the chip mesh (per-shard
+    partial sums + per-shard verdict bits; bisection only inside a
+    failing shard). Verdicts are identical to `verify_batch` / the
+    scalar loop on every path."""
     if not items:
         return np.zeros(0, bool)
     prep = prepare_rlc_batch(curve_name, items)
@@ -348,5 +408,16 @@ def rlc_verify_batch(curve_name: str,
         descend(live[:mid])
         descend(live[mid:])
 
-    descend(list(range(len(items))))
+    live = [i for i in range(len(items)) if prep.host_valid[i]]
+    if not live:
+        return out
+    from tpubft.ops import dispatch
+    plan = dispatch.mesh_plan()
+    if plan.mesh is not None and len(live) >= _MESH_MIN_ROWS * plan.n:
+        for sub in dispatch.mesh_launch(
+                "ecdsa",
+                lambda p: _rlc_mesh_round(p, curve_name, prep, live)):
+            descend(sub)
+    else:
+        descend(live)
     return out

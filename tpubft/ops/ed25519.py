@@ -371,33 +371,39 @@ def _use_pallas() -> bool:
         return False
 
 
-@functools.lru_cache(maxsize=1)
-def _mesh_kernel():
-    """Multi-device data-parallel verify (None on single-device hosts).
-    This is how the product batch path scales across chips: the batch
-    axis shards over the mesh, no cross-device traffic (SURVEY §5.7 —
-    the sharded kernels are the same ones dryrun_multichip validates).
-    On TPU meshes each device runs the fused Pallas kernel on its shard."""
-    import jax
-    if len(jax.devices()) <= 1:
-        return None
-    from tpubft.parallel.sharding import (make_mesh, sharded_verify_ed25519,
-                                          verify_pad_multiple)
-    mesh = make_mesh()
-    return verify_pad_multiple(mesh), sharded_verify_ed25519(mesh)
+def _pad_rows(prep: "PreparedBatch", n: int, m: int):
+    """Zero-pad the prepared arrays from n to m lanes (padding lanes
+    carry benign values and are masked out by host_valid)."""
+    def pad(a, axis):
+        if m == n:
+            return a
+        width = [(0, 0)] * a.ndim
+        width[axis] = (0, m - n)
+        return np.pad(a, width)
+
+    return (pad(prep.s_win, 1), pad(prep.h_win, 1), pad(prep.a_y, 1),
+            pad(prep.a_sign, 0), pad(prep.r_y, 1), pad(prep.r_sign, 0))
 
 
-def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
-    """End-to-end batched verify: (msg, sig, pk) triples → bool array."""
-    if not items:
-        return np.zeros(0, bool)
-    n = len(items)
-    meshed = _mesh_kernel()
-    if meshed is not None:
-        d, kernel = meshed
-        m = _pad_to_class(n)
-        m = ((m + d - 1) // d) * d      # batch must split over the mesh
-    elif _use_pallas():
+def _run_kernel(kernel, prep: "PreparedBatch", n: int, m: int,
+                shards: int = 1) -> np.ndarray:
+    from tpubft.ops.dispatch import device_section
+    with device_section("ed25519", batch=n, shards=shards):
+        dev = kernel(*_pad_rows(prep, n, m))
+        out = np.asarray(dev)
+        if out.shape[0] < n:
+            # a garbage device result must classify as a device failure
+            # (breaker), never silently truncate into false verdicts
+            raise RuntimeError(
+                f"ed25519 kernel returned {out.shape[0]} verdicts "
+                f"for a batch of {n}")
+        return out[:n] & prep.host_valid
+
+
+def _single_device_verify(prep: "PreparedBatch", n: int) -> np.ndarray:
+    """The unsharded tier: fused Pallas kernel on TPU, plain XLA
+    elsewhere, batch padded to a size class."""
+    if _use_pallas():
         from tpubft.ops import ed25519_pallas
         kernel = ed25519_pallas.verify_kernel
         # the fused kernel tiles the batch in TILE-lane grid steps
@@ -407,25 +413,47 @@ def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
     else:
         kernel = verify_kernel
         m = _pad_to_class(n)
+    return _run_kernel(kernel, prep, n, m)
+
+
+def _mesh_verify(plan, prep: "PreparedBatch", n: int) -> np.ndarray:
+    """One launch under a MeshPlan: batch axis sharded over the plan's
+    devices (each running the fused Pallas kernel on TPU meshes), with
+    pow2 per-shard rows so the jit cache stays bounded. Falls through
+    to the single-device tier when eviction shrank the plan to one
+    chip — the mesh_launch retry loop hands us whatever survives."""
+    if plan.mesh is None:
+        return _single_device_verify(prep, n)
+    from tpubft.parallel import sharding
+    per_dev = 1
+    if _use_pallas():
+        from tpubft.ops import ed25519_pallas
+        per_dev = ed25519_pallas.TILE
+    # floor of 8 rows/shard keeps the shape inventory near the old
+    # size-class ladder (8 chips -> m of 64, 128, 256, ...)
+    rows = max(sharding.shard_rows(n, plan.n, per_dev), 8)
+    kernel = sharding.mesh_manager().cached_kernel(
+        "ed25519", plan, sharding.sharded_verify_ed25519)
+    return _run_kernel(kernel, prep, n, rows * plan.n, shards=plan.n)
+
+
+def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    """End-to-end batched verify: (msg, sig, pk) triples → bool array.
+    Routes across the chip mesh when one is healthy (per-lane verdicts
+    are byte-identical to the single-device kernel — the shards compute
+    the same elementwise program on their slice of the batch)."""
+    if not items:
+        return np.zeros(0, bool)
+    n = len(items)
     prep = prepare_batch(list(items))
-
-    def pad(a, axis):
-        if m == n:
-            return a
-        width = [(0, 0)] * a.ndim
-        width[axis] = (0, m - n)
-        return np.pad(a, width)
-
-    from tpubft.ops.dispatch import device_section
-    with device_section("ed25519", batch=n):
-        dev = kernel(pad(prep.s_win, 1), pad(prep.h_win, 1),
-                     pad(prep.a_y, 1), pad(prep.a_sign, 0),
-                     pad(prep.r_y, 1), pad(prep.r_sign, 0))
-        out = np.asarray(dev)
-        if out.shape[0] < n:
-            # a garbage device result must classify as a device failure
-            # (breaker), never silently truncate into false verdicts
-            raise RuntimeError(
-                f"ed25519 kernel returned {out.shape[0]} verdicts "
-                f"for a batch of {n}")
-        return out[:n] & prep.host_valid
+    from tpubft.ops import dispatch
+    plan = dispatch.mesh_plan()
+    # mesh gate: >= 8 rows per shard before fan-out pays — below it the
+    # pow2 row floor makes the sharded launch mostly padding lanes, and
+    # the small-verify traffic of a live cluster would eat cross-chip
+    # dispatch overhead on every call (single-device path is the exact
+    # pre-mesh program, byte-identical verdicts)
+    if plan.mesh is not None and n >= 8 * plan.n:
+        return dispatch.mesh_launch(
+            "ed25519", lambda plan: _mesh_verify(plan, prep, n))
+    return _single_device_verify(prep, n)

@@ -122,21 +122,56 @@ def digest_words_to_bytes(dw: np.ndarray) -> List[bytes]:
     return [row.astype(">u4").tobytes() for row in np.asarray(dw)]
 
 
+# a digest batch rides the mesh only past this per-shard row count:
+# below it the mesh launch overhead (and the extra compiled shapes)
+# costs more than the split buys — the compression scan is cheap per
+# lane compared to the curve kernels
+_MESH_MIN_ROWS = 32
+
+
+def _mesh_plan_for(n: int):
+    """The current MeshPlan when an n-row digest batch should shard,
+    else None (single-chip host, or batch below the per-shard floor)."""
+    from tpubft.ops import dispatch
+    plan = dispatch.mesh_plan()
+    if plan.mesh is None or n < _MESH_MIN_ROWS * plan.n:
+        return None
+    return plan
+
+
+def _launch_uniform(plan, messages: Sequence[bytes], n: int) -> List[bytes]:
+    from tpubft.ops.dispatch import device_section
+    if plan is not None and plan.mesh is not None:
+        from tpubft.parallel import sharding
+        shards = plan.n
+        m = sharding.shard_rows(n, shards) * shards
+        kern = sharding.mesh_manager().cached_kernel(
+            "sha256", plan, sharding.sharded_sha256_kernel)
+    else:
+        shards, m = 1, 1 << (n - 1).bit_length()
+        kern = sha256_kernel
+    padded = list(messages) + [messages[0]] * (m - n)
+    words = prepare(padded)
+    with device_section("sha256", batch=m, shards=shards):
+        return digest_words_to_bytes(kern(jnp.asarray(words)))[:n]
+
+
 def sha256_batch(messages: Sequence[bytes]) -> List[bytes]:
     """Hash a batch of same-block-count messages on device. The batch is
     padded to the next power of two so steady-state callers (e.g. the
     Merkle ascend, whose width shrinks level by level) hit a handful of
-    compiled shapes instead of one XLA compile per distinct width."""
+    compiled shapes instead of one XLA compile per distinct width; big
+    batches shard across the chip mesh (per-lane digests identical —
+    the compression is elementwise per lane)."""
     if not messages:
         return []
     n = len(messages)
-    padded_n = 1 << (n - 1).bit_length()
-    padded = list(messages) + [messages[0]] * (padded_n - n)
-    words = prepare(padded)
-    from tpubft.ops.dispatch import device_section
-    with device_section("sha256", batch=padded_n):
-        out = digest_words_to_bytes(sha256_kernel(jnp.asarray(words)))
-    return out[:n]
+    plan = _mesh_plan_for(n)
+    if plan is not None:
+        from tpubft.ops import dispatch
+        return dispatch.mesh_launch(
+            "sha256", lambda p: _launch_uniform(p, messages, n))
+    return _launch_uniform(None, messages, n)
 
 
 @functools.partial(jax.jit, static_argnums=())
@@ -187,13 +222,29 @@ def sha256_batch_mixed(messages: Sequence[bytes]) -> List[bytes]:
     nbs = {blocks_needed(len(m)) for m in messages}
     if len(nbs) == 1:
         return sha256_batch(messages)
-    padded_n = 1 << (n - 1).bit_length()
-    padded = list(messages) + [messages[0]] * (padded_n - n)
-    words, nblocks = prepare_mixed(padded)
+    plan = _mesh_plan_for(n)
+    if plan is not None:
+        from tpubft.ops import dispatch
+        return dispatch.mesh_launch(
+            "sha256", lambda p: _launch_mixed(p, messages, n))
+    return _launch_mixed(None, messages, n)
+
+
+def _launch_mixed(plan, messages: Sequence[bytes], n: int) -> List[bytes]:
     from tpubft.ops.dispatch import device_section
-    with device_section("sha256", batch=padded_n):
-        out = digest_words_to_bytes(
-            sha256_kernel_masked(jnp.asarray(words), jnp.asarray(nblocks)))
-    return out[:n]
+    if plan is not None and plan.mesh is not None:
+        from tpubft.parallel import sharding
+        shards = plan.n
+        m = sharding.shard_rows(n, shards) * shards
+        kern = sharding.mesh_manager().cached_kernel(
+            "sha256.masked", plan, sharding.sharded_sha256_masked_kernel)
+    else:
+        shards, m = 1, 1 << (n - 1).bit_length()
+        kern = sha256_kernel_masked
+    padded = list(messages) + [messages[0]] * (m - n)
+    words, nblocks = prepare_mixed(padded)
+    with device_section("sha256", batch=m, shards=shards):
+        return digest_words_to_bytes(
+            kern(jnp.asarray(words), jnp.asarray(nblocks)))[:n]
 
 

@@ -240,6 +240,15 @@ class ChaosCampaign:
         except Exception:  # noqa: BLE001 — cleanup is best-effort
             pass
         try:
+            # the mesh plane is process-wide too: injected chip faults,
+            # eviction state, and the shard-count cap must never leak
+            # into the next scenario's crypto traffic
+            from tpubft.parallel import sharding
+            sharding.clear_chip_faults()
+            sharding.mesh_manager().reset()
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
+        try:
             # the autotuner's ECDSA crossover override is process-wide
             # (all replicas share the device): a scenario whose
             # controllers moved it must not leak tuned routing into
@@ -562,6 +571,86 @@ def scenario_autotune_stability(ctx: ScenarioContext) -> dict:
     return {"recovery_s": round(reset_s, 3),
             "tune_steps": steps, "reset_episodes": resets,
             "max_direction_flips": worst_flips}
+
+
+def scenario_mesh_chip_fault_flood(ctx: ScenarioContext) -> dict:
+    """Multi-chip crypto-plane chaos (ISSUE 16): one mesh chip dies in
+    the middle of an ed25519 verification flood. The chip's own breaker
+    (`device.chip<N>`) must evict exactly that chip and rebalance the
+    flood over the survivors — the plane stays BATCHED (the GLOBAL
+    device breaker never trips, so nothing falls back to scalar) and no
+    verdict in the flood is dropped or flipped. After the chip heals,
+    the cooldown probe re-admits it and the full-width plane verifies
+    the same flood byte-identically."""
+    import numpy as np
+    from tpubft.crypto import cpu
+    from tpubft.ops import dispatch
+    from tpubft.ops import ed25519 as ops_ed25519
+    from tpubft.parallel import sharding
+    from tpubft.utils.breaker import CLOSED
+
+    mgr = dispatch.crypto_mesh()
+    mgr.reset()
+    sharding.clear_chip_faults()
+    full = mgr.device_count()
+    if full < 2:
+        # single-chip host: there is no mesh to degrade — report the
+        # run degraded (PR 4's artifact convention) instead of going
+        # vacuously green on an unexercised plane
+        ctx.event("mesh_unavailable", devices=full)
+        return {"recovery_s": 0.0, "degraded": True,
+                "probe_error": "single-chip host: mesh plane "
+                               "unavailable (%d device)" % full}
+    # flood schedule: forged signatures every `stride` items, so every
+    # shard of every width carries both valid and forged lanes
+    stride = ctx.randint("forge_stride", 3, 9)
+    n_batches = ctx.randint("flood_batches", 3, 5)
+    n = 64
+    signer = cpu.Ed25519Signer.generate(seed=ctx.cluster_seed())
+    pk = signer.public_bytes()
+    items = []
+    for i in range(n):
+        m = b"flood-%d" % i
+        sig = signer.sign(m)
+        if i % stride == 0:
+            sig = sig[:4] + bytes([sig[4] ^ 0xFF]) + sig[5:]
+        items.append((m, sig, pk))
+    want = [i % stride != 0 for i in range(n)]
+    # healthy full-width baseline
+    assert dispatch.mesh_plan().n == full, "mesh not at full width"
+    assert np.asarray(ops_ed25519.verify_batch(items)).tolist() == want
+    victim = ctx.choice("victim",
+                        [d.id for d in dispatch.mesh_plan().devices])
+    ctx.event("chip_fault", device=victim)
+    sharding.inject_chip_fault(victim)
+    t0 = time.monotonic()
+    verdicts = [np.asarray(ops_ed25519.verify_batch(items)).tolist()
+                for _ in range(n_batches)]
+    recovery = time.monotonic() - t0
+    assert all(v == want for v in verdicts), \
+        "flood dropped/flipped verdicts across the eviction"
+    snap = mgr.snapshot()
+    assert snap["evicted"] == [victim], snap
+    assert dispatch.mesh_plan().n == full - 1, \
+        "plane did not rebalance onto the survivors"
+    assert dispatch.device_breaker().state == CLOSED, \
+        "global breaker tripped — the plane fell back to scalar"
+    # chip heals: the cooldown probe must re-admit it into the plan
+    ctx.event("heal", device=victim)
+    sharding.clear_chip_faults()
+    b = mgr.chip_breaker(victim)
+    b.configure(cooldown_s=0.05)
+    try:
+        ctx.wait_until(lambda: dispatch.mesh_plan().n == full, 10,
+                       what="healed chip re-admitted after cooldown")
+    finally:
+        b.configure(cooldown_s=2.0)
+    assert mgr.snapshot()["readmits"] >= 1
+    assert np.asarray(ops_ed25519.verify_batch(items)).tolist() == want
+    return {"recovery_s": round(recovery, 3),
+            "rebalance_ms": snap["last_rebalance_ms"],
+            "flood_batches": n_batches,
+            "shards_after_eviction": full - 1}
 
 
 def scenario_crash_restart_replay(ctx: ScenarioContext) -> dict:
@@ -973,6 +1062,11 @@ def smoke_matrix() -> List[ScenarioSpec]:
         ScenarioSpec("autotune-stability", scenario_autotune_stability,
                      "inproc", 90, tags=("autotune", "degraded",
                                          "compound")),
+        ScenarioSpec("mesh-chip-fault-flood", scenario_mesh_chip_fault_flood,
+                     # budget sized for a COLD first run: the full- and
+                     # survivor-width kernels compile inside the
+                     # scenario on a 1-core host (~90s); warm it is <5s
+                     "inproc", 240, tags=("mesh", "crypto", "recovery")),
         ScenarioSpec("crash-restart-replay", scenario_crash_restart_replay,
                      "inproc", 60, tags=("recovery",)),
         ScenarioSpec("thin-replica-failover",

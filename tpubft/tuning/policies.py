@@ -178,6 +178,40 @@ def ecdsa_crossover_policy() -> Policy:
     return policy
 
 
+def crypto_shard_policy() -> Policy:
+    """Mesh fan-out cap (ISSUE 16): follow the measured per-item cost
+    of the SHARDED verify launches. The `ed25519.shard` profile row
+    (written by device_section alongside the plain `ed25519` row on
+    every mesh launch) proves fresh sharded traffic; the full-batch
+    per-item cost then says whether the current width still amortizes —
+    falling => GROW toward more chips, rising past the same ratio =>
+    SHRINK (mesh dispatch overhead is beating the split at the current
+    batch sizes). No fresh SHARDED launches => HOLD: an idle or
+    single-chip-routed interval says nothing about the mesh. An evicted
+    chip never reaches this policy at all — any non-CLOSED breaker
+    trips the controller's degraded rule, which resets the knob to its
+    default (full width) until the plane heals."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if not fresh_slots(cur, prev):
+            return HOLD
+        if prev is None or kernel_calls(cur, "ed25519.shard") \
+                <= kernel_calls(prev, "ed25519.shard"):
+            return HOLD
+        a = kernel_per_item_us(cur, "ed25519")
+        b = kernel_per_item_us(prev, "ed25519")
+        if a is None or b is None or b <= 0.0:
+            return HOLD
+        if a <= b * FALLING_RATIO:
+            return GROW
+        if a * FALLING_RATIO >= b:
+            return SHRINK
+        return HOLD
+
+    return policy
+
+
 def durability_amortize_policy() -> Policy:
     """Group-commit window/size (ISSUE 15): widen while the measured
     fsync cost PER RUN keeps falling (grouping is still amortizing the

@@ -35,6 +35,7 @@ from tpubft.tuning.controller import TuningController
 from tpubft.tuning.knobs import Knob, KnobRegistry, load_seed
 from tpubft.tuning.policies import (admission_watermark_policy,
                                     batch_amortize_policy,
+                                    crypto_shard_policy,
                                     durability_amortize_policy,
                                     ecdsa_crossover_policy,
                                     exec_accumulation_policy)
@@ -150,6 +151,20 @@ def build_replica_tuning(replica, cfg) -> TuningController:
       1, MAX_CROSSOVER, tpu_mod.set_ecdsa_crossover,
       "ecdsa kernel per-item cost vs ecdsa_host_us/items", "sigs")
     controller.add_policy("ecdsa_crossover_b", ecdsa_crossover_policy())
+
+    # --- multi-chip mesh fan-out (ISSUE 16): cap the crypto plane's
+    # shard count from the measured sharded-launch amortization.
+    # Process-wide like the device and the crossover; default = every
+    # chip, so the degraded-rule reset (any breaker non-CLOSED,
+    # including an evicted chip's `device.chip<N>` child) restores full
+    # width for the post-recovery remeasure ---
+    from tpubft.ops import dispatch as dispatch_mod
+    n_chips = dispatch_mod.crypto_mesh().device_count()
+    if n_chips > 1:
+        K("crypto_shard_count", n_chips, 1, n_chips,
+          dispatch_mod.crypto_mesh().set_shard_count,
+          "ed25519.shard per-item cost vs full-batch trend", "chips")
+        controller.add_policy("crypto_shard_count", crypto_shard_policy())
 
     # --- catalog/pin-only knobs (no policy yet; seedable, freezable,
     # reset-on-degradation like everything else) ---

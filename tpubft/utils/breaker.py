@@ -311,6 +311,15 @@ def all_breakers() -> Dict[str, CircuitBreaker]:
         return dict(_registry)
 
 
+def prefixed(prefix: str) -> Dict[str, CircuitBreaker]:
+    """Registry slice by name prefix — how the health plane (and tests)
+    enumerate a breaker FAMILY, e.g. the per-chip mesh children
+    `device.chip<N>` (ISSUE 16) without knowing the chip inventory."""
+    with _registry_mu:
+        return {n: b for n, b in _registry.items()
+                if n.startswith(prefix)}
+
+
 def snapshot_all() -> Dict[str, Dict]:
     return {name: b.snapshot() for name, b in all_breakers().items()}
 
