@@ -152,6 +152,138 @@ def run_dispatch_ab(d: int, batch: int, platform: str = "cpu") -> dict:
             "verdicts_identical": True}
 
 
+def run_agg_ab(f: int = 10, fanout: int = 4, writes: int = 10,
+               mode: str = "tree", min_reduction: float = 4.0,
+               min_goodput_ratio: float = 0.9) -> dict:
+    """Aggregation-gossip on/off A/B through a full in-process cluster
+    (ISSUE 17): the same skvbc write flood ordered twice by n = 3f+1
+    replicas — once with every Prepare/Commit share sent direct to the
+    collector (the all-to-all baseline) and once climbing the
+    aggregation overlay. One replica is killed in both legs so the
+    optimistic fast path can never complete and every slot takes the
+    aggregated share path. Gated on the facts the mode claims:
+
+      * per-replica share-datagram reduction — the busiest replica's
+        received Prepare/Commit share count drops >= `min_reduction`x
+        (O(n) collector fan-in -> O(fanout) per overlay node);
+      * byte-identical ledgers — every live replica in BOTH legs ends
+        with the same state digest and raw block bytes (aggregation is
+        transport, never semantics);
+      * goodput — the aggregated leg sustains >= `min_goodput_ratio`
+        of baseline write throughput (asserted on real accelerator
+        rows; CPU rows report it and carry the degraded annotation).
+    """
+    import jax
+
+    from tpubft.apps import skvbc
+    from tpubft.kvbc import KeyValueBlockchain
+    from tpubft.storage.memorydb import MemoryDB
+    from tpubft.testing.cluster import InProcessCluster
+
+    def leg(agg_mode: str) -> dict:
+        def handler_factory(_r):
+            return skvbc.SkvbcHandler(
+                KeyValueBlockchain(MemoryDB(), use_device_hashing=False))
+
+        overrides = dict(threshold_scheme="multisig-bls",
+                         share_aggregation=agg_mode,
+                         # 50ms quiescence window: on a CPU host child
+                         # shares trickle in with >10ms gaps, and every
+                         # premature flush is an extra datagram up the
+                         # tree — the A/B wants ~one flush per subtree
+                         # per slot
+                         agg_fanout=fanout, agg_flush_ms=50,
+                         # sized per the OPERATIONS.md guidance: above
+                         # the full CPU-host slow-path slot latency
+                         # INCLUDING the first slot's JAX compile stall,
+                         # so the A/B measures the overlay, not fallback
+                         # churn from a timeout tuned for device hosts
+                         agg_parent_timeout_ms=10000,
+                         fast_path_timeout_ms=80,
+                         view_change_timer_ms=60000)
+        cluster = InProcessCluster(f=f, num_clients=1,
+                                   handler_factory=handler_factory,
+                                   cfg_overrides=overrides)
+        n = cluster.n
+        try:
+            cluster.start()
+            cluster.kill(n - 1)
+            live = range(n - 1)
+            cl = cluster.client(0)
+            cl._req_seq = 1_000_000
+            kv = skvbc.SkvbcClient(cl)
+            t0 = time.perf_counter()
+            for i in range(writes):
+                assert kv.write([(b"k%d" % i, b"v%d" % i)],
+                                timeout_ms=120000).success
+            elapsed = time.perf_counter() - t0
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all(
+                    cluster.handlers[r].blockchain.last_block_id == writes
+                    for r in live):
+                time.sleep(0.05)
+            digests = {cluster.handlers[r].blockchain.state_digest()
+                       for r in live}
+            assert len(digests) == 1, "live replicas diverged in-leg"
+            return {
+                "rate": writes / elapsed,
+                "rcvd": [cluster.metric(r, "counters",
+                                        "share_msgs_received")
+                         for r in live],
+                "absorbed": cluster.metric(0, "counters",
+                                           "agg_partials_absorbed"),
+                "fallbacks": sum(
+                    cluster.metric(r, "counters", "agg_fallbacks")
+                    for r in live),
+                "digest": digests.pop(),
+                "blocks": [cluster.handlers[0].blockchain.get_raw_block(i)
+                           for i in range(1, writes + 1)],
+            }
+        finally:
+            cluster.stop()
+
+    off = leg("off")
+    on = leg(mode)
+    assert on["digest"] == off["digest"] and on["blocks"] == off["blocks"], \
+        "aggregation changed ledger BYTES; it may only change transport"
+    assert on["absorbed"] > 0, "overlay never delivered a partial"
+    reduction = max(off["rcvd"]) / max(max(on["rcvd"]), 1)
+    assert reduction >= min_reduction, (
+        f"per-replica share fan-in reduction {reduction:.2f}x under the "
+        f"{min_reduction}x bar (off={max(off['rcvd'])}, "
+        f"on={max(on['rcvd'])})")
+    goodput_ratio = on["rate"] / max(off["rate"], 1e-9)
+    platform = jax.default_backend()
+    if platform != "cpu":
+        assert goodput_ratio >= min_goodput_ratio, (
+            f"aggregated goodput ratio {goodput_ratio:.3f} under "
+            f"{min_goodput_ratio}")
+    n = 3 * f + 1
+    return {"mode": "agg-ab", "agg_mode": mode, "n": n, "f": f,
+            "fanout": fanout, "writes": writes, "platform": platform,
+            "off_rate": round(off["rate"], 2),
+            "on_rate": round(on["rate"], 2),
+            "goodput_ratio": round(goodput_ratio, 3),
+            "off_max_rcvd": max(off["rcvd"]),
+            "on_max_rcvd": max(on["rcvd"]),
+            "off_collector_rcvd": off["rcvd"][0],
+            "on_collector_rcvd": on["rcvd"][0],
+            "reduction": round(reduction, 2),
+            "fallbacks": on["fallbacks"],
+            "ledgers_identical": True}
+
+
+def agg_ab_smoke(writes: int = 4) -> dict:
+    """Tier-1 shape: the smallest overlay whose interior nodes survive
+    the fast-path-disabling kill (n=7, fanout 2 — at n=4 the seeded
+    permutation seats the killed replica at the only non-root interior
+    slot and no partial can ever flow). At this size the reduction is
+    marginal by construction — the gates that matter are ledger
+    byte-identity and that the overlay actually carried partials."""
+    return run_agg_ab(f=2, fanout=2, writes=writes, mode="tree",
+                      min_reduction=1.0, min_goodput_ratio=0.0)
+
+
 def _annotate_degraded(row: dict, probe_error, stderr_tail: str) -> dict:
     """bench.py's artifact convention (PR 4): a row produced on the CPU
     backend is not comparable to a real-chip row and must say so in a
@@ -181,12 +313,31 @@ def main() -> None:
                     help="sharded-vs-single A/B through the production "
                          "dispatch plane (mesh cap 1 vs full width), "
                          "correctness-gated on byte-identical verdicts")
+    ap.add_argument("--agg-ab", action="store_true",
+                    help="share-aggregation on/off A/B through a full "
+                         "in-process cluster: per-replica share fan-in "
+                         "reduction + byte-identical ledgers (ISSUE 17)")
+    ap.add_argument("--agg-f", type=int, default=10,
+                    help="f for the --agg-ab cluster (n = 3f+1; the "
+                         "default is the 'n=32' row: f=10 -> n=31, the "
+                         "closest n=3f+1 size)")
+    ap.add_argument("--agg-fanout", type=int, default=4)
+    ap.add_argument("--agg-writes", type=int, default=10)
+    ap.add_argument("--agg-mode", default="tree",
+                    choices=("tree", "gossip"))
     ap.add_argument("--platform", default="cpu",
                     choices=("cpu", "native"),
                     help="cpu = virtual host-device mesh (1-host "
                          "validation); native = real accelerator mesh "
                          "(the actual scaling slope)")
     args = ap.parse_args()
+    if args.agg_ab:
+        if args.platform == "cpu":
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        row = run_agg_ab(f=args.agg_f, fanout=args.agg_fanout,
+                         writes=args.agg_writes, mode=args.agg_mode)
+        print(json.dumps(_annotate_degraded(row, None, "")))
+        return
     if args.one_width:
         if args.dispatch_ab:
             print(json.dumps(run_dispatch_ab(args.one_width, args.batch,

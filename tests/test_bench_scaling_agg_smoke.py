@@ -1,0 +1,31 @@
+"""Tier-1 wiring for benchmarks/bench_scaling.py --agg-ab (ISSUE 17),
+mirroring test_bench_e2e_smoke: the aggregation on/off A/B leg runs a
+minimal two-leg cluster pair in-process under TPUBFT_THREADCHECK=1, so
+every make_lock on the new share-routing path (dispatcher flush timer,
+collector-pool partial jobs, fallback re-sends) feeds the lock-order
+graph and an inversion raises here instead of deadlocking a real
+cluster. The smoke gates are the platform-independent facts: ledgers
+byte-identical between legs, the overlay actually carried partials, and
+no replica received more share datagrams than the all-to-all baseline's
+busiest node."""
+import pytest
+
+
+@pytest.fixture
+def threadcheck(monkeypatch):
+    monkeypatch.setenv("TPUBFT_THREADCHECK", "1")
+    from tpubft.utils import racecheck
+    assert racecheck.enabled()
+    yield
+
+
+def test_bench_scaling_agg_ab_smoke(threadcheck):
+    from benchmarks.bench_scaling import agg_ab_smoke
+    from tpubft.utils.racecheck import get_watchdog
+    before = get_watchdog().stall_reports
+    row = agg_ab_smoke()
+    assert row["ledgers_identical"], row
+    assert row["reduction"] >= 1.0, row
+    assert row["on_max_rcvd"] <= row["off_max_rcvd"], row
+    # the watchdog stayed quiet across both legs
+    assert get_watchdog().stall_reports == before

@@ -103,6 +103,23 @@ def test_campaign_slice_replays_identically():
     assert first["degraded"] and "breaker" in first["probe_error"]
 
 
+def test_agg_node_kill_scenario_replays_identically():
+    """ISSUE 17 acceptance: the interior-aggregator kill converges via
+    the parent-timeout fallback without a view change (asserted inside
+    the scenario), green on two runs of the same seed with
+    byte-identical event-log digests."""
+    by_name = cmp.matrix_by_name()
+    spec = by_name["agg-tree-node-kill"]
+    first = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
+    assert first["failed"] == 0, json.dumps(first["scenarios"], indent=1)
+    assert first["scenarios"][0]["stats"]["fallbacks"] > 0
+    second = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,
+                               specs=[spec]).run()
+    assert second["failed"] == 0, json.dumps(second["scenarios"],
+                                             indent=1)
+    assert first["event_log_digest"] == second["event_log_digest"]
+
+
 @pytest.mark.slow
 def test_full_smoke_matrix_green():
     art = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,

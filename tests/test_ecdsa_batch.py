@@ -104,6 +104,56 @@ def test_host_batch_multi_principal_and_sizes():
     assert scalar.ecdsa_verify_batch([], curve) == []
 
 
+def test_glv_split_identity_and_bounds():
+    """The secp256k1 lattice decomposition satisfies
+    k1 + k2*lam == k (mod n) with both halves under the walk's
+    magnitude rail, across random and edge scalars."""
+    import random
+    g = scalar._GLV_PARAMS["secp256k1"]
+    cv = scalar.CURVES["secp256k1"]
+    n, p = cv["n"], cv["p"]
+    assert pow(g["beta"], 3, p) == 1 and g["beta"] != 1
+    assert pow(g["lam"], 3, n) == 1 and g["lam"] != 1
+    # phi(G) = (beta*gx, gy) must equal [lam]G
+    lam_g = scalar._jac_to_affine(
+        scalar._jac_mul(g["lam"], (cv["gx"], cv["gy"]), cv), p)
+    assert lam_g == (g["beta"] * cv["gx"] % p, cv["gy"])
+    rng = random.Random(0xD1CE)
+    for k in [0, 1, n - 1, n // 2] + [rng.randrange(n)
+                                      for _ in range(500)]:
+        a1, n1, a2, n2 = scalar._glv_split(k, g, n)
+        k1 = -a1 if n1 else a1
+        k2 = -a2 if n2 else a2
+        assert (k1 + k2 * g["lam"] - k) % n == 0
+        assert max(a1, a2) < scalar._GLV_MAX
+
+
+@pytest.mark.parametrize("curve", ["secp256k1", "secp256r1"])
+def test_glv_on_off_verdict_equivalence(curve, monkeypatch):
+    """GLV halved walk vs full-length walk: byte-identical verdict
+    vectors on the full mixed corpus (valid, malleated, every reject
+    class), at sizes inside and outside the walk-size gate."""
+    items, expected = _corpus(curve, valid=6)
+    batch = [(pk, m, s) for m, s, pk in items]
+    # pad with extra principals so one run crosses _glv_max_walk()
+    extra = cpu.EcdsaSigner.generate(curve, seed=b"glv-x")
+    for i in range(40):
+        m = b"glv-pad-%d" % i
+        batch.append((extra.public_bytes(), m, extra.sign(m)))
+    for size in (1, 5, len(items), len(batch)):
+        sub = batch[:size]
+        monkeypatch.setenv("TPUBFT_ECDSA_GLV_MAX_B", "32")
+        monkeypatch.setenv("TPUBFT_ECDSA_GLV", "0")
+        off = scalar.ecdsa_verify_batch(sub, curve)
+        monkeypatch.setenv("TPUBFT_ECDSA_GLV", "1")
+        on = scalar.ecdsa_verify_batch(sub, curve)
+        # force the split path even past the size gate
+        monkeypatch.setenv("TPUBFT_ECDSA_GLV_MAX_B", "4096")
+        forced = scalar.ecdsa_verify_batch(sub, curve)
+        assert on == off == forced
+        assert off[:len(expected)] == expected[:size]
+
+
 def test_host_batch_hot_comb_equivalence():
     """Crossing the hot-comb threshold must not change verdicts (the
     8-bit rebuild is a pure speed upgrade)."""

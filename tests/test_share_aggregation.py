@@ -1,0 +1,328 @@
+"""Aggregation-gossip commit path (ISSUE 17).
+
+Covers the four layers of the share-aggregation plane:
+  * overlay geometry — the deterministic view-seeded tree partitions the
+    cluster, pins its root to the collector, rotates per view (and per
+    seq range in "gossip" mode), and bounds every node at `fanout`
+    children;
+  * partial-aggregate crypto — `combine_batch` fed interior partial
+    aggregates produces byte-identical certificates to the raw-share
+    feed, and a forged partial bisects to exactly the guilty subtree via
+    the contributor bitmap while every honest sibling still combines;
+  * config surface — mode/scheme/size/fanout validation rails;
+  * cluster behavior — aggregation on vs off reaches the same counter
+    state with fewer collector-side share datagrams, and a view change
+    (root death included) re-derives the overlay and keeps pending slots
+    committing.
+"""
+import time
+
+import pytest
+
+from tpubft.consensus.aggregation import overlay_for
+from tpubft.consensus.collectors import ShareCollector
+from tpubft.crypto.interfaces import Cryptosystem
+from tpubft.crypto.systems import (AGG_CERT_LEN, pack_contributors,
+                                   unpack_agg_cert, unpack_contributors)
+from tpubft.utils.config import ReplicaConfig
+
+
+def _wait(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ---------------------------------------------------------------------
+# overlay geometry
+# ---------------------------------------------------------------------
+
+def test_overlay_partition_determinism_and_fanout_bound():
+    for n, fanout in ((4, 2), (7, 2), (31, 4), (64, 16)):
+        root = 3 % n
+        ov = overlay_for("tree", n, fanout, root, view=7, seq_num=9,
+                         rotate_seqs=16)
+        # same inputs -> same shape on every replica; "tree" mode is
+        # seq-independent (one shape per view)
+        ov2 = overlay_for("tree", n, fanout, root, view=7, seq_num=9000,
+                          rotate_seqs=16)
+        assert ov.order == ov2.order
+        assert ov.root == root
+        assert sorted(ov.order) == list(range(n))
+        for r in range(n):
+            assert len(ov.children_of(r)) <= fanout
+            for ch in ov.children_of(r):
+                assert ov.parent_of(ch) == r
+        assert ov.parent_of(root) is None
+        # the root's children subtrees + the root partition the cluster
+        seen = [root]
+        for ch in ov.children_of(root):
+            seen += ov.subtree_ids(ch)
+        assert sorted(seen) == list(range(n))
+        assert sorted(ov.subtree_ids(root)) == list(range(n))
+
+
+def test_overlay_rotation_per_view_and_gossip_seq_ranges():
+    n, fanout = 31, 4
+    a = overlay_for("tree", n, fanout, 0, view=0, seq_num=1,
+                    rotate_seqs=16)
+    b = overlay_for("tree", n, fanout, 0, view=1, seq_num=1,
+                    rotate_seqs=16)
+    assert a.order != b.order           # a slow interior node rotates out
+    g_lo = overlay_for("gossip", n, fanout, 0, 0, 1, 16)
+    g_edge = overlay_for("gossip", n, fanout, 0, 0, 15, 16)
+    g_next = overlay_for("gossip", n, fanout, 0, 0, 16, 16)
+    assert g_lo.order == g_edge.order
+    assert g_lo.order != g_next.order   # re-seeded every rotate_seqs
+    # the root stays pinned to the collector through every rotation
+    assert b.root == g_next.root == 0
+    # fanout larger than the cluster degrades to a flat one-hop tree
+    flat = overlay_for("tree", 4, 16, 2, 0, 0, 16)
+    assert flat.children_of(2) == [r for r in flat.order[1:]]
+    assert flat.depth() == 1
+
+
+# ---------------------------------------------------------------------
+# partial-aggregate crypto: byte-identity and subtree bisection
+# ---------------------------------------------------------------------
+
+def _partial(v, shares, ids):
+    """Fold `ids`' entries into one 56-byte partial aggregate the way an
+    interior node does (decode -> one segmented sum -> pack)."""
+    ents = v._decode_job_entries({i: shares[i] for i in ids})
+    flat = sorted(x for ent_ids, _ in ents.values() for x in ent_ids)
+    pts = [pt for _, pt in ents.values()]
+    blob = v.aggregate_partials([(flat, pts)])[0]
+    assert len(blob) == AGG_CERT_LEN
+    return blob
+
+
+def test_partial_feed_byte_identical_to_raw_shares():
+    cs = Cryptosystem("multisig-bls", threshold=5, num_signers=7,
+                      seed=b"agg-eq")
+    v = cs.create_threshold_verifier()
+    d = b"\x21" * 32
+    shares = {i: cs.create_threshold_signer(i).sign_share(d)
+              for i in range(1, 8)}
+    raw = v.combine_batch([(d, dict(shares))])
+    # interior nodes pre-fold {1,2,3} and {4,5}; 6 and 7 arrive raw
+    feed = {1: _partial(v, shares, [1, 2, 3]),
+            4: _partial(v, shares, [4, 5]),
+            6: shares[6], 7: shares[7]}
+    part = v.combine_batch([(d, feed)])
+    assert part == raw                  # ok, cert BYTES, bad list
+    ok, cert, bad = part[0]
+    assert ok and bad == []
+    ids, _ = unpack_agg_cert(cert)
+    assert ids == list(range(1, 8))     # never truncated to threshold
+    assert v.verify(d, cert)
+
+
+def test_forged_partial_bisects_to_guilty_subtree():
+    cs = Cryptosystem("multisig-bls", threshold=4, num_signers=7,
+                      seed=b"agg-bisect")
+    v = cs.create_threshold_verifier()
+    d = b"\x42" * 32
+    shares = {i: cs.create_threshold_signer(i).sign_share(d)
+              for i in range(1, 8)}
+    # signer 5 signed the wrong digest; its poison is folded inside the
+    # {4,5} partial the way a compromised/fed-garbage subtree would be
+    shares[5] = cs.create_threshold_signer(5).sign_share(b"evil" * 8)
+    feed = {1: _partial(v, shares, [1, 2, 3]),
+            4: _partial(v, shares, [4, 5]),
+            6: shares[6], 7: shares[7]}
+    ok, _cert, bad = v.combine_batch([(d, feed)])[0]
+    assert not ok
+    assert bad == [4]                   # the guilty SUBTREE's entry key
+    # dropping the identified entry leaves an honest quorum that
+    # combines into a valid (smaller-bitmap) certificate
+    ok2, cert2, bad2 = v.combine_batch(
+        [(d, {k: s for k, s in feed.items() if k not in bad})])[0]
+    assert ok2 and bad2 == []
+    assert unpack_agg_cert(cert2)[0] == [1, 2, 3, 6, 7]
+    assert v.verify(d, cert2)
+
+
+def test_contributor_bitmap_roundtrip():
+    for ids in ([1], [1, 2, 3], [7, 64], list(range(1, 65))):
+        assert unpack_contributors(pack_contributors(ids)) == ids
+
+
+def test_collector_superseding_partial_replaces_and_retriggers():
+    """Interior flushes are cumulative: a child's later SUPERSET partial
+    arrives at the root under the same forwarder key as its earlier thin
+    one. The collector must let the heavier blob replace the lighter
+    (first-write-wins stranded those contributors until the parent
+    timeout) and the items-based last_attempt must re-arm the combine."""
+    cs = Cryptosystem("multisig-bls", threshold=4, num_signers=7,
+                      seed=b"agg-supersede")
+    v = cs.create_threshold_verifier()
+    d = b"\x5a" * 32
+    shares = {i: cs.create_threshold_signer(i).sign_share(d)
+              for i in range(1, 8)}
+    col = ShareCollector(0, 1, "prepare", d, v)
+    thin = _partial(v, shares, [2])
+    fat = _partial(v, shares, [2, 3, 4])
+    assert col.add_share(1, thin)          # forwarder replica 1 -> key 2
+    assert not col.add_share(1, thin)      # exact duplicate: rejected
+    # equal weight never replaces (deterministic first-wins tie-break)
+    assert not col.add_share(1, _partial(v, shares, [3]))
+    assert col.add_share(6, shares[7])     # unrelated raw share, key 7
+    assert not col.has_quorum()            # weights 1 + 1 < 4
+    # a failed combine pins last_attempt on the current items; the fat
+    # re-flush under the SAME key must still flip ready_for_job
+    col.last_attempt = frozenset(col.shares.items())
+    assert col.add_share(1, fat)           # weight 3 > 1: replaces
+    assert col.shares[2] == fat
+    assert col.has_quorum()                # contributors {2,3,4,7}
+    assert col.ready_for_job()
+    res = col.combine_and_verify(dict(col.shares))
+    assert res.ok and res.bad_shares == []
+    assert unpack_agg_cert(res.combined_sig)[0] == [2, 3, 4, 7]
+    assert v.verify(d, res.combined_sig)
+
+
+def test_combine_prefers_heavier_entry_on_contributor_overlap():
+    """Parent-timeout fallback races the overlay: signer 3's raw share
+    lands under key 3 while the {3,4,5} subtree partial arrives under
+    key 4. Decode must resolve the contributor overlap heaviest-first —
+    the old ascending-key order kept the weight-1 raw, dropped the
+    partial, and the sub-threshold union failed the combine with NO
+    individually-bad share to evict."""
+    cs = Cryptosystem("multisig-bls", threshold=5, num_signers=7,
+                      seed=b"agg-heaviest")
+    v = cs.create_threshold_verifier()
+    d = b"\x33" * 32
+    shares = {i: cs.create_threshold_signer(i).sign_share(d)
+              for i in range(1, 8)}
+    feed = {1: shares[1], 2: shares[2], 3: shares[3],
+            4: _partial(v, shares, [3, 4, 5])}
+    ok, cert, bad = v.combine_batch([(d, feed)])[0]
+    assert ok and bad == []
+    ids, _ = unpack_agg_cert(cert)
+    assert ids == [1, 2, 3, 4, 5]          # overlap resolved, union kept
+    assert v.verify(d, cert)
+
+
+# ---------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------
+
+def _cfg(**kw):
+    base = dict(replica_id=0, f_val=1)
+    base.update(kw)
+    c = ReplicaConfig(**base)
+    c.validate()
+    return c
+
+
+def test_aggregation_config_validation():
+    _cfg(share_aggregation="tree", threshold_scheme="multisig-bls")
+    _cfg(share_aggregation="gossip", threshold_scheme="adaptive")
+    with pytest.raises(ValueError):     # scheme without partials
+        _cfg(share_aggregation="tree", threshold_scheme="threshold-bls")
+    with pytest.raises(ValueError):     # unknown mode
+        _cfg(share_aggregation="ring", threshold_scheme="multisig-bls")
+    with pytest.raises(ValueError):     # degenerate chain overlay
+        _cfg(share_aggregation="tree", threshold_scheme="multisig-bls",
+             agg_fanout=1)
+    with pytest.raises(ValueError):     # bitmap is a u64: n must be <=64
+        _cfg(share_aggregation="tree", threshold_scheme="multisig-bls",
+             f_val=22)
+    _cfg(f_val=22)                      # ...but only when aggregation is on
+
+
+# ---------------------------------------------------------------------
+# cluster: traffic reduction, state equivalence, view-change rotation
+# ---------------------------------------------------------------------
+
+def _counter_run(mode, writes=6):
+    """f=2 (n=7) counter cluster with one replica killed so the
+    optimistic fast path can never complete and every slot takes the
+    aggregated Prepare/Commit share path."""
+    from tpubft.apps import counter
+    from tpubft.testing.cluster import InProcessCluster
+
+    cluster = InProcessCluster(f=2, num_clients=1, cfg_overrides={
+        "share_aggregation": mode,
+        "agg_fanout": 2,
+        "agg_flush_ms": 5,
+        "agg_parent_timeout_ms": 150,
+        "fast_path_timeout_ms": 50,
+    })
+    n = cluster.n
+    try:
+        cluster.start()
+        cluster.kill(n - 1)
+        cl = cluster.client(0)
+        for _ in range(writes):
+            cl.send_write(counter.encode_add(1), timeout_ms=30000)
+        assert _wait(lambda: all(cluster.handlers[r].value == writes
+                                 for r in range(n - 1)))
+        live = range(n - 1)
+        return {
+            "vals": [cluster.handlers[r].value for r in live],
+            "rcvd": [cluster.metric(r, "counters", "share_msgs_received")
+                     for r in live],
+            "fwd": [cluster.metric(r, "counters",
+                                   "agg_partials_forwarded")
+                    for r in live],
+            "absorbed": [cluster.metric(r, "counters",
+                                        "agg_partials_absorbed")
+                         for r in live],
+        }
+    finally:
+        cluster.stop()
+
+
+def test_aggregation_reduces_collector_fan_in_same_state():
+    off = _counter_run("off")
+    tree = _counter_run("tree")
+    assert off["vals"] == tree["vals"]
+    # the metric is the PER-REPLICA hotspot, not the cluster total
+    # (interior hops add messages, but no single node carries O(n)):
+    # replica 0 is view 0's collector for every slot and sheds most of
+    # its fan-in to the interior nodes, and the busiest aggregated
+    # replica stays under the all-to-all collector's load
+    assert tree["rcvd"][0] < off["rcvd"][0] * 0.75
+    assert max(tree["rcvd"]) < max(off["rcvd"])
+    # interior nodes actually forwarded partials and the root absorbed
+    assert sum(tree["fwd"]) > 0
+    assert tree["absorbed"][0] > 0
+    assert sum(off["fwd"]) == 0 and sum(off["absorbed"]) == 0
+
+
+def test_view_change_rotates_overlay_and_keeps_committing():
+    """Killing the primary kills the overlay ROOT. The view change must
+    re-derive both the collector and the overlay for the new view and
+    commit writes issued before and after — pending slots never wedge
+    on the dead root."""
+    from tpubft.apps import counter
+    from tpubft.testing.cluster import InProcessCluster
+
+    cluster = InProcessCluster(f=2, num_clients=1, cfg_overrides={
+        "share_aggregation": "gossip",
+        "agg_fanout": 2,
+        "agg_flush_ms": 5,
+        "agg_parent_timeout_ms": 150,
+        "fast_path_timeout_ms": 50,
+        "view_change_timer_ms": 900,
+    })
+    n = cluster.n
+    try:
+        cluster.start()
+        cl = cluster.client(0)
+        for _ in range(3):
+            cl.send_write(counter.encode_add(1), timeout_ms=30000)
+        cluster.kill(0)                 # primary = collector = root
+        for _ in range(3):
+            cl.send_write(counter.encode_add(1), timeout_ms=60000)
+        assert _wait(lambda: all(cluster.handlers[r].value == 6
+                                 for r in range(1, n)))
+        views = {cluster.replicas[r].view for r in range(1, n)}
+        assert min(views) >= 1          # the cluster actually moved on
+    finally:
+        cluster.stop()

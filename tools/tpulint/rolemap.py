@@ -136,7 +136,8 @@ API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
      "push_external_obj"): frozenset({"transport", "admission"}),
     ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
      "push_internal"): frozenset({"transport", "exec_lane",
-                                  "dispatcher", "preexec"}),
+                                  "dispatcher", "preexec",
+                                  "sig_combine"}),
     ("tpubft/consensus/incoming.py", "IncomingMsgsStorage",
      "push_internal_once"): frozenset({"exec_lane", "durability"}),
     # the pipeline's post-fsync completion hop into the lane's
@@ -169,6 +170,15 @@ API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
     # handler threads; published by the dispatcher (_store_checkpoint)
     ("tpubft/consensus/replica.py", "Replica", "thin_replica_anchor"):
         frozenset({"thinreplica_srv"}),
+    # share-aggregation interior flush (ISSUE 17): the dispatcher's
+    # _agg_flush_tick snapshots due buffers and hands this job to
+    # CollectorPool.submit as a lambda (callable crossing the pool's
+    # executor — invisible to the syntactic call graph, like the
+    # _bg_verify_cert hop): it decodes + sums the subtree's shares on a
+    # sig-combine worker (one msm_batch launch per flush) and re-enters
+    # the dispatcher through push_internal("agg_partial")
+    ("tpubft/consensus/replica.py", "Replica", "_agg_combine_job"):
+        frozenset({"sig_combine"}),
     # mesh-rebuild path (ISSUE 16): the crypto-mesh manager's plan /
     # eviction state is mutated from every kernel-calling thread (any
     # verify seam can hit on_launch_failure and rebuild the plan) and

@@ -103,7 +103,8 @@ _VIEW_SEQ_CODES = frozenset(int(c) for c in (
     m.MsgCode.StartSlowCommit,
     m.MsgCode.PreparePartial, m.MsgCode.PrepareFull,
     m.MsgCode.CommitPartial, m.MsgCode.CommitFull,
-    m.MsgCode.PartialCommitProof, m.MsgCode.FullCommitProof))
+    m.MsgCode.PartialCommitProof, m.MsgCode.FullCommitProof,
+    m.MsgCode.AggregateShare))
 _VIEW_SEQ = struct.Struct("<QQ")        # at offset 6
 # Checkpoint: | u64 seq @6 |
 _CKPT_CODE = int(m.MsgCode.Checkpoint)

@@ -69,15 +69,36 @@ class ShareCollector:
         self.last_attempt: Optional[frozenset] = None
 
     def add_share(self, signer_id: int, share: bytes) -> bool:
-        """Store a share (0-based replica id). Returns True if new."""
+        """Store a share (0-based replica id). Returns True if new.
+
+        Under share aggregation the root feeds subtree PARTIALS through
+        this same path, keyed by the forwarding child (the entry is
+        self-describing — crypto/systems.AGG_CERT_LEN blobs carry their
+        contributor bitmap), so the whole verdict machinery downstream
+        (snapshot, fused combine, bad-share pop) is unchanged. A
+        strictly HEAVIER blob under an existing key replaces it: interior
+        flushes are cumulative, so a child's later superset partial must
+        supersede its earlier thin one or those contributors are lost
+        until the parent-timeout fallback."""
         sid = signer_id + 1                    # threshold signers are 1-based
-        if sid in self.shares or self.combined is not None:
+        if self.combined is not None:
+            return False
+        cur = self.shares.get(sid)
+        if cur is not None and (cur == share or
+                                self.verifier.share_weight(share)
+                                <= self.verifier.share_weight(cur)):
             return False
         self.shares[sid] = share
         return True
 
     def has_quorum(self) -> bool:
-        return len(self.shares) >= self.verifier.threshold
+        # every entry weighs >= 1, so the cheap len check short-circuits
+        # the common all-raw case; with partial aggregates in the dict
+        # quorum counts CONTRIBUTORS (bitmap popcount), not datagrams
+        if len(self.shares) >= self.verifier.threshold:
+            return True
+        return sum(self.verifier.share_weight(s)
+                   for s in self.shares.values()) >= self.verifier.threshold
 
     def ready_for_job(self) -> bool:
         """Quorum reached, no job in flight, not combined yet, and the
@@ -85,7 +106,9 @@ class ShareCollector:
         inputs would fail identically."""
         return (self.has_quorum() and not self.job_launched
                 and self.combined is None
-                and frozenset(self.shares) != self.last_attempt)
+                # items, not keys: a superseded partial under an
+                # unchanged key must still retrigger the combine
+                and frozenset(self.shares.items()) != self.last_attempt)
 
     def on_result(self, res: CombineResult) -> None:
         """Dispatcher-side verdict application: the ONLY place collector
@@ -310,7 +333,7 @@ class CollectorPool:
             return False
         collector.job_launched = True
         snapshot = dict(collector.shares)
-        collector.last_attempt = frozenset(snapshot)
+        collector.last_attempt = frozenset(snapshot.items())
         if self._combiner is not None:
             self._combiner.submit(collector, snapshot)
         else:

@@ -71,7 +71,8 @@ class ClusterKeys:
         from tpubft.crypto.systems import resolve_threshold_scheme
         scheme = resolve_threshold_scheme(
             cfg.threshold_scheme, n,
-            getattr(cfg, "threshold_scheme_crossover_n", 0))
+            getattr(cfg, "threshold_scheme_crossover_n", 0),
+            aggregation=getattr(cfg, "share_aggregation", "off"))
         ck = cls(n=n, f=f, c=c, threshold_scheme=scheme,
                  replica_sig_scheme=cfg.replica_sig_scheme,
                  client_sig_scheme=cfg.client_sig_scheme)

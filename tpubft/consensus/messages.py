@@ -56,6 +56,7 @@ class MsgCode(enum.IntEnum):
     PreProcessBatchReply = 26
     AskForCheckpoint = 27
     TimeOpinion = 28
+    AggregateShare = 29
 
 
 class RequestFlag(enum.IntFlag):
@@ -362,6 +363,40 @@ class FullCommitProofMsg(_SignedShareBase):
     """Fast-path combined proof (reference FullCommitProofMsg.hpp) —
     possession is a commit certificate."""
     CODE = MsgCode.FullCommitProof
+
+
+@register
+@dataclass
+class AggregateShareMsg(ConsensusMsg):
+    """A PARTIAL AGGREGATE climbing the share-aggregation overlay
+    (ISSUE 17, arXiv 1911.04698): an interior node's sum of its
+    subtree's Prepare/Commit shares, self-authenticating via the
+    contributor bitmap inside `agg` (crypto/systems.pack_agg_cert —
+    the root verifies it against the bitmap's aggregate public key, so
+    a forged partial indicts exactly the forwarding subtree). `kind`
+    is the share family ("prepare"=0 / "commit"=1); fast-path shares
+    never aggregate (they are already one datagram to the collector).
+    NOT relay-safe: the transport sender is the accountable forwarder
+    for retransmission/ack and bad-subtree isolation."""
+    CODE = MsgCode.AggregateShare
+    sender_id: int
+    view: int
+    seq_num: int
+    kind: int                     # 0 = prepare share family, 1 = commit
+    digest: bytes                 # share_digest(kind, epoch, view, seq, ppD)
+    agg: bytes                    # pack_agg_cert: u64 bitmap + 48B G1 sum
+    epoch: int = 0
+    SPEC = [("sender_id", "u32"), ("view", "u64"), ("seq_num", "u64"),
+            ("kind", "u8"), ("digest", "bytes"), ("agg", "bytes"),
+            ("epoch", "u64")]
+
+    def validate(self) -> None:
+        if self.kind not in (0, 1):
+            raise MsgError("bad aggregate share kind")
+        if len(self.digest) != 32:
+            raise MsgError("bad digest length")
+        if len(self.agg) != 56:
+            raise MsgError("bad partial aggregate length")
 
 
 # ---------------- checkpointing ----------------

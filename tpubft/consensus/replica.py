@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tpubft.comm.interfaces import ICommunication, IReceiver
 from tpubft.consensus import messages as m
+from tpubft.consensus.aggregation import overlay_for
 from tpubft.consensus.clients_manager import ClientsManager
 from tpubft.consensus.collectors import (CollectorPool, CombineResult,
                                          ShareCollector)
@@ -201,6 +202,38 @@ class Replica(IReceiver):
                                                     backend, min_dev)
         self.controller = CommitPathController(cfg.f_val, cfg.c_val)
 
+        # --- share-aggregation overlay (consensus/aggregation.py) ---
+        # Active only when the RESOLVED threshold scheme supports partial
+        # aggregation (multisig-bls: unweighted G1 sums compose; Shamir
+        # shares cannot — interfaces.IThresholdAccumulator.add_partial).
+        # A pinned incompatible scheme degrades to "off" rather than
+        # refusing to start; config.validate rejects the loud cases.
+        self._agg_mode = (cfg.share_aggregation
+                          if getattr(keys, "threshold_scheme", "")
+                          == "multisig-bls" else "off")
+        self._agg_fanout = max(2, cfg.agg_fanout)
+        # interior-node banking: (view, seq, kind, digest) -> {entry_key:
+        # raw 48B share | 56B partial}, entry keys 1-based (signer id for
+        # raw, forwarding child + 1 for partials) — the same keying the
+        # root's ShareCollector uses, so bad-entry isolation composes
+        self._agg_buffers: Dict[tuple, Dict[int, bytes]] = {}
+        self._agg_buffer_born: Dict[tuple, float] = {}
+        # membership snapshot of the last flush per buffer: flushes are
+        # CUMULATIVE — a buffer re-flushes (as a superset partial that
+        # supersedes the previous one upstream) whenever new members
+        # arrived, so an early age-based flush never strands the
+        # children that were still in flight
+        self._agg_flushed: Dict[tuple, frozenset] = {}
+        # leaf/interior liveness floor: (view, seq, kind) -> (deadline,
+        # share msg, collector id); on parent timeout the original share
+        # re-sends DIRECT to the collector — aggregation can delay a
+        # slot by at most agg_parent_timeout_ms, never lose it
+        self._agg_fallback: Dict[tuple, tuple] = {}
+        # parent id -> view in which its edge proved dead: shares route
+        # around a sick parent for the rest of that view (the overlay
+        # reshuffles at the view change, which implicitly pardons it)
+        self._agg_sick: Dict[int, int] = {}
+
         # --- protocol state (dispatcher-thread only) ---
         st, window_msgs = restore_replica_state(self.storage)
         self.view = st.last_view
@@ -283,6 +316,16 @@ class Replica(IReceiver):
         self.dispatcher.register_internal("pp_verified", self._on_pp_verified)
         self.dispatcher.register_internal("cert_verified",
                                           self._on_cert_verified)
+        if self._agg_mode != "off":
+            # interior-node partials re-enter from the collector pool
+            # (the sum job) exactly like combine verdicts do
+            self.dispatcher.register_internal("agg_partial",
+                                              self._on_agg_partials)
+            self.dispatcher.add_timer(max(cfg.agg_flush_ms, 5) / 1000.0,
+                                      self._agg_flush_tick)
+            self.dispatcher.add_timer(
+                cfg.agg_parent_timeout_ms / 1000.0 / 2,
+                self._agg_fallback_tick)
         self.dispatcher.add_timer(cfg.batch_flush_period_ms / 1000.0,
                                   self._try_send_pre_prepare)
         self.dispatcher.add_timer(cfg.fast_path_timeout_ms / 1000.0 / 4,
@@ -426,6 +469,20 @@ class Replica(IReceiver):
             "combine_batches")
         self.m_combined_slots = self.metrics.register_counter(
             "combined_slots")
+        # aggregation overlay: Prepare/Commit share datagrams RECEIVED
+        # from peers (raw shares + climbing partials; fast-path shares
+        # excluded — they never aggregate), the fan-in bench_scaling
+        # --agg-ab reads at the hottest replica; partials forwarded up the
+        # tree, partials absorbed at the root, and parent-timeout
+        # fallbacks (each one is a direct re-send, the liveness floor)
+        self.m_share_msgs_rcvd = self.metrics.register_counter(
+            "share_msgs_received")
+        self.m_agg_forwarded = self.metrics.register_counter(
+            "agg_partials_forwarded")
+        self.m_agg_absorbed = self.metrics.register_counter(
+            "agg_partials_absorbed")
+        self.m_agg_fallbacks = self.metrics.register_counter(
+            "agg_fallbacks")
         # external-queue backpressure drops (IncomingMsgsStorage bound),
         # refreshed by the status timer — paired with the admission
         # component's counters for the full ingest picture
@@ -1153,6 +1210,8 @@ class Replica(IReceiver):
             self._on_commit_full(msg)
         elif isinstance(msg, m.PartialCommitProofMsg):
             self._on_share(msg, "fast")
+        elif isinstance(msg, m.AggregateShareMsg):
+            self._on_agg_share(msg)
         elif isinstance(msg, m.FullCommitProofMsg):
             self._on_full_commit_proof(msg)
         elif isinstance(msg, m.StartSlowCommitMsg):
@@ -1568,11 +1627,7 @@ class Replica(IReceiver):
         msg = m.PreparePartialMsg(sender_id=self.id, view=self.view,
                                   seq_num=pp.seq_num, digest=d, sig=share,
                                   epoch=self.epoch)
-        collector_id = self.info.collector_for(self.view, pp.seq_num)
-        if collector_id == self.id:
-            self._on_share(msg, "prepare")
-        else:
-            self._send_tracked(collector_id, msg)
+        self._route_share(msg, "prepare")
 
     def _send_commit_partial(self, info: SeqNumInfo) -> None:
         pp = info.pre_prepare
@@ -1581,11 +1636,286 @@ class Replica(IReceiver):
         msg = m.CommitPartialMsg(sender_id=self.id, view=self.view,
                                  seq_num=pp.seq_num, digest=d, sig=share,
                                  epoch=self.epoch)
-        collector_id = self.info.collector_for(self.view, pp.seq_num)
+        self._route_share(msg, "commit")
+
+    # ------------------------------------------------------------------
+    # share-aggregation overlay (consensus/aggregation.py): slow-path
+    # shares climb a view-seeded tree rooted at the collector, each hop
+    # folding its subtree into ONE 56-byte partial — the collector's
+    # fan-in drops from O(n) datagrams per slot to O(fanout) at every
+    # node (arXiv 1911.04698 rebuilt on the collector-centric flow)
+    # ------------------------------------------------------------------
+    def _overlay(self, view: int, seq_num: int, root: int):
+        return overlay_for(self._agg_mode, self.cfg.n_val, self._agg_fanout,
+                           root, view, seq_num, self.cfg.agg_rotate_seqs)
+
+    def _route_share(self, msg, kind: str) -> None:
+        """Send a slow-path share toward its collector: direct when
+        aggregation is off (byte-identical to the historical path), via
+        the overlay when on — banked locally if this node is interior,
+        else to the overlay parent. Every non-direct route arms the
+        parent-timeout fallback."""
+        collector_id = self.info.collector_for(self.view, msg.seq_num)
         if collector_id == self.id:
-            self._on_share(msg, "commit")
-        else:
-            self._send_tracked(collector_id, msg)
+            self._on_share(msg, kind)
+            return
+        if self._agg_mode != "off":
+            ov = self._overlay(self.view, msg.seq_num, collector_id)
+            if ov.is_interior(self.id):
+                # our own share joins our subtree's next flush
+                self._agg_absorb(self.id, self.view, msg.seq_num, kind,
+                                 msg.digest, msg.sig)
+                up = ov.parent_of(self.id)
+                self._agg_arm_fallback(msg, kind, collector_id,
+                                       -1 if up is None else up)
+                return
+            parent = ov.parent_of(self.id)
+            if parent is not None and parent != collector_id:
+                if not self._agg_parent_sick(parent):
+                    self._send_tracked(parent, msg)
+                    self._agg_arm_fallback(msg, kind, collector_id, parent)
+                    return
+                # sick parent: fall through to the direct send — one
+                # timeout already proved this edge dead, later slots
+                # must not re-pay it
+            # depth-1 leaf: the overlay edge IS the direct send
+        self._send_tracked(collector_id, msg)
+
+    def _agg_parent_sick(self, parent: int) -> bool:
+        """A parent that ate a share until the fallback timeout is
+        routed AROUND (direct to the collector) for the rest of the
+        view: the overlay reshuffles at the next view change (and per
+        rotation window in gossip mode), so sickness is view-scoped —
+        without this memory every slot behind a dead interior node
+        pays the full parent timeout again."""
+        entry = self._agg_sick.get(parent)
+        return entry is not None and entry == self.view
+
+    def _agg_arm_fallback(self, msg, kind: str, collector_id: int,
+                          parent: int = -1) -> None:
+        self._agg_fallback[(self.view, msg.seq_num, kind)] = (
+            time.monotonic() + self.cfg.agg_parent_timeout_ms / 1e3,
+            msg, collector_id, parent)
+
+    def _agg_absorb(self, sender: int, view: int, seq_num: int, kind: str,
+                    digest: bytes, blob: bytes) -> None:
+        """Interior node: bank a child's raw share or subtree partial
+        for the next flush (dispatcher thread; no crypto here — decode
+        and summation happen on the collector-pool worker). The digest
+        is part of the buffer key, so shares over a wrong digest
+        self-segregate instead of poisoning the honest buffer."""
+        key = (view, seq_num, kind, digest)
+        buf = self._agg_buffers.get(key)
+        if buf is None:
+            buf = self._agg_buffers[key] = {}
+        cur = buf.get(sender + 1)
+        if cur is None or self._agg_weight(blob) > self._agg_weight(cur):
+            # a child's cumulative re-flush supersedes its earlier,
+            # thinner partial (raw shares always weigh 1, so they never
+            # displace anything)
+            buf[sender + 1] = blob
+            # quiescence debounce: every growth re-arms the age clock,
+            # so the age-based flush fires only once the trickle of
+            # child arrivals PAUSES (a full subtree still flushes
+            # immediately via the weight test) — without this, a slow
+            # host flushes one thin partial per arrival window and the
+            # overlay's fan-in win evaporates
+            self._agg_buffer_born[key] = time.monotonic()
+
+    def _agg_weight(self, blob: bytes) -> int:
+        """Contributor count of a banked entry, dispatcher-cheap: the
+        bitmap prefix for partials, 1 for raw shares."""
+        from tpubft.crypto.systems import AGG_CERT_LEN
+        if len(blob) == AGG_CERT_LEN:
+            (bm,) = struct.unpack_from("<Q", blob, 0)
+            return max(bin(bm).count("1"), 1)
+        return 1
+
+    def _agg_flush_tick(self) -> None:
+        """Dispatcher timer: flush buffers whose subtree is complete or
+        that have been QUIESCENT for agg_flush_ms (the age clock re-arms
+        on every arrival, see _agg_absorb). One collector-pool job per tick
+        sums EVERY due buffer in one device launch
+        (BlsMultisigVerifier.aggregate_partials → msm_batch).
+
+        Flushes are cumulative: the buffer is kept (not popped) and
+        re-flushes when membership grew, so a child share that arrives
+        AFTER the age-based flush still climbs the overlay — as a
+        superset partial that supersedes the earlier one at the parent
+        (weight-based replacement) instead of being silently lost to
+        the first-flush-wins entry key."""
+        if not self._agg_buffers:
+            return
+        now = time.monotonic()
+        age_s = self.cfg.agg_flush_ms / 1e3
+        due = []
+        for key in list(self._agg_buffers):
+            view, seq_num, kind, _digest = key
+            if view != self.view or self.in_view_change \
+                    or seq_num <= self.last_stable \
+                    or not self.window.in_window(seq_num):
+                del self._agg_buffers[key]
+                self._agg_buffer_born.pop(key, None)
+                self._agg_flushed.pop(key, None)
+                continue
+            members = frozenset(self._agg_buffers[key])
+            if members == self._agg_flushed.get(key):
+                continue                  # nothing new since last flush
+            collector_id = self.info.collector_for(view, seq_num)
+            ov = self._overlay(view, seq_num, collector_id)
+            expected = len(ov.subtree_ids(self.id))
+            weight = sum(self._agg_weight(b)
+                         for b in self._agg_buffers[key].values())
+            if weight >= expected \
+                    or now - self._agg_buffer_born[key] >= age_s:
+                due.append(key)
+                self._agg_flushed[key] = members
+                # re-arm the age window so late stragglers batch up
+                # instead of one flush per arrival
+                self._agg_buffer_born[key] = now
+        if not due:
+            return
+        snapshot = [(key, dict(self._agg_buffers[key])) for key in due]
+        self.collector_pool.submit(lambda: self._agg_combine_job(snapshot))
+
+    def _agg_combine_job(self, snapshot) -> None:
+        """Collector-pool worker: decode banked entries (accumulator
+        `add` semantics — malformed/overlapping entries dropped
+        deterministically) and fold each buffer into one packed partial;
+        all sums ride ONE segmented multi-MSM launch. Results re-enter
+        the dispatcher as "agg_partial"."""
+        try:
+            jobs, keys = [], []
+            for key, entries in snapshot:
+                decoded = self.slow_verifier._decode_job_entries(entries)
+                ids: List[int] = []
+                pts = []
+                for k in sorted(decoded):
+                    eids, pt = decoded[k]
+                    ids.extend(eids)
+                    pts.append(pt)
+                if pts:
+                    jobs.append((sorted(ids), pts))
+                    keys.append(key)
+            if not jobs:
+                return
+            partials = self.slow_verifier.aggregate_partials(jobs)
+            self.incoming.push_internal("agg_partial",
+                                        list(zip(keys, partials)))
+        except Exception:  # noqa: BLE001 — fallback covers a lost flush
+            log.exception("agg combine job failed")
+
+    def _on_agg_partials(self, payload) -> None:
+        """Flushed partials (dispatcher thread): pack each into an
+        AggregateShareMsg and send it one hop up the overlay."""
+        for (view, seq_num, kind, digest), partial in payload:
+            if view != self.view or self.in_view_change \
+                    or seq_num <= self.last_stable:
+                continue
+            collector_id = self.info.collector_for(view, seq_num)
+            if collector_id == self.id:
+                continue                    # we became collector mid-flush
+            ov = self._overlay(view, seq_num, collector_id)
+            parent = ov.parent_of(self.id)
+            if parent is None:
+                continue
+            if parent != collector_id and self._agg_parent_sick(parent):
+                parent = collector_id    # route the partial AROUND the
+                #                          dead hop; the root absorbs it
+            flight.record(flight.EV_AGG_FORWARD, seq=seq_num, view=view,
+                          arg=self._agg_weight(partial))
+            self.m_agg_forwarded.inc()
+            self._send_tracked(parent, m.AggregateShareMsg(
+                sender_id=self.id, view=view, seq_num=seq_num,
+                kind=0 if kind == "prepare" else 1,
+                digest=digest, agg=partial, epoch=self.epoch))
+
+    def _on_agg_share(self, msg: m.AggregateShareMsg) -> None:
+        """A partial aggregate climbing the overlay: banked again if this
+        node is an interior hop, fed into the slot's ShareCollector at
+        the root — keyed by the forwarding child, so a forged partial
+        bisects to exactly that child's subtree (contributor bitmap) and
+        the bad-share pop in _on_combine_result drops the whole subtree
+        in one move."""
+        if self._agg_mode == "off":
+            return
+        if msg.view != self.view or not self.info.is_replica(msg.sender_id):
+            return
+        if self.in_view_change:
+            return
+        if not self.window.in_window(msg.seq_num) \
+                or msg.seq_num <= self.last_stable:
+            return
+        self.m_share_msgs_rcvd.inc()
+        self._ack(msg.sender_id, int(msg.CODE), msg.seq_num)
+        kind = "prepare" if msg.kind == 0 else "commit"
+        if self.info.collector_for(self.view, msg.seq_num) != self.id:
+            self._agg_absorb(msg.sender_id, msg.view, msg.seq_num, kind,
+                             msg.digest, msg.agg)
+            return
+        info = self.window.get(msg.seq_num)
+        if info.pre_prepare is None:
+            # PP not accepted yet: park beside early raw shares, drained
+            # through _drain_early_shares under the "agg" pseudo-kind
+            info.early_shares.setdefault("agg", []).append(msg)
+            if not info.first_evidence_at:
+                info.first_evidence_at = time.monotonic()
+            return
+        collector = self._collector(info, kind)
+        if collector is None or msg.digest != collector.digest:
+            return
+        flight.record(flight.EV_AGG_ROOT, seq=msg.seq_num, view=msg.view,
+                      arg=self._agg_weight(msg.agg))
+        self.m_agg_absorbed.inc()
+        if collector.add_share(msg.sender_id, msg.agg):
+            self.collector_pool.maybe_launch(collector)
+
+    def _agg_fallback_tick(self) -> None:
+        """Dispatcher timer: any share still waiting on the overlay past
+        its parent timeout re-sends DIRECT to the collector, and the
+        parent that ate it is marked sick for the rest of the view
+        (_agg_parent_sick) so later slots route around it immediately.
+        The liveness floor is exactly the no-aggregation path — a dead
+        or byzantine interior node costs ONE timeout per view, never a
+        view change."""
+        if not self._agg_fallback:
+            return
+        now = time.monotonic()
+        for key in list(self._agg_fallback):
+            view, seq_num, kind = key
+            deadline, msg, collector_id, parent = self._agg_fallback[key]
+            info = (self.window.peek(seq_num)
+                    if self.window.in_window(seq_num) else None)
+            done = (view != self.view or self.in_view_change
+                    or seq_num <= self.last_stable
+                    or (info is not None
+                        and (info.committed
+                             or (kind == "prepare" and info.prepared))))
+            if done:
+                del self._agg_fallback[key]
+                continue
+            if now < deadline:
+                continue
+            del self._agg_fallback[key]
+            if parent >= 0 and view == self.view \
+                    and self.retrans is not None \
+                    and (self.retrans.is_pending(parent, int(msg.CODE),
+                                                 msg.seq_num)
+                         or self.retrans.is_pending(
+                             parent, int(m.AggregateShareMsg.CODE),
+                             msg.seq_num)):
+                # unacked after the whole parent window: the EDGE is
+                # dead, not just the slot slow — route around it for
+                # the rest of the view (leaves track their raw share,
+                # interior hops their forwarded partial)
+                self._agg_sick[parent] = view
+            flight.record(flight.EV_AGG_FALLBACK, seq=seq_num, view=view,
+                          arg=0 if kind == "prepare" else 1)
+            self.m_agg_fallbacks.inc()
+            if collector_id == self.id:
+                self._on_share(msg, kind)
+            else:
+                self._send_tracked(collector_id, msg)
 
     def _fast_tools(self, path: int):
         """(signer, verifier, domain-tag) for a fast commit path."""
@@ -1625,9 +1955,23 @@ class Replica(IReceiver):
         if not self.window.in_window(msg.seq_num) \
                 or msg.seq_num <= self.last_stable:
             return
+        if kind != "fast" and msg.sender_id != self.id:
+            # Prepare/Commit share fan-in only (the aggregation overlay's
+            # target metric) — fast-path shares are always one direct
+            # datagram to the collector and never aggregate
+            self.m_share_msgs_rcvd.inc()
         # receipt ack (duplicates too — the sender may have missed the
         # first ack; retransmission keys on receipt, not on usefulness)
         self._ack(msg.sender_id, int(msg.CODE), msg.seq_num)
+        if self._agg_mode != "off" and kind != "fast" \
+                and msg.sender_id != self.id \
+                and self.info.collector_for(self.view, msg.seq_num) != self.id:
+            # interior overlay hop: bank the child's raw share for the
+            # next flush (no PrePrepare needed — the digest keys the
+            # buffer, and only the root resolves digests to collectors)
+            self._agg_absorb(msg.sender_id, msg.view, msg.seq_num, kind,
+                             msg.digest, msg.sig)
+            return
         info = self.window.get(msg.seq_num)
         if info.pre_prepare is None:
             info.early_shares.setdefault(kind, []).append(msg)
@@ -1662,7 +2006,10 @@ class Replica(IReceiver):
         for kind, msgs in list(info.early_shares.items()):
             info.early_shares[kind] = []
             for msg in msgs:
-                self._on_share(msg, kind)
+                if kind == "agg":
+                    self._on_agg_share(msg)
+                else:
+                    self._on_share(msg, kind)
 
     # ------------------------------------------------------------------
     # combine results (internal msg; reference onInternalMsg :1517)

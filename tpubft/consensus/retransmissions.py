@@ -135,3 +135,11 @@ class RetransmissionsManager:
     def pending(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def is_pending(self, dest: int, code: int, seq: int) -> bool:
+        """True while a tracked send has not been acked — the aggregation
+        fallback uses this as dead-parent evidence: a parent that acked
+        the share is alive (the slot is just slow) and must not be
+        routed around."""
+        with self._lock:
+            return (dest, code, seq) in self._entries

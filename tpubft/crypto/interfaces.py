@@ -81,6 +81,19 @@ class IThresholdAccumulator(abc.ABC):
         (reference: re-accumulation with share verification,
         CollectorOfThresholdSignatures.hpp:363-401)."""
 
+    def add_partial(self, partial: bytes) -> int:
+        """Absorb a PARTIAL AGGREGATE produced by an interior node of the
+        share-aggregation overlay: a self-describing blob carrying the
+        contributor bitmap plus the aggregated share, so the root can
+        fold whole subtrees in at once while keeping per-contributor
+        accounting (a forged partial bisects to the guilty subtree via
+        its bitmap). Only schemes whose shares sum meaningfully without
+        per-signer weighting support this — Shamir threshold shares do
+        NOT (Lagrange coefficients depend on the final contributor set),
+        which is why aggregation mode requires a multisig scheme."""
+        raise NotImplementedError(
+            "scheme does not support partial aggregation")
+
 
 class IThresholdVerifier(abc.ABC):
     @abc.abstractmethod
@@ -120,6 +133,19 @@ class IThresholdVerifier(abc.ABC):
             else:
                 out.append((False, b"", acc.identify_bad_shares()))
         return out
+
+    @property
+    def supports_partial_aggregation(self) -> bool:
+        """True when this scheme's accumulators implement `add_partial`
+        (the share-aggregation overlay requires it)."""
+        return False
+
+    def share_weight(self, share: bytes) -> int:
+        """How many contributors one entry in a share dict represents.
+        1 for a raw share; partial-aggregation schemes override this to
+        return the contributor-bitmap popcount so quorum accounting
+        counts signers, not datagrams."""
+        return 1
 
     @property
     @abc.abstractmethod

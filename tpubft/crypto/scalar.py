@@ -458,6 +458,64 @@ _HOT_COMB_MAX = max(1, int(os.environ.get(
     "TPUBFT_ECDSA_HOT_COMBS", "24")))
 
 
+# ---- GLV endomorphism split (secp256k1) ------------------------------
+# phi(x, y) = (beta*x, y) equals [lam]P on secp256k1 (beta^3 = 1 mod p,
+# lam^3 = 1 mod n), so any scalar k splits as k = k1 + k2*lam (mod n)
+# with |k1|, |k2| ~ sqrt(n) via the standard lattice basis
+# (a1, b1), (a2, b2) — libsecp256k1's constants. The batched verify
+# walks BOTH half-scalars over the same ~17 comb columns (width 8)
+# instead of 32, sharing one batch inversion per column; see
+# _ecdsa_verify_batch. secp256r1 has no such endomorphism and keeps the
+# full-length walk.
+_GLV_PARAMS = {
+    "secp256k1": dict(
+        beta=0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE,
+        lam=0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72,
+        a1=0x3086D221A7D46BCDE86C90E49284EB15,
+        b1=-0xE4437ED6010E88286F547FA90ABFE4C3,
+        a2=0x114CA50F7A8E2F3F657C1108D9D44CFD8,
+        b2=0x3086D221A7D46BCDE86C90E49284EB15,
+    ),
+}
+# decomposition magnitude rail: reduced scalars always split below
+# ~2^128.5; the walk guards at 2^132 and routes a (mathematically
+# unreachable) violator through the plain per-item verify instead
+_GLV_MAX = 1 << 132
+
+
+def _glv_enabled() -> bool:
+    """Read per call (not at import) so the equivalence tests can pin
+    GLV on vs off inside one process; the comb tables serve both paths
+    unchanged (full 256-bit rows, the GLV walk just stops early)."""
+    return os.environ.get("TPUBFT_ECDSA_GLV", "1") != "0"
+
+
+def _glv_max_walk() -> int:
+    """GLV pays while the per-column batch inversion is the dominant
+    serial cost. Each item trades 64 comb additions (32 G + 32 Q at
+    width 8) for 68 (2 x 17 + 2 x 17: the half-scalar column count
+    ceilings at 17, since |k_i| can exceed 2^128), so past ~32 lockstep
+    items the four extra additions outweigh the halved inversion count
+    and the full-length walk takes over. The host engine is the
+    small-batch / breaker-open path (the device kernel owns large
+    batches), so the gated regime is the common one."""
+    return int(os.environ.get("TPUBFT_ECDSA_GLV_MAX_B", "32"))
+
+
+def _glv_cols(width: int) -> int:
+    """Comb columns a half-scalar walk needs at this width."""
+    return (132 + width - 1) // width
+
+
+def _glv_split(k: int, glv: dict, n: int) -> Tuple[int, bool, int, bool]:
+    """k -> (|k1|, k1<0, |k2|, k2<0) with k1 + k2*lam ≡ k (mod n)."""
+    c1 = (glv["b2"] * k + (n >> 1)) // n
+    c2 = (-glv["b1"] * k + (n >> 1)) // n
+    k1 = k - c1 * glv["a1"] - c2 * glv["a2"]
+    k2 = -c1 * glv["b1"] - c2 * glv["b2"]
+    return abs(k1), k1 < 0, abs(k2), k2 < 0
+
+
 def _batch_inv(values: Sequence[int], m: int) -> List[int]:
     """Montgomery's trick: invert every element mod m with ONE pow.
     All values must be nonzero mod m (callers screen them)."""
@@ -855,24 +913,93 @@ def _ecdsa_verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]],
     if not walk:
         return out
     # ---- lockstep affine comb walk ----
-    # steps: (shared_row_or_None, per_item_rows_or_None, idxs, digits)
+    # steps: (shared_row_or_None, per_item_rows_or_None, idxs, digits,
+    #         phis, negs) — phis (per-entry, GLV only) routes the
+    #         gathered entry through the secp256k1 endomorphism
+    #         (x, y) -> (beta*x mod p, y) (the [lam]P half-scalar
+    #         stream, reusing the same comb rows); negs flags per-item
+    #         sign flips (y -> p - y at gather) for negative
+    #         half-scalars
     steps = []
     g_rows = _g_comb(curve_name)
-    g_digs = {i: _digit_columns(u1[i], _COMB_G_WIDTH) for i in walk}
-    for j, row in enumerate(g_rows):
-        steps.append((row, None, walk, [g_digs[i][j] for i in walk]))
-    for width in (_COMB_Q_HOT_WIDTH, _COMB_Q_COLD_WIDTH):
-        sub = [i for i in walk if qwidth[i] == width]
-        if not sub:
-            continue
-        digs = {i: _digit_columns(u2[i], width) for i in sub}
-        for j in range(len(qcomb[sub[0]])):
-            steps.append((None, [qcomb[i][j] for i in sub], sub,
-                          [digs[i][j] for i in sub]))
-    ax = [0] * B
-    ay = [0] * B
-    inf = [True] * B
-    for shared_row, rows, idxs, digs in steps:
+    glv = (_GLV_PARAMS.get(curve_name)
+           if _glv_enabled() and len(walk) <= _glv_max_walk() else None)
+    if glv is not None:
+        # GLV split (ISSUE 17 satellite): u = s1*|k1| + s2*|k2|*lam
+        # (mod n) with |k1|, |k2| < 2^~128.5, so the walk is
+        # _glv_cols(width) columns instead of the full 256-bit run.
+        # Both half-scalars of one column share a single step — and so
+        # a single _batch_inv — by accumulating into two independent
+        # lanes (item i: lane A at slot i, lane B at slot B+i; adds
+        # across lanes have no serial dependency, unlike two adds into
+        # one accumulator). The walk length (= the count of per-column
+        # modular inversions, the serial cost here) halves and each
+        # surviving inversion amortizes over twice the additions; a
+        # final batched merge add folds lane B into lane A.
+        splits = {}
+        bounded = []
+        for i in walk:
+            s = (_glv_split(u1[i], glv, n) + _glv_split(u2[i], glv, n))
+            if max(s[0], s[2], s[4], s[6]) >= _GLV_MAX:
+                # magnitude rail (unreachable for reduced scalars):
+                # verdict via the plain per-item path, never a wrong
+                # answer from truncated digits
+                pk_i, msg_i, sig_i = items[i]
+                out[i] = ecdsa_verify(pk_i, msg_i, sig_i, curve_name)
+                continue
+            splits[i] = s
+            bounded.append(i)
+        walk = bounded
+        if not walk:
+            return out
+        lane_b = [B + i for i in walk]
+        both = walk + lane_b
+        g_phis = [False] * len(walk) + [True] * len(walk)
+        g_negs = ([splits[i][1] for i in walk]
+                  + [splits[i][3] for i in walk])
+        da = {i: _digit_columns(splits[i][0], _COMB_G_WIDTH)
+              for i in walk}
+        db = {i: _digit_columns(splits[i][2], _COMB_G_WIDTH)
+              for i in walk}
+        for j in range(_glv_cols(_COMB_G_WIDTH)):
+            steps.append((g_rows[j], None, both,
+                          [da[i][j] for i in walk]
+                          + [db[i][j] for i in walk], g_phis, g_negs))
+        for width in (_COMB_Q_HOT_WIDTH, _COMB_Q_COLD_WIDTH):
+            sub = [i for i in walk if qwidth[i] == width]
+            if not sub:
+                continue
+            sub_both = sub + [B + i for i in sub]
+            q_phis = [False] * len(sub) + [True] * len(sub)
+            q_negs = ([splits[i][5] for i in sub]
+                      + [splits[i][7] for i in sub])
+            qa = {i: _digit_columns(splits[i][4], width) for i in sub}
+            qb = {i: _digit_columns(splits[i][6], width) for i in sub}
+            for j in range(_glv_cols(width)):
+                rows_j = [qcomb[i][j] for i in sub]
+                steps.append((None, rows_j + rows_j, sub_both,
+                              [qa[i][j] for i in sub]
+                              + [qb[i][j] for i in sub],
+                              q_phis, q_negs))
+    else:
+        g_digs = {i: _digit_columns(u1[i], _COMB_G_WIDTH) for i in walk}
+        for j, row in enumerate(g_rows):
+            steps.append((row, None, walk,
+                          [g_digs[i][j] for i in walk], None, None))
+        for width in (_COMB_Q_HOT_WIDTH, _COMB_Q_COLD_WIDTH):
+            sub = [i for i in walk if qwidth[i] == width]
+            if not sub:
+                continue
+            digs = {i: _digit_columns(u2[i], width) for i in sub}
+            for j in range(len(qcomb[sub[0]])):
+                steps.append((None, [qcomb[i][j] for i in sub], sub,
+                              [digs[i][j] for i in sub], None, None))
+    beta = glv["beta"] if glv is not None else 0
+    lanes = 2 * B if glv is not None else B
+    ax = [0] * lanes
+    ay = [0] * lanes
+    inf = [True] * lanes
+    for shared_row, rows, idxs, digs, phis, negs in steps:
         denoms: List[int] = []
         dap = denoms.append
         acts: List[Tuple[int, int, int, int]] = []
@@ -882,6 +1009,11 @@ def _ecdsa_verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]],
             if not d:
                 continue
             e = shared_row[d] if shared_row is not None else rows[t][d]
+            if phis is not None:
+                if phis[t]:
+                    e = (beta * e[0] % p, e[1])
+                if negs[t]:
+                    e = (e[0], p - e[1])
             if inf[i]:
                 ax[i], ay[i] = e
                 inf[i] = False
@@ -911,6 +1043,41 @@ def _ecdsa_verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]],
                 x3 = (lam * lam - x1 - ex) % p
             ay[i] = (lam * (x1 - x3) - y1) % p
             ax[i] = x3
+    if glv is not None:
+        # fold lane B (the [lam]-stream accumulator) into lane A with
+        # one final batched affine add
+        denoms = []
+        acts = []
+        for i in walk:
+            ib = B + i
+            if inf[ib]:
+                continue
+            if inf[i]:
+                ax[i], ay[i] = ax[ib], ay[ib]
+                inf[i] = False
+                continue
+            dx = ax[ib] - ax[i]
+            if dx:
+                denoms.append(dx)
+                acts.append((i, ax[ib], ay[ib], 0))
+            elif ay[ib] == ay[i]:
+                denoms.append(2 * ay[i])
+                acts.append((i, ax[ib], ay[ib], 1))
+            else:
+                inf[i] = True               # A + (-A)
+        if denoms:
+            invs = _batch_inv(denoms, p)
+            for (i, ex, ey, dbl), invd in zip(acts, invs):
+                x1 = ax[i]
+                y1 = ay[i]
+                if dbl:
+                    lam = (3 * x1 * x1 + a) * invd % p
+                    x3 = (lam * lam - 2 * x1) % p
+                else:
+                    lam = (ey - y1) * invd % p
+                    x3 = (lam * lam - x1 - ex) % p
+                ay[i] = (lam * (x1 - x3) - y1) % p
+                ax[i] = x3
     for i in walk:
         # x(T) mod n == r covers the r+n wrap case by construction
         out[i] = (not inf[i]) and ax[i] % n == rs[i]

@@ -372,10 +372,392 @@ class BlsThresholdFactory(IThresholdFactory):
         return master_pk, share_pks, shares
 
 
+# ---------------- multisig-bls (BLS12-381, aggregation-friendly) ----------------
+#
+# n INDEPENDENT BLS keys (like multisig-ed25519, not Shamir): the combined
+# certificate is an unweighted sum of identified G1 shares plus a
+# contributor bitmap, verified against the sum of the contributors' G2
+# public keys. Unlike Shamir threshold shares, any SUBSET of these shares
+# sums to a meaningful partial aggregate — which is exactly what the
+# share-aggregation overlay needs interior nodes to produce — so this is
+# the scheme `share_aggregation` mode requires (the reference's
+# "multisig-bls" role, threshsign BlsMultisigKeygen).
+
+AGG_BITMAP_LEN = 8          # u64 LE contributor bitmap: 1-based id i -> bit i-1
+AGG_CERT_LEN = AGG_BITMAP_LEN + 48   # bitmap + compressed G1 point
+
+
+def pack_contributors(ids: Sequence[int]) -> int:
+    bm = 0
+    for i in ids:
+        bm |= 1 << (i - 1)
+    return bm
+
+
+def unpack_contributors(bm: int) -> List[int]:
+    return [i + 1 for i in range(bm.bit_length()) if bm >> i & 1]
+
+
+def pack_agg_cert(ids: Sequence[int], pt) -> bytes:
+    """THE multisig-bls certificate/partial encoding: u64 LE contributor
+    bitmap + 48-byte compressed aggregate. One serializer for the
+    accumulator, fused-combine, and interior-partial paths — byte-identity
+    between a raw-share feed and a partial-aggregate feed of the same
+    contributor set is a pinned invariant."""
+    return struct.pack("<Q", pack_contributors(ids)) + bls.g1_compress(pt)
+
+
+def unpack_agg_cert(blob: bytes) -> Optional[Tuple[List[int], object]]:
+    """-> (sorted contributor ids, G1 point) or None if malformed."""
+    if len(blob) != AGG_CERT_LEN:
+        return None
+    (bm,) = struct.unpack_from("<Q", blob, 0)
+    if bm == 0:
+        return None
+    try:
+        pt = bls.g1_decompress(blob[AGG_BITMAP_LEN:])
+    except ValueError:
+        return None
+    if pt is None:
+        return None
+    return unpack_contributors(bm), pt
+
+
+class BlsMultisigSigner(BlsThresholdSigner):
+    """Same share shape as the threshold signer (H(m)^sk, compressed);
+    only the key material differs (independent sk, not a Shamir share)."""
+
+
+class BlsMultisigAccumulator(IThresholdAccumulator):
+    """Accumulates raw shares AND interior-node partial aggregates.
+
+    `add` accepts either form (48-byte raw share keyed by signer id, or a
+    self-describing 56-byte bitmap+point partial) so the fused
+    combine_batch default loop and the ShareCollector snapshot path feed
+    it without caring which kind each entry is. Contributor sets must be
+    disjoint — an overlapping add is rejected (first-come wins), which
+    keeps the final sum a plain union and the cert deterministic."""
+
+    def __init__(self, verifier: "BlsMultisigVerifier", share_verification: bool):
+        self._verifier = verifier
+        self._share_verification = share_verification
+        self._digest: Optional[bytes] = None
+        # entry key (signer id for raw, arbitrary for partial) ->
+        # (contributor-id tuple, G1 point)
+        self._entries: Dict[int, Tuple[Tuple[int, ...], object]] = {}
+        self._contrib: set = set()
+
+    def set_expected_digest(self, digest: bytes) -> None:
+        self._digest = digest
+
+    def _count(self) -> int:
+        return len(self._contrib)
+
+    def add(self, share_id: int, share: bytes) -> int:
+        if len(share) == AGG_CERT_LEN:
+            return self._add_partial_entry(share_id, share)
+        if not 1 <= share_id <= self._verifier.total_signers:
+            return self._count()
+        if share_id in self._contrib:
+            return self._count()
+        try:
+            pt = bls.g1_decompress(share)
+        except ValueError:
+            return self._count()
+        if pt is None:
+            return self._count()
+        if self._share_verification and self._digest is not None:
+            if not self._verifier.verify_share(share_id, self._digest, share):
+                return self._count()
+        self._entries[share_id] = ((share_id,), pt)
+        self._contrib.add(share_id)
+        return self._count()
+
+    def add_partial(self, partial: bytes) -> int:
+        """Absorb an interior node's partial aggregate; entry key is the
+        smallest contributor id (stable + collision-free given the
+        disjointness rule)."""
+        dec = unpack_agg_cert(partial)
+        if dec is None:
+            return self._count()
+        return self._add_partial_entry(dec[0][0], partial)
+
+    def _add_partial_entry(self, key: int, partial: bytes) -> int:
+        dec = unpack_agg_cert(partial)
+        if dec is None:
+            return self._count()
+        ids, pt = dec
+        if any(i > self._verifier.total_signers for i in ids):
+            return self._count()
+        if self._contrib.intersection(ids):
+            return self._count()          # overlap: first-come wins
+        if self._share_verification and self._digest is not None:
+            if not bls.verify(self._verifier.agg_pk(ids), self._digest, pt):
+                return self._count()
+        self._entries[key] = (tuple(ids), pt)
+        self._contrib.update(ids)
+        return self._count()
+
+    def has_threshold(self) -> bool:
+        return self._count() >= self._verifier.threshold
+
+    def contributor_ids(self) -> List[int]:
+        return sorted(self._contrib)
+
+    def points(self) -> List[object]:
+        """Entry points in sorted-entry-key order (summation input)."""
+        return [self._entries[k][1] for k in sorted(self._entries)]
+
+    def get_full_signed_data(self) -> bytes:
+        """ALL accumulated contributors, never threshold-truncated: the
+        cert bytes depend only on the contributor SET, so a raw-share
+        feed and a partial-aggregate feed of the same signers produce
+        identical certificates."""
+        acc = None
+        for pt in self.points():
+            acc = bls.g1_add(acc, pt)
+        return pack_agg_cert(self.contributor_ids(), acc)
+
+    def partial_signed_data(self) -> bytes:
+        """Current partial aggregate (what an interior node flushes up).
+        Same encoding as the certificate — a partial IS a cert over a
+        sub-threshold contributor set."""
+        return self.get_full_signed_data()
+
+    def identify_bad_shares(self) -> List[int]:
+        assert self._digest is not None
+        return self._verifier._identify_bad_entries(self._digest, self._entries)
+
+
+class BlsMultisigVerifier(IThresholdVerifier):
+    def __init__(self, threshold: int, total: int, share_pks):
+        self._threshold = threshold
+        self._total = total
+        self._share_pks = share_pks
+        self._apk_cache: Dict[int, object] = {}
+
+    def new_accumulator(self, with_share_verification: bool) -> BlsMultisigAccumulator:
+        return BlsMultisigAccumulator(self, with_share_verification)
+
+    @property
+    def supports_partial_aggregation(self) -> bool:
+        return True
+
+    def share_weight(self, share: bytes) -> int:
+        if len(share) == AGG_CERT_LEN:
+            (bm,) = struct.unpack_from("<Q", share, 0)
+            return max(bin(bm).count("1"), 1)
+        return 1
+
+    def share_pk(self, share_id: int):
+        if not 1 <= share_id <= self._total:
+            raise ValueError(f"share id {share_id} out of range 1..{self._total}")
+        return self._share_pks[share_id - 1]
+
+    def agg_pk(self, ids: Sequence[int]):
+        """Sum of the contributors' G2 public keys (cached by bitmap —
+        overlay subtrees recur across slots, so hit rates are high)."""
+        bm = pack_contributors(ids)
+        apk = self._apk_cache.get(bm)
+        if apk is None:
+            apk = None
+            for i in ids:
+                apk = bls.g2_add(apk, self.share_pk(i)) if apk is not None \
+                    else self.share_pk(i)
+            if len(self._apk_cache) > 4096:
+                self._apk_cache.clear()
+            self._apk_cache[bm] = apk
+        return apk
+
+    def verify_share(self, share_id: int, data: bytes, share: bytes) -> bool:
+        if not 1 <= share_id <= self._total:
+            return False
+        try:
+            pt = bls.g1_decompress(share)
+        except ValueError:
+            return False
+        return bls.verify(self.share_pk(share_id), data, pt)
+
+    def verify(self, data: bytes, sig: bytes) -> bool:
+        dec = unpack_agg_cert(sig)
+        if dec is None:
+            return False
+        ids, pt = dec
+        if len(ids) < self._threshold or ids[-1] > self._total:
+            return False
+        return bls.verify(self.agg_pk(ids), data, pt)
+
+    def verify_batch_certs(self, items) -> List[bool]:
+        """Aggregated verification with PER-CERT aggregate public keys:
+        e(Σ z_i·sig_i, -g2) · Π e(z_i·H(d_i), apk_i) == 1 — one Miller
+        batch of m+1 pairings instead of 2m (each apk differs, so the
+        H-side cannot fold to a single pairing the way the master-pk
+        threshold scheme's can). Per-cert loop on aggregate failure."""
+        out = [False] * len(items)
+        decoded = []
+        for i, (d, s) in enumerate(items):
+            dec = unpack_agg_cert(s)
+            if dec is None:
+                continue
+            ids, pt = dec
+            if len(ids) < self._threshold or ids[-1] > self._total:
+                continue
+            decoded.append((i, d, ids, pt))
+        if not decoded:
+            return out
+        if len(decoded) == 1:
+            i, d, ids, pt = decoded[0]
+            out[i] = bls.verify(self.agg_pk(ids), d, pt)
+            return out
+        ctx = b"agg-certs" + b"".join(
+            d + struct.pack("<Q", pack_contributors(ids)) + bls.g1_compress(pt)
+            for _, d, ids, pt in decoded)
+        zs = bls._rlc_scalars(len(decoded), ctx)
+        agg_sig = bls.g1_msm([pt for _, _, _, pt in decoded], zs)
+        pairs = [(agg_sig, bls.g2_neg(bls.G2_GEN))]
+        for z, (_, d, ids, _) in zip(zs, decoded):
+            pairs.append((bls.g1_mul(bls.hash_to_g1(d), z), self.agg_pk(ids)))
+        if bls.pairing_check(pairs):
+            for i, _, _, _ in decoded:
+                out[i] = True
+            return out
+        for i, d, ids, pt in decoded:
+            out[i] = bls.verify(self.agg_pk(ids), d, pt)
+        return out
+
+    # ---- fused cross-slot combine (CombineBatcher protocol) ----
+
+    def _decode_job_entries(self, shares: Dict[int, bytes]
+                            ) -> Dict[int, Tuple[Tuple[int, ...], object]]:
+        """Snapshot-dict decode with accumulator `add` semantics: raw
+        48-byte shares keyed by signer id, 56-byte partials keyed by the
+        forwarding child; malformed/out-of-range/overlapping entries
+        silently dropped. Entries are visited heaviest-first (contributor
+        popcount, key as the deterministic tie-break) so a duplicate —
+        e.g. a parent-timeout fallback raw whose signer already rides a
+        subtree partial — is the entry dropped, never the partial: the
+        surviving contributor union stays maximal, keeping the combined
+        cert at or above threshold."""
+        entries: Dict[int, Tuple[Tuple[int, ...], object]] = {}
+        taken: set = set()
+        for key in sorted(shares,
+                          key=lambda k: (-self.share_weight(shares[k]), k)):
+            blob = shares[key]
+            if len(blob) == AGG_CERT_LEN:
+                dec = unpack_agg_cert(blob)
+                if dec is None:
+                    continue
+                ids, pt = dec
+                if ids[-1] > self._total or taken.intersection(ids):
+                    continue
+                entries[key] = (tuple(ids), pt)
+                taken.update(ids)
+            else:
+                if not 1 <= key <= self._total or key in taken:
+                    continue
+                try:
+                    pt = bls.g1_decompress(blob)
+                except ValueError:
+                    continue
+                if pt is None:
+                    continue
+                entries[key] = ((key,), pt)
+                taken.add(key)
+        return entries
+
+    def _sum_segments(self, segments: List[List[object]]) -> List[object]:
+        """[[points]] -> one unweighted G1 sum per segment. Host path:
+        sequential adds; the TPU subclass folds every segment into ONE
+        all-ones-scalar segmented multi-MSM launch (the PR 11 kernel,
+        new call shape)."""
+        out = []
+        for pts in segments:
+            acc = None
+            for pt in pts:
+                acc = bls.g1_add(acc, pt)
+            out.append(acc)
+        return out
+
+    def aggregate_partials(self, jobs: List[Tuple[List[int], List[object]]]
+                           ) -> List[bytes]:
+        """Interior-node flush: [(contributor ids, entry points)] -> one
+        packed partial per job, all sums in one `_sum_segments` pass (one
+        device launch on the TPU subclass)."""
+        sums = self._sum_segments([pts for _, pts in jobs])
+        return [pack_agg_cert(ids, pt) for (ids, _), pt in zip(jobs, sums)]
+
+    def combine_batch(self, jobs) -> List[Tuple[bool, bytes, List[int]]]:
+        decoded = [(digest, self._decode_job_entries(shares))
+                   for digest, shares in jobs]
+        sums = self._sum_segments(
+            [[pt for _, pt in entries.values()] for _, entries in decoded])
+        certs = []
+        for (_, entries), pt in zip(decoded, sums):
+            ids = sorted(i for ids, _ in entries.values() for i in ids)
+            certs.append(pack_agg_cert(ids, pt) if ids else b"")
+        verdicts = self.verify_batch_certs(
+            [(digest, cert) for (digest, _), cert in zip(decoded, certs)])
+        out: List[Tuple[bool, bytes, List[int]]] = []
+        for (digest, entries), cert, ok in zip(decoded, certs, verdicts):
+            if ok:
+                out.append((True, cert, []))
+            else:
+                out.append((False, b"",
+                            self._identify_bad_entries(digest, entries)))
+        return out
+
+    def _identify_bad_entries(self, digest: bytes,
+                              entries: Dict[int, Tuple[Tuple[int, ...], object]]
+                              ) -> List[int]:
+        """Contributor-bitmap bisection: each entry (raw share OR subtree
+        partial) verifies against its bitmap's aggregate pk, walked with
+        the O(b·log n) aggregation tree — a forged partial indicts
+        exactly its subtree's entry key, so the collector drops that
+        subtree and the direct-send fallback refills it."""
+        keys = sorted(entries)
+        if not keys:
+            return []
+        h = bls.hash_to_g1(digest)
+        tree = bls.BlsBatchVerifier(
+            [self.agg_pk(entries[k][0]) for k in keys], h)
+        verdicts = tree.batch_verify([entries[k][1] for k in keys])
+        return [k for k, good in zip(keys, verdicts) if not good]
+
+    @property
+    def threshold(self) -> int:
+        return self._threshold
+
+    @property
+    def total_signers(self) -> int:
+        return self._total
+
+
+class BlsMultisigFactory(IThresholdFactory):
+    def new_signer(self, signer_id: int, secret_share: int) -> BlsMultisigSigner:
+        return BlsMultisigSigner(signer_id, secret_share)
+
+    def new_verifier(self, threshold, total, public_key, share_public_keys):
+        return BlsMultisigVerifier(threshold, total, share_public_keys)
+
+    def keygen(self, threshold: int, total: int, seed: Optional[bytes] = None):
+        import hashlib
+        sks, pks = [], []
+        for i in range(total):
+            s = (hashlib.sha256(b"ms-bls" + seed + i.to_bytes(4, "big")).digest()
+                 if seed is not None else None)
+            sk, pk = bls.keygen(seed=s)
+            sks.append(sk)
+            pks.append(pk)
+        # no single master public key for multisig; use the pk list
+        return pks, pks, sks
+
+
 def register_builtin(type_name: str) -> None:
     if type_name == "multisig-ed25519":
         Cryptosystem.register_type(type_name, MultisigEd25519Factory())
-    elif type_name in ("threshold-bls", "multisig-bls"):
+    elif type_name == "multisig-bls":
+        Cryptosystem.register_type(type_name, BlsMultisigFactory())
+    elif type_name == "threshold-bls":
         Cryptosystem.register_type(type_name, BlsThresholdFactory())
     else:
         raise ValueError(f"unknown cryptosystem type {type_name}"
@@ -399,13 +781,25 @@ ADAPTIVE_SCHEME_CROSSOVER_N = 16
 
 
 def resolve_threshold_scheme(scheme: str, n: int,
-                             crossover_n: int = 0) -> str:
+                             crossover_n: int = 0,
+                             aggregation: str = "off") -> str:
     """Configure-time resolution of the certificate scheme: "adaptive"
     becomes a concrete cryptosystem type from the cluster size, anything
     else passes through. Every replica must resolve identically (same n,
-    same crossover) — the scheme is part of the cluster's key material,
-    so it is resolved once at keygen, never re-negotiated on the wire."""
+    same crossover, same aggregation mode) — the scheme is part of the
+    cluster's key material, so it is resolved once at keygen, never
+    re-negotiated on the wire.
+
+    When share aggregation is on, "adaptive" resolves to "multisig-bls"
+    regardless of n: interior overlay nodes must produce partial
+    aggregates, which Shamir threshold shares cannot (the Lagrange
+    weights depend on the final contributor set) and the Ed25519 vector
+    only can by concatenation (no bandwidth win). BLS multisig partials
+    are a constant 56 bytes at every tree level, which is the whole
+    point of aggregating (arXiv 1911.04698)."""
     if scheme != "adaptive":
         return scheme
+    if aggregation and aggregation != "off":
+        return "multisig-bls"
     cx = crossover_n or ADAPTIVE_SCHEME_CROSSOVER_N
     return "multisig-ed25519" if n < cx else "threshold-bls"

@@ -32,7 +32,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpubft.crypto import bls12381 as bls
 from tpubft.crypto.interfaces import IVerifier
-from tpubft.crypto.systems import (BlsThresholdAccumulator,
+from tpubft.crypto.systems import (BlsMultisigVerifier,
+                                   BlsThresholdAccumulator,
                                    BlsThresholdVerifier,
                                    MultisigEd25519Verifier)
 
@@ -395,6 +396,36 @@ class TpuBlsThresholdVerifier(BlsThresholdVerifier):
             return super()._combine_segments(segments)
 
 
+class TpuBlsMultisigVerifier(BlsMultisigVerifier):
+    """Multisig-BLS with the unweighted sums on device: every segment's
+    Σ share_i rides the SAME segmented multi-MSM kernel the threshold
+    scheme's Lagrange combine uses (`ops/bls12_381.msm_batch` under
+    `device_section("bls_msm")`), with all-ones scalars — a new call
+    shape, not a new kernel. Serves both the fused `combine_batch` flush
+    (root of the aggregation overlay) and `aggregate_partials` (interior
+    nodes), so one flush is one launch in both roles."""
+
+    def _sum_segments(self, segments) -> List:
+        import os
+        total = sum(len(pts) for pts in segments)
+        crossover = int(os.environ.get("TPUBFT_MSM_CROSSOVER_K", "128"))
+        # fused flush: clear the crossover on the SUM across segments
+        if total < crossover or not any(segments):
+            return super()._sum_segments(segments)
+        try:
+            from tpubft.ops import bls12_381 as dev
+            live = [i for i, pts in enumerate(segments) if pts]
+            sums = dev.msm_batch([(segments[i], [1] * len(segments[i]))
+                                  for i in live])
+            out = [None] * len(segments)
+            for i, pt in zip(live, sums):
+                out[i] = pt
+            return out
+        except Exception:  # noqa: BLE001 — device loss: the host
+            # sequential sums produce identical points
+            return super()._sum_segments(segments)
+
+
 def make_threshold_verifier(type_name: str, threshold: int, total: int,
                             public_key, share_public_keys,
                             min_device_batch: int = 1):
@@ -408,4 +439,6 @@ def make_threshold_verifier(type_name: str, threshold: int, total: int,
     if type_name == "threshold-bls":
         return TpuBlsThresholdVerifier(threshold, total, public_key,
                                        share_public_keys)
+    if type_name == "multisig-bls":
+        return TpuBlsMultisigVerifier(threshold, total, share_public_keys)
     raise ValueError(f"no TPU backend for cryptosystem {type_name!r}")

@@ -269,7 +269,8 @@ _FAST_VC = {"view_change_timer_ms": 900}
 def _counter_cluster(ctx: ScenarioContext, **kw):
     from tpubft.testing.cluster import InProcessCluster
     kw.setdefault("cfg_overrides", dict(_FAST_VC))
-    return InProcessCluster(f=1, seed=ctx.cluster_seed(), **kw)
+    kw.setdefault("f", 1)
+    return InProcessCluster(seed=ctx.cluster_seed(), **kw)
 
 
 def _persistent_factories(ctx: ScenarioContext):
@@ -1042,6 +1043,186 @@ def scenario_thin_replica_failover(ctx: ScenarioContext) -> dict:
             "preexec_agreed": agreed}
 
 
+# ----------------------------------------------------------------------
+# share-aggregation overlay scenarios (ISSUE 17)
+# ----------------------------------------------------------------------
+
+
+class _WanLatency:
+    """WAN latency profile over the loopback bus, modeled on
+    bench_st.LatencyNet (deliver-time heap + one scheduler thread): the
+    bus hook intercepts replica->replica traffic and re-queues it for
+    delayed direct delivery to the destination endpoint — the same tail
+    the bus pump runs. Client traffic stays instant, so request
+    injection is not part of the profile. Per-pair delays come from a
+    caller-supplied (sender, dest) -> seconds function, letting a
+    scenario shape regions rather than one flat RTT."""
+
+    def __init__(self, bus, n_replicas: int, delay_fn) -> None:
+        import heapq
+        self._heapq = heapq
+        self._bus = bus
+        self._n = n_replicas
+        self._delay = delay_fn
+        self._q: list = []
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="wan-latency")
+        self._thread.start()
+        bus.add_hook(self._hook)
+
+    def _hook(self, s, d, data):
+        if s >= self._n or d >= self._n or self._stop:
+            return data                 # clients / teardown: instant
+        with self._cv:
+            self._seq += 1
+            self._heapq.heappush(
+                self._q, (time.monotonic() + self._delay(s, d),
+                          self._seq, s, d, data))
+            self._cv.notify()
+        return None
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and (
+                        not self._q
+                        or self._q[0][0] > time.monotonic()):
+                    timeout = (max(self._q[0][0] - time.monotonic(), 1e-4)
+                               if self._q else None)
+                    self._cv.wait(timeout=timeout)
+                if self._stop:
+                    return
+                _, _, s, d, data = self._heapq.heappop(self._q)
+            ep = self._bus._endpoints.get(d)
+            if ep is not None:
+                ep._deliver(s, data)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=5)
+
+
+def scenario_agg_tree_node_kill(ctx: ScenarioContext) -> dict:
+    """Interior aggregator killed mid-flood: the shares its subtree was
+    climbing through stop being forwarded, the children's parent
+    timeout re-sends them DIRECT to the collector, and the cluster
+    converges WITHOUT a view change — liveness under aggregation is
+    never worse than the all-to-all path it replaced. The schedule
+    (victim draw included) replays digest-identically."""
+    from tpubft.apps import counter
+    from tpubft.consensus.aggregation import overlay_for
+    overrides = dict(share_aggregation="tree", agg_fanout=2,
+                     agg_flush_ms=5, agg_parent_timeout_ms=150,
+                     fast_path_timeout_ms=50,
+                     # long enough that the fallback, not a view
+                     # change, is what restores progress
+                     view_change_timer_ms=6000)
+    with _counter_cluster(ctx, cfg_overrides=overrides) as cluster:
+        n = cluster.n
+        # the view-0 overlay is deterministic: pick the interior
+        # non-root aggregator every replica agrees on
+        ov = overlay_for("tree", n, 2, 0, 0, 1, 16)
+        victim = next(r for r in ov.order[1:] if ov.is_interior(r))
+        ctx.event("kill", replica=victim, role="interior-aggregator")
+        cl = cluster.client()
+        total = 0
+        for i in range(2):              # flood before the kill
+            delta = ctx.randint(f"pre{i}", 1, 50)
+            total += delta
+            reply = cl.send_write(counter.encode_add(delta),
+                                  timeout_ms=20000)
+            assert counter.decode_reply(reply) == total
+        cluster.kill(victim)
+        t0 = time.monotonic()
+        for i in range(3):              # flood through the dead branch
+            delta = ctx.randint(f"post{i}", 1, 50)
+            total += delta
+            reply = cl.send_write(counter.encode_add(delta),
+                                  timeout_ms=30000)
+            assert counter.decode_reply(reply) == total
+        recovery = time.monotonic() - t0
+        live = [r for r in range(n) if r != victim]
+        _wait_converged(ctx, cluster, total, live, 15,
+                        "fallback path converges")
+        for r in live:
+            assert cluster.replicas[r].view == 0, \
+                f"replica {r} view-changed; fallback should have held"
+        fallbacks = sum(cluster.metric(r, "counters", "agg_fallbacks")
+                        for r in live)
+        assert fallbacks > 0, "no parent-timeout fallback ever fired"
+    return {"recovery_s": round(recovery, 3), "victim": victim,
+            "fallbacks": fallbacks}
+
+
+def scenario_agg_wan_latency(ctx: ScenarioContext) -> dict:
+    """Large-n two-region WAN profile (intra 2ms, inter 12ms one-way)
+    under gossip aggregation with one dead replica forcing the slow
+    path: the overlay keeps every node's share fan-in under the
+    collector's all-to-all O(n), and commits flow without a view change
+    at WAN timescales."""
+    from tpubft.apps import counter
+    intra_ms, inter_ms = 2, 12
+    # parent timeout must clear the WHOLE slow-path slot latency (WAN
+    # hops + flush windows + CPU-host BLS combines), not just one hop:
+    # the fallback trigger is "slot not prepared/committed yet", so an
+    # undersized value collapses the overlay back to all-to-all with
+    # duplicate shares on top. 2s is comfortably past a CPU-host slot
+    # and still 4x under the view-change timer.
+    overrides = dict(share_aggregation="gossip", agg_fanout=3,
+                     agg_flush_ms=10, agg_parent_timeout_ms=2000,
+                     agg_rotate_seqs=4, fast_path_timeout_ms=80,
+                     view_change_timer_ms=8000)
+    ctx.event("latency_profile", intra_ms=intra_ms, inter_ms=inter_ms,
+              regions=2)
+    with _counter_cluster(ctx, f=3, cfg_overrides=overrides) as cluster:
+        n = cluster.n                   # 10
+        region = {r: r % 2 for r in range(n)}
+
+        def delay(s, d):
+            return (intra_ms if region[s] == region[d] else inter_ms) / 1e3
+
+        wan = _WanLatency(cluster.bus, n, delay)
+        try:
+            victim = n - 1
+            ctx.event("kill", replica=victim, role="fast-path-breaker")
+            cluster.kill(victim)
+            cl = cluster.client()
+            total = 0
+            for i in range(5):
+                delta = ctx.randint(f"add{i}", 1, 50)
+                total += delta
+                reply = cl.send_write(counter.encode_add(delta),
+                                      timeout_ms=45000)
+                assert counter.decode_reply(reply) == total
+            live = [r for r in range(n) if r != victim]
+            _wait_converged(ctx, cluster, total, live, 30,
+                            "WAN cluster converges")
+            for r in live:
+                assert cluster.replicas[r].view == 0
+            rcvd = [cluster.metric(r, "counters", "share_msgs_received")
+                    for r in live]
+            absorbed = cluster.metric(0, "counters",
+                                      "agg_partials_absorbed")
+            assert absorbed > 0, "root never absorbed a partial"
+            # the whole point: no node carries all-to-all fan-in.
+            # 5 slots x 2 kinds x (n-2) senders is the collector's
+            # un-aggregated load; the busiest node must sit strictly
+            # under it even INCLUDING the first-slot fallback burst
+            # (the dead replica seats as an interior node in some
+            # rotation, so its orphans route direct from slot 2 on)
+            assert max(rcvd) < 5 * 2 * (n - 2), \
+                f"fan-in {max(rcvd)} not under all-to-all {5*2*(n-2)}"
+        finally:
+            wan.stop()
+    return {"recovery_s": 0.0, "max_fan_in": max(rcvd),
+            "collector_fan_in": rcvd[0], "absorbed": absorbed}
+
+
 def smoke_matrix() -> List[ScenarioSpec]:
     return [
         ScenarioSpec("wrong-digest-primary", scenario_wrong_digest_primary,
@@ -1083,6 +1264,12 @@ def smoke_matrix() -> List[ScenarioSpec]:
         ScenarioSpec("group-commit-crash", scenario_group_commit_crash,
                      "inproc", 60, tags=("crashpoint", "durability",
                                          "recovery")),
+        ScenarioSpec("agg-tree-node-kill", scenario_agg_tree_node_kill,
+                     "inproc", 90, tags=("aggregation", "crash",
+                                         "fallback")),
+        ScenarioSpec("agg-wan-latency", scenario_agg_wan_latency,
+                     "inproc", 120, tags=("aggregation", "wan",
+                                          "large-n")),
     ]
 
 

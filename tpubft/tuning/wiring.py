@@ -21,10 +21,16 @@ replica exposes and binds each to its seam:
                                         bound; kvbc attaches ST after
                                         construction)
   breaker_cooldown_ms                   device breaker configure()
+  agg_fanout                            replica._agg_fanout (overlay
+                                        edges; PIN-ONLY, wire-visible)
   ====================================  ==================================
 
 Knobs with a policy move from live telemetry; the rest are
-catalog/pin/seed surfaces (and still reset on degradation). The seed
+catalog/pin/seed surfaces (and still reset on degradation).
+`combine_batch_max` and `agg_fanout` are additionally WIRE-VISIBLE:
+they shape bytes other replicas must reproduce (certificate contributor
+sets, overlay edges), so they are catalog/pin-only by design — no
+policy is ever attached, and operators change them cluster-wide. The seed
 file (`ReplicaConfig.autotune_seed_file`, written by
 `bench_msm_crossover --ecdsa --seed-out`) re-baselines measured knobs
 before the controller starts.
@@ -103,11 +109,19 @@ def build_replica_tuning(replica, cfg) -> TuningController:
       "bls_msm per-item cost vs commit p50 share", "us")
     controller.add_policy("combine_flush_us",
                           batch_amortize_policy("bls_msm", "commit"))
+    # combine_batch_max is WIRE-VISIBLE and therefore pin/catalog-only
+    # (ISSUE 17): the combine-flush drain order determines which share
+    # subset a certificate aggregates over, and under share aggregation
+    # the cert's contributor bitmap IS wire bytes — replicas autotuning
+    # this independently would emit certificates other replicas never
+    # mint themselves, breaking the cross-replica retransmission cache
+    # and the byte-equivalence gates the benches assert. Operators pin
+    # it cluster-wide (flush timing stays per-replica tunable above:
+    # WHEN a batch drains is local, WHAT a cert may span is not).
     K("combine_batch_max", cfg.combine_batch_max, 1, 512,
       lambda v: replica.collector_pool.reconfigure(max_batch=v),
       "bls_msm per-item cost vs commit p50 share", "slots")
-    controller.add_policy("combine_batch_max",
-                          batch_amortize_policy("bls_msm", "commit"))
+    controller.track("combine_batch_max")
 
     # --- execution lane: coalescing depth from the exec stage share ---
     if replica.exec_lane is not None:
@@ -192,6 +206,20 @@ def build_replica_tuning(replica, cfg) -> TuningController:
     K("breaker_cooldown_ms", cfg.breaker_cooldown_ms, 100, 120_000,
       apply_breaker_cooldown, "breaker trip/recovery history", "ms")
     controller.track("breaker_cooldown_ms")
+
+    # agg_fanout is WIRE-VISIBLE and pin/catalog-only (ISSUE 17): every
+    # replica derives the aggregation overlay deterministically from
+    # (n, fanout, root, view) with no negotiation — a replica moving its
+    # own fanout would compute different parent/child edges than its
+    # peers, orphaning its shares (they land on nodes that don't expect
+    # to be its parent and time out into the direct-send fallback: safe,
+    # but the aggregation win silently evaporates). No policy may ever
+    # drive it; operators pin it cluster-wide in one move.
+    if getattr(replica, "_agg_mode", "off") != "off":
+        K("agg_fanout", cfg.agg_fanout, 2, 16,
+          lambda v: setattr(replica, "_agg_fanout", max(2, int(v))),
+          "overlay depth vs per-hop flush latency (pin-only)", "children")
+        controller.track("agg_fanout")
 
     # --- measured-operating-point seed (bench handoff) ---
     if cfg.autotune_seed_file:

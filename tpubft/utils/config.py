@@ -171,6 +171,41 @@ class ReplicaConfig:
     # schemes & combine batching" for tuning)
     combine_flush_us: int = 300
     combine_batch_max: int = 64
+    # share-aggregation overlay (ISSUE 17, arXiv 1911.04698): "off" =
+    # every replica sends its Prepare/Commit shares straight to the
+    # slot's collector (the O(n) fan-in path, byte-identical to the
+    # pre-aggregation protocol); "tree" = shares climb a deterministic
+    # view-seeded fanout tree rooted at the collector, interior nodes
+    # forwarding 56-byte partial aggregates so the collector's inbound
+    # share traffic drops to O(fanout); "gossip" = same overlay but
+    # re-seeded every `agg_rotate_seqs` sequence numbers as well as per
+    # view, so a slow interior node rotates out mid-view. Requires the
+    # adaptive scheme (which resolves to "multisig-bls" when this is
+    # on) or an explicit "multisig-bls" — Shamir threshold shares
+    # cannot partially aggregate. Every replica of a cluster MUST
+    # configure the same mode: the overlay shape is derived
+    # deterministically, never negotiated on the wire.
+    share_aggregation: str = "off"      # "off" | "tree" | "gossip"
+    # overlay fanout (children per interior node). WIRE-VISIBLE and
+    # pinned (never autotuned): every replica derives parent/children
+    # from (n, fanout, view), so per-replica drift would fragment the
+    # overlay — shares forwarded to a node that doesn't consider itself
+    # the sender's parent would still aggregate (partials are
+    # self-describing) but the O(fanout) bound and the timeout
+    # accounting would be lost. See tuning/wiring.py.
+    agg_fanout: int = 4
+    # how long a non-root replica waits for its subtree's slot to reach
+    # a full certificate before re-sending its own share DIRECT to the
+    # collector (the all-to-all fallback: a dead/slow interior
+    # aggregator costs one timeout, never liveness)
+    agg_parent_timeout_ms: int = 250
+    # how long an interior node holds a partially-filled aggregation
+    # buffer before flushing what it has up the tree (bounds the
+    # latency a straggler child can add at each level)
+    agg_flush_ms: int = 30
+    # "gossip" mode: re-seed the overlay permutation every this many
+    # sequence numbers (rotation cadence within a view)
+    agg_rotate_seqs: int = 16
     # below this many signatures a batch verifies on the CPU verifiers
     # instead of paying a device dispatch (latency-critical singletons)
     device_min_verify_batch: int = 32
@@ -391,6 +426,23 @@ class ReplicaConfig:
         if self.combine_batch_max < 1 or self.combine_flush_us < 0:
             raise ValueError("combine_batch_max must be >= 1 and "
                              "combine_flush_us >= 0")
+        if self.share_aggregation not in ("off", "tree", "gossip"):
+            raise ValueError("share_aggregation must be off|tree|gossip")
+        if self.share_aggregation != "off":
+            if self.threshold_scheme not in ("adaptive", "multisig-bls"):
+                raise ValueError(
+                    "share_aggregation requires threshold_scheme adaptive "
+                    "(resolves to multisig-bls) or multisig-bls — Shamir "
+                    "threshold shares cannot partially aggregate")
+            if self.n_val > 64:
+                raise ValueError("share_aggregation contributor bitmaps "
+                                 "are u64 (n <= 64)")
+        if self.agg_fanout < 2:
+            raise ValueError("agg_fanout must be >= 2")
+        if self.agg_parent_timeout_ms < 1 or self.agg_flush_ms < 0 \
+                or self.agg_rotate_seqs < 1:
+            raise ValueError("agg_parent_timeout_ms must be >= 1, "
+                             "agg_flush_ms >= 0, agg_rotate_seqs >= 1")
         if self.preexec_reply_cache_max < 1:
             raise ValueError("preexec_reply_cache_max must be >= 1")
         if self.preexec_threads < 1:

@@ -100,6 +100,15 @@ EV_TUNE = 26            # autotuner knob change (seq=knob id,
 EV_DUR_GROUP = 27       # durability group committed (io thread;
 #                         seq=new watermark, arg=runs in the group —
 #                         one event per group fsync)
+EV_AGG_FORWARD = 28     # aggregation overlay: interior node flushed a
+#                         partial aggregate to its parent (dispatcher;
+#                         seq/view=slot, arg=contributor count)
+EV_AGG_ROOT = 29        # aggregation overlay: root absorbed a partial
+#                         into the slot's ShareCollector (dispatcher;
+#                         arg=contributor count)
+EV_AGG_FALLBACK = 30    # aggregation overlay: parent timeout fired —
+#                         share re-sent DIRECT to the collector
+#                         (dispatcher; arg=share kind 0=prep/1=commit)
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -116,7 +125,8 @@ EV_NAMES = {
     EV_TRS_PROOF: "trs_proof", EV_PREEXEC_LAUNCH: "preexec_launch",
     EV_PREEXEC_AGREE: "preexec_agree",
     EV_PREEXEC_CONFLICT: "preexec_conflict", EV_TUNE: "tune",
-    EV_DUR_GROUP: "dur_group",
+    EV_DUR_GROUP: "dur_group", EV_AGG_FORWARD: "agg_forward",
+    EV_AGG_ROOT: "agg_root", EV_AGG_FALLBACK: "agg_fallback",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
