@@ -215,6 +215,16 @@ def run_config(config: int, backend: str, secs: float,
         row["dur_group_len"] = _dur_group_len(
             sum(_dur(i, "dur_runs") for i in range(n)),
             sum(_dur(i, "dur_groups") for i in range(n)))
+        if overrides.get("optimistic_replies"):
+            # the optimistic plane's own evidence: slots released to
+            # the reply path before the pairing verify landed, and any
+            # deferred-cert failures (must be 0 on an honest cluster)
+            row["opt_releases"] = sum(
+                cluster.metric(i, "counters", "optimistic_releases")
+                for i in range(n))
+            row["cert_async_failures"] = sum(
+                cluster.metric(i, "counters", "cert_async_failures")
+                for i in range(n))
         if extra_overrides:
             row["overrides"] = dict(extra_overrides)
         if profile:
@@ -339,13 +349,58 @@ def smoke(secs: float = 2.0, clients: int = 2) -> dict:
             ("nodur", {"execution_lane": True,
                        "durability_pipeline": False}),
             ("inline", {"execution_lane": False})):
+        # the optimistic-replies leg lives in smoke_optimistic() (its
+        # own tier-1 test) — not duplicated here
         row = run_config(1, "cpu", secs, clients,
                          extra_overrides=overrides)
         out[label] = {"ok": row["ops"] > 0,
                       "ops": row["ops"],
                       "ops_per_sec": row["ops_per_sec"]}
+        if "opt_releases" in row:
+            out[label]["opt_releases"] = row["opt_releases"]
     out["stall_reports"] = get_watchdog().stall_reports
     return out
+
+
+def smoke_optimistic(secs: float = 2.0, clients: int = 2) -> dict:
+    """Tier-1 A/B shape for the optimistic reply plane (ISSUE 18): the
+    same config-1 workload with `optimistic_replies` on then off, one
+    JSON row with the PR 4 `degraded`/`probe_error` convention — the
+    row degrades (rather than fails) when the plane never actually
+    released a slot, so CI flags a silently-inert plane without
+    guessing at throughput on a noisy host."""
+    from tpubft.utils.racecheck import get_watchdog
+    on = run_config(1, "cpu", secs, clients,
+                    extra_overrides={"execution_lane": True,
+                                     "optimistic_replies": True})
+    off = run_config(1, "cpu", secs, clients,
+                     extra_overrides={"execution_lane": True,
+                                      "optimistic_replies": False})
+    row = {
+        "bench": "e2e-optimistic-smoke", "unit": "ops",
+        "value": on["ops"],
+        "on_ops": on["ops"], "off_ops": off["ops"],
+        "on_ops_per_sec": on["ops_per_sec"],
+        "off_ops_per_sec": off["ops_per_sec"],
+        "on_p90_latency_ms": on["p90_latency_ms"],
+        "off_p90_latency_ms": off["p90_latency_ms"],
+        "opt_releases": on.get("opt_releases", 0),
+        "cert_async_failures": on.get("cert_async_failures", 0),
+        "stall_reports": get_watchdog().stall_reports,
+        "degraded": False, "probe_error": "",
+    }
+    problems = []
+    if not on["ops"] or not off["ops"]:
+        problems.append("a leg ordered zero traffic")
+    if not row["opt_releases"]:
+        problems.append("optimistic plane never released a slot")
+    if row["cert_async_failures"]:
+        problems.append("deferred cert verification failed on an "
+                        "honest cluster")
+    if problems:
+        row["degraded"] = True
+        row["probe_error"] = "; ".join(problems)
+    return row
 
 
 def main() -> None:
@@ -370,6 +425,16 @@ def main() -> None:
                          "lane A/B rows")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fixed shape for CI (lane on vs off)")
+    ap.add_argument("--smoke-optimistic", action="store_true",
+                    help="tiny fixed optimistic-replies A/B shape for "
+                         "CI: one JSON row (degraded/probe_error "
+                         "convention)")
+    ap.add_argument("--optimistic-off", action="store_true",
+                    help="A/B control leg: run with the optimistic "
+                         "reply plane OFF (replies certificate-gated). "
+                         "Without this flag the bench runs the plane ON "
+                         "— pair alternating on/off invocations like "
+                         "the durability rows")
     ap.add_argument("--durability-off", action="store_true",
                     help="A/B control leg: run with the group-commit "
                          "durability pipeline OFF (per-run apply + "
@@ -388,10 +453,19 @@ def main() -> None:
     if args.smoke:
         print(json.dumps(smoke()), flush=True)
         return
+    if args.smoke_optimistic:
+        print(json.dumps(smoke_optimistic()), flush=True)
+        return
     from tpubft.utils.config import parse_config_overrides
     extra = parse_config_overrides(args.override)
     if args.durability_off:
         extra["durability_pipeline"] = False
+    if args.optimistic_off:
+        extra["optimistic_replies"] = False
+    else:
+        # the measured configuration IS the optimistic plane (ISSUE 18);
+        # --optimistic-off is the paired control leg
+        extra.setdefault("optimistic_replies", True)
     if args.profile and args.processes:
         raise SystemExit("--profile reads the in-process flight "
                          "recorder; with --processes take per-replica "

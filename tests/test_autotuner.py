@@ -13,8 +13,11 @@ from tpubft.tuning.controller import TuningController
 from tpubft.tuning.knobs import (GROW, HOLD, SHRINK, Knob, KnobRegistry,
                                  load_seed, write_seed)
 from tpubft.tuning.policies import (Telemetry, batch_amortize_policy,
+                                    breaker_readmission_policy,
+                                    device_min_batch_policy,
                                     ecdsa_crossover_policy,
                                     exec_accumulation_policy,
+                                    optimistic_combine_policy,
                                     stage_fraction)
 from tpubft.utils import flight
 
@@ -165,9 +168,10 @@ class TestSeedFiles:
 # policies
 # ----------------------------------------------------------------------
 def _tel(slots=10, stages=None, kernels=None, depths=None,
-         counters=None, health="healthy"):
+         counters=None, health="healthy", breakers=None):
     return Telemetry(stages=stages or {}, kernels=kernels or {},
                      depths=depths or {}, counters=counters or {},
+                     breakers=breakers or {},
                      health=health, completed_slots=slots)
 
 
@@ -235,6 +239,69 @@ class TestPolicies:
         assert pol(_tel(slots=2, kernels={"ecdsa": {
             "calls": 4, "batch_avg": 64.0, "warm_avg_ms": 1.0}}),
             prev, _knob()) == HOLD
+
+    def test_breaker_readmission_policy(self):
+        pol = breaker_readmission_policy()
+        base = _tel(breakers={"device": {"trips": 2, "recoveries": 2}})
+        # a NEW trip after re-admission: the cooldown was too short
+        retripped = _tel(breakers={"device": {"trips": 3,
+                                              "recoveries": 2}})
+        assert pol(retripped, base, _knob()) == GROW
+        # recoveries advanced, no new trips: plane held — re-admit faster
+        held = _tel(breakers={"device": {"trips": 2, "recoveries": 3}})
+        assert pol(held, base, _knob()) == SHRINK
+        # a trip WITH its recovery in one interval still grows (the
+        # re-trip is the signal; its recovery does not excuse it)
+        both = _tel(breakers={"device": {"trips": 3, "recoveries": 3}})
+        assert pol(both, base, _knob()) == GROW
+        # no fresh history / no baseline: hold
+        assert pol(base, base, _knob()) == HOLD
+        assert pol(base, None, _knob()) == HOLD
+
+    def test_device_min_batch_policy(self):
+        pol = device_min_batch_policy()
+        prev = _tel(kernels={"ed25519": {"calls": 4, "batch_avg": 64.0,
+                                         "warm_avg_ms": 1.0}})
+        falling = _tel(kernels={"ed25519": {"calls": 8, "batch_avg": 128.0,
+                                            "warm_avg_ms": 1.5}})
+        # per-item: 15.6us -> 11.7us — the device amortizes, lower the
+        # floor so smaller batches ride it
+        assert pol(falling, prev, _knob()) == SHRINK
+        rising = _tel(kernels={"ed25519": {"calls": 8, "batch_avg": 64.0,
+                                           "warm_avg_ms": 1.5}})
+        assert pol(rising, prev, _knob()) == GROW
+        # stale kernel counters (no fresh launches): hold
+        assert pol(prev, prev, _knob()) == HOLD
+        assert pol(falling, None, _knob()) == HOLD
+
+    def test_optimistic_combine_policy_vetoes_shrink_on_cert_lag(self):
+        pol = optimistic_combine_policy(
+            batch_amortize_policy("bls_msm", "commit"))
+        commit_heavy = {"commit": {"p50_ms": 8.0, "count": 0},
+                        "exec": {"p50_ms": 1.0}}
+        prev = _tel(slots=10, stages=dict(
+            commit_heavy, cert_lag={"count": 5}))
+        # fresh cert_lag samples: replies no longer wait on the combine
+        # — the dominant commit stage must NOT shrink the flush window
+        cur = _tel(slots=20, stages=dict(
+            commit_heavy, cert_lag={"count": 9}))
+        assert pol(cur, prev, _knob()) == HOLD
+        # no fresh lag samples (optimistic idle / mode off): the inner
+        # policy's SHRINK passes through untouched
+        stale = _tel(slots=30, stages=dict(
+            commit_heavy, cert_lag={"count": 9}))
+        assert pol(stale, cur, _knob()) == SHRINK
+        # GROW is never vetoed: wider windows amortize the deferred
+        # combine even harder
+        grow_prev = _tel(slots=10, kernels={"bls_msm": {
+            "calls": 4, "batch_avg": 8.0, "warm_avg_ms": 1.0}},
+            stages={"cert_lag": {"count": 0}})
+        grow_cur = _tel(slots=20, stages={
+            "commit": {"p50_ms": 1.0}, "exec": {"p50_ms": 4.0},
+            "cert_lag": {"count": 7}},
+            kernels={"bls_msm": {"calls": 8, "batch_avg": 16.0,
+                                 "warm_avg_ms": 1.5}})
+        assert pol(grow_cur, grow_prev, _knob()) == GROW
 
 
 # ----------------------------------------------------------------------
@@ -331,6 +398,38 @@ class TestController:
         assert c.poll_once() == []               # first vote (streak 3)
         assert c.poll_once() != []               # second vote: move
         assert reg.get("a") == 150
+
+    def test_breaker_cooldown_hysteresis_and_degraded_reset(
+            self, monkeypatch):
+        """The ISSUE-18 breaker_cooldown_ms policy rides the standard
+        stability machinery: one noisy re-trip interval never moves the
+        knob (hysteresis 2), a sustained pattern does, and a degraded
+        interval resets the knob to its default like every other."""
+        reg = _reg(_knob("breaker_cooldown_ms", value=1000, lo=100,
+                         hi=120_000))
+        c = TuningController(reg, warmup_polls=0)
+        c.add_policy("breaker_cooldown_ms", breaker_readmission_policy())
+
+        def bt(trips, recov, health="healthy"):
+            return _tel(breakers={"device": {
+                "state": "closed", "trips": trips,
+                "recoveries": recov}}, health=health)
+
+        feed = [bt(0, 0), bt(1, 0), bt(1, 1), bt(2, 1), bt(3, 1)]
+        it = iter(feed)
+        monkeypatch.setattr(c, "gather", lambda: next(it))
+        c.poll_once()                            # baseline (prev=None)
+        assert c.poll_once() == []               # GROW streak 1: no move
+        assert c.poll_once() == []               # SHRINK: streak reset
+        c.poll_once()                            # GROW streak 1 again
+        made = c.poll_once()                     # GROW streak 2: move
+        assert made and made[0]["knob"] == "breaker_cooldown_ms"
+        assert reg.get("breaker_cooldown_ms") > 1000
+        # degraded interval: the moved knob backs off to its default
+        it = iter([bt(3, 1, health="degraded")])
+        made = c.poll_once()
+        assert made[0]["source"] == "degraded-reset"
+        assert reg.get("breaker_cooldown_ms") == 1000
 
     def test_ev_tune_flight_event_and_decision_log(self):
         if not flight.enabled():

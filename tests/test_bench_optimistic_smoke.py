@@ -1,0 +1,40 @@
+"""Tier-1 wiring for the bench_e2e optimistic-replies A/B leg (ISSUE
+18), mirroring test_bench_e2e_smoke: the optimistic reply plane — the
+signed-reply build on the execution lane, the structural release in the
+cert handler, the async-verify bookkeeping — gets a collection-time
+guard (the bench module must import) and a runtime guard (both the ON
+and OFF legs must order real traffic, and the ON leg must actually
+release slots optimistically).
+
+TPUBFT_THREADCHECK=1 arms utils/racecheck across the run so a
+lock-order inversion on the widened lane handoff (speculation now
+starts at PrePrepare acceptance) raises here instead of deadlocking
+production. The row follows the one-JSON-line convention with the PR 4
+`degraded`/`probe_error` fields."""
+import json
+
+import pytest
+
+
+@pytest.fixture
+def threadcheck(monkeypatch):
+    monkeypatch.setenv("TPUBFT_THREADCHECK", "1")
+    from tpubft.utils import racecheck
+    assert racecheck.enabled()
+    yield
+
+
+def test_bench_optimistic_smoke(threadcheck):
+    from benchmarks.bench_e2e import smoke_optimistic
+    row = smoke_optimistic(secs=2.0, clients=2)
+    # the row is one JSON line with the degraded/probe_error convention
+    line = json.loads(json.dumps(row))
+    assert {"degraded", "probe_error", "unit", "value"} <= set(line)
+    # both legs ordered real traffic and the plane really engaged
+    assert row["on_ops"] > 0 and row["off_ops"] > 0, row
+    assert row["opt_releases"] > 0, row
+    # honest cluster: no deferred certificate may fail
+    assert row["cert_async_failures"] == 0, row
+    assert not row["degraded"], row
+    # racecheck: no dispatcher/executor/lane stall during either leg
+    assert row["stall_reports"] == 0, row

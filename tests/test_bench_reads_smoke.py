@@ -21,6 +21,9 @@ def threadcheck(monkeypatch):
     yield
 
 
+# ~28 s; the thin-replica tests keep the verified-read plane pinned
+# in tier-1, the full bench smoke rides the slow suite
+@pytest.mark.slow
 def test_bench_reads_smoke(threadcheck):
     from benchmarks.bench_reads import smoke
     out = smoke(secs=2.0)

@@ -58,6 +58,11 @@ def test_matrix_names_unique_and_wellformed():
     tags = {s.name: set(s.tags) for s in specs}
     assert any({"compound", "view-change"} <= t for t in tags.values())
     assert sum(1 for t in tags.values() if "crashpoint" in t) >= 2
+    # ISSUE 18: the optimistic-reply blackout rides the smoke matrix
+    smoke_names = {s.name for s in cmp.smoke_matrix()}
+    assert "optimistic-reply-cert-blackout" in smoke_names
+    assert {"byzantine", "view-change", "optimistic-replies"} \
+        <= tags["optimistic-reply-cert-blackout"]
 
 
 def test_failing_scenario_yields_red_verdict_not_crash():
@@ -113,6 +118,24 @@ def test_agg_node_kill_scenario_replays_identically():
     first = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
     assert first["failed"] == 0, json.dumps(first["scenarios"], indent=1)
     assert first["scenarios"][0]["stats"]["fallbacks"] > 0
+    second = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,
+                               specs=[spec]).run()
+    assert second["failed"] == 0, json.dumps(second["scenarios"],
+                                             indent=1)
+    assert first["event_log_digest"] == second["event_log_digest"]
+
+
+def test_optimistic_blackout_scenario_replays_identically():
+    """ISSUE 18 acceptance: the optimistic-reply cert blackout — strict
+    clients time out while every commit share/cert is suppressed, the
+    cluster view-changes away from the equivocator, the optimistic
+    plane re-engages — green on two runs of the same seed with
+    byte-identical event-log digests."""
+    by_name = cmp.matrix_by_name()
+    spec = by_name["optimistic-reply-cert-blackout"]
+    first = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
+    assert first["failed"] == 0, json.dumps(first["scenarios"], indent=1)
+    assert first["scenarios"][0]["stats"]["opt_releases"] > 0
     second = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,
                                specs=[spec]).run()
     assert second["failed"] == 0, json.dumps(second["scenarios"],

@@ -75,6 +75,9 @@ def test_curve_parameters_mirror_device_kernels():
 
 # ---------------- scalar sign → device kernel verify ----------------
 
+# ~22 s of kernel compiles; every tpu-backend cluster test exercises
+# host-sign -> device-verify end to end in tier-1
+@pytest.mark.slow
 def test_scalar_ed25519_signs_for_the_kernel():
     from tpubft.ops import ed25519 as dev
     signers = [cpu.Ed25519Signer.generate(seed=b"xk%d" % i)

@@ -112,6 +112,9 @@ def test_tpu_bls_combine_matches_cpu(monkeypatch):
     assert cpu_v.verify(digest, combined_tpu)
 
 
+# ~23 s; the client-batch and forged-request tpu-backend tests below
+# keep device-path cluster ordering pinned in tier-1
+@pytest.mark.slow
 def test_cluster_orders_with_tpu_backend():
     """4-replica counter cluster, crypto_backend=tpu end to end: client
     sigs verified by the cross-principal device batch, commit certificates

@@ -70,7 +70,13 @@ def _corpus(curve, valid=3):
     return items, expected
 
 
-@pytest.mark.parametrize("curve", ["secp256k1", "secp256r1"])
+# the secp256r1 leg is ~47 s of kernel compiles on this host; the
+# secp256k1 leg keeps the cross-engine equivalence pin in tier-1
+# (and is the curve the GLV split applies to), r1 rides the slow suite
+@pytest.mark.parametrize("curve", [
+    "secp256k1",
+    pytest.param("secp256r1", marks=pytest.mark.slow),
+])
 def test_three_way_verdict_equivalence(curve):
     items, expected = _corpus(curve)
     want = [scalar.ecdsa_verify(pk, m, s, curve) for m, s, pk in items]

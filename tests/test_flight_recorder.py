@@ -113,7 +113,7 @@ def test_fold_stage_math():
     stages = SlotTracker.fold(slot)
     assert stages == {"adm_wait": 2.0, "dispatch": 1.0, "prepare": 7.0,
                       "commit": 5.0, "exec": 10.0, "reply": 1.0,
-                      "spec_overlap": 0.0}
+                      "spec_overlap": 0.0, "cert_lag": 0.0}
     # fast path: no prepare quorum — prepare reads 0, commit runs from
     # accept; a primary self-proposal has no admit/handler anchors
     fast = {"accept": t0, "committed": t0 + 4_000_000,
@@ -168,6 +168,29 @@ def test_slot_tracker_folds_recorded_lifecycle():
     # a replay of EV_REPLY for an already-folded slot is ignored
     flight.record(flight.EV_REPLY, seq=10)
     assert flight.stage_summary()["completed"] == 3
+
+
+def test_late_commit_after_reply_does_not_resurrect_slot():
+    """Optimistic replies reorder the lifecycle: the slot finalizes on
+    EV_REPLY and the verified-commit EV_COMMITTED (plus any straggler
+    stage event) lands afterwards. Late events on a folded slot must be
+    dropped, not spawn a ghost live entry that never finalizes."""
+    flight.reset()
+    tr = flight.slot_tracker()
+    t0 = 1_000_000_000
+    tr.on_event(7, flight.EV_PP_ACCEPT, 9, 0, 0, t0)
+    tr.on_event(7, flight.EV_EXEC_APPLY, 9, 0, 1, t0 + 1_000_000)
+    tr.on_event(7, flight.EV_REPLY, 9, 0, 0, t0 + 2_000_000)
+    assert tr.summary(rid=7)["completed"] == 1
+    # the deferred certificate verifies after the client already replied
+    tr.on_event(7, flight.EV_COMMITTED, 9, 0, 0, t0 + 9_000_000)
+    tr.on_event(7, flight.EV_PREPARED, 9, 0, 0, t0 + 9_100_000)
+    s = tr.summary(rid=7)
+    assert s["live"] == 0 and s["completed"] == 1
+    # a slot never seen before still opens a live entry as usual
+    tr.on_event(7, flight.EV_COMMITTED, 10, 0, 0, t0 + 9_200_000)
+    assert tr.summary(rid=7)["live"] == 1
+    tr.reset()
 
 
 def test_slot_tracker_live_bound():

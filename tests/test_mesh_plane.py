@@ -106,6 +106,10 @@ def test_shard_cap_1_degenerates_to_single_device_sha256():
 # per-chip breaker: eviction keeps the plane batched, then re-admits
 # ---------------------------------------------------------------------
 
+# ~35 s of mesh recompiles on this host: eviction/re-admission also
+# rides the slow-suite mesh-chip-fault-flood chaos scenario; the
+# cheaper mesh tests keep the sharded plane pinned in tier-1
+@pytest.mark.slow
 def test_chip_fault_evicts_chip_not_the_plane():
     mgr = _require_mesh()
     items, want = _ed_items(64)

@@ -1,0 +1,198 @@
+"""Optimistic reply plane (ISSUE 18 acceptance).
+
+Covers: on/off ledger equivalence — byte-identical ledger blocks,
+state digest and reply-ring pages with `optimistic_replies` on vs off
+(the plane changes WHEN the client hears back, never WHICH bytes land),
+including an abort-heavy schedule behind a genuinely equivocating
+primary (speculative runs staged at PrePrepare acceptance abort when
+the view change resolves the other fork); clients running strict
+`require_signed_replies` accept the f+1 individually-signed replies;
+and the durability gate — a backup's signed optimistic reply is only
+sent at/after the group-commit watermark (held pipelines mean NO ack,
+exactly like the certificate-gated plane of ISSUE 15)."""
+import threading
+import time
+
+import pytest
+
+from tpubft.apps import skvbc
+from tpubft.consensus.persistent import FilePersistentStorage
+from tpubft.kvbc import KeyValueBlockchain
+from tpubft.storage.memorydb import MemoryDB
+from tpubft.testing.cluster import InProcessCluster
+
+_FAST_VC = {"view_change_timer_ms": 900}
+
+
+def _wait(pred, timeout=25.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _kv_cluster(tmp_path, dbs, byzantine=None, **overrides):
+    def handler_factory(r):
+        db = dbs.setdefault(r, MemoryDB())
+        return skvbc.SkvbcHandler(
+            KeyValueBlockchain(db, use_device_hashing=False))
+
+    def storage_factory(r):
+        return FilePersistentStorage(str(tmp_path / f"r{r}.wal"))
+
+    return InProcessCluster(f=1, handler_factory=handler_factory,
+                            storage_factory=storage_factory,
+                            byzantine=byzantine,
+                            cfg_overrides=overrides or None)
+
+
+def _run_workload(tmp_path, sub, n_writes=6, byzantine=None,
+                  timeout_ms=15000, **overrides):
+    """Sequential single-key writes (one block per write), deterministic
+    ledger bytes; returns the observable artifacts the optimistic plane
+    must NOT change."""
+    dbs = {}
+    subdir = tmp_path / sub
+    subdir.mkdir()
+    with _kv_cluster(subdir, dbs, byzantine=byzantine,
+                     **overrides) as cluster:
+        strict = bool(overrides.get("optimistic_replies"))
+        cl = cluster.client(0, require_signed_replies=strict)
+        cl._req_seq = 1_000_000     # pin reply-ring page comparability
+        kv = skvbc.SkvbcClient(cl)
+        for i in range(n_writes):
+            assert kv.write([(b"k%d" % i, b"v%d" % i)],
+                            timeout_ms=timeout_ms).success
+        # compare a replica that is honest in BOTH runs (0 is the
+        # byzantine primary in the abort-heavy schedule)
+        ref = 1 if byzantine else 0
+        assert _wait(lambda:
+                     cluster.handlers[ref].blockchain.last_block_id
+                     == n_writes)
+        bc = cluster.handlers[ref].blockchain
+        assert _wait(lambda: cluster.metric(
+            ref, "counters", "dur_groups", component="durability") > 0)
+        opt_fired = sum(
+            cluster.metric(r, "counters", "optimistic_releases")
+            for r in range(cluster.n) if r != 0 or not byzantine)
+        aborts = sum(
+            cluster.metric(r, "counters", "exec_spec_aborts")
+            for r in range(cluster.n) if r != 0 or not byzantine)
+        pages = cluster.replicas[ref].res_pages
+        ring = sorted((k, v) for k, v in pages.all_pages()
+                      if k[2:].startswith((b"clientreplies", b"clients")))
+        return {
+            "state_digest": bc.state_digest(),
+            "reply_pages": ring,
+            "blocks": [bc.get_raw_block(b)
+                       for b in range(1, n_writes + 1)],
+            "opt_fired": opt_fired,
+            "spec_aborts": aborts,
+        }
+
+
+def test_optimistic_on_off_ledger_equivalence(tmp_path):
+    """Same sequential workload with the optimistic reply plane on
+    (strict signed-reply client) vs off: byte-identical ledger blocks,
+    state digest, and reply-ring pages. The ON run must actually have
+    exercised the plane (optimistic_releases fired)."""
+    on = _run_workload(tmp_path, "on", optimistic_replies=True)
+    off = _run_workload(tmp_path, "off", optimistic_replies=False)
+    assert on["opt_fired"] > 0, \
+        "optimistic plane never released a slot — test proved nothing"
+    assert off["opt_fired"] == 0
+    assert on["state_digest"] == off["state_digest"]
+    assert on["reply_pages"] and on["reply_pages"] == off["reply_pages"]
+    assert on["blocks"] == off["blocks"]
+
+
+# ~13 s (view-change schedule): the clean on/off equivalence test above
+# keeps the byte-identical pin in tier-1; the abort-heavy variant and
+# the optimistic-reply-cert-blackout chaos scenario ride the slow suite
+@pytest.mark.slow
+def test_optimistic_equivalence_abort_heavy(tmp_path):
+    """Abort-heavy schedule: an equivocating primary forks every
+    PrePrepare, so backups speculate (now staged at PP ACCEPTANCE, the
+    earliest point) on forks the view change then discards. Optimistic
+    on vs off must still produce byte-identical ledgers and reply
+    pages, and the honest replicas must have actually aborted
+    speculative runs in the ON schedule."""
+    on = _run_workload(tmp_path, "on", n_writes=3,
+                       byzantine={0: "equivocate"}, timeout_ms=45000,
+                       optimistic_replies=True, **_FAST_VC)
+    off = _run_workload(tmp_path, "off", n_writes=3,
+                        byzantine={0: "equivocate"}, timeout_ms=45000,
+                        optimistic_replies=False, **_FAST_VC)
+    assert on["spec_aborts"] > 0, \
+        "equivocation schedule produced no speculative aborts"
+    assert on["state_digest"] == off["state_digest"]
+    assert on["reply_pages"] and on["reply_pages"] == off["reply_pages"]
+    assert on["blocks"] == off["blocks"]
+
+
+def test_optimistic_reply_never_precedes_group_fsync(tmp_path):
+    """The optimistic plane removes the CERTIFICATE wait from the reply
+    path, never the DURABILITY wait: hold every replica's io thread and
+    the signed optimistic reply must not reach the client, nor
+    last_executed advance past the watermark; release delivers the same
+    write (PR 15 semantics, ISSUE 18 tentpole b)."""
+    dbs = {}
+    with _kv_cluster(tmp_path, dbs, durability_window_us=0,
+                     optimistic_replies=True) as cluster:
+        kv = skvbc.SkvbcClient(
+            cluster.client(0, require_signed_replies=True))
+        assert kv.write([(b"warm", b"w")], timeout_ms=15000).success
+        assert _wait(lambda: all(
+            cluster.replicas[r].last_executed >= 1
+            and cluster.replicas[r].durability.idle()
+            for r in range(4)))
+        base = [cluster.replicas[r].last_executed for r in range(4)]
+        for r in range(4):
+            cluster.replicas[r].durability.hold()
+        box = {}
+
+        def bg_write():
+            box["r"] = kv.write([(b"gated", b"g")], timeout_ms=30000)
+
+        t = threading.Thread(target=bg_write, daemon=True)
+        t.start()
+        time.sleep(1.5)
+        # optimistically released + executed (sealed) but NOT durable:
+        # no signed reply, no watermark move
+        assert "r" not in box, \
+            "optimistic reply preceded its group's fsync"
+        for r in range(4):
+            rep = cluster.replicas[r]
+            assert rep.last_executed == base[r], \
+                "last_executed advanced past the durability watermark"
+            assert rep.last_executed <= rep.durability.watermark
+        for r in range(4):
+            cluster.replicas[r].durability.release()
+        t.join(30)
+        assert box.get("r") is not None and box["r"].success
+        for r in range(4):
+            rep = cluster.replicas[r]
+            assert _wait(lambda rep=rep:
+                         rep.last_executed <= rep.durability.watermark
+                         and rep.durability.idle(), 10)
+
+
+def test_unsigned_reply_rejected_by_strict_client(tmp_path):
+    """A strict client (`require_signed_replies`) must drop the
+    unsigned replies a certificate-gated cluster sends: the write times
+    out instead of being accepted on unvouched data."""
+    from tpubft.bftclient.client import TimeoutError_
+    dbs = {}
+    with _kv_cluster(tmp_path, dbs,
+                     optimistic_replies=False) as cluster:
+        kv = skvbc.SkvbcClient(
+            cluster.client(0, require_signed_replies=True))
+        with pytest.raises(TimeoutError_):
+            # a write normally acks in well under a second here — 1.2 s
+            # of silence is the starvation signal, not a flaky margin
+            kv.write([(b"x", b"1")], timeout_ms=1200)
+        # the cluster itself executed fine — only acceptance failed
+        assert _wait(lambda:
+                     cluster.handlers[0].blockchain.last_block_id >= 1)

@@ -48,6 +48,13 @@ class ClientConfig:
     retry_timeout_ms: int = 250
     retry_max_ms: int = 2000
     request_timeout_ms: int = 10000
+    # optimistic-reply contract (ISSUE 18): a SIGNED reply is verified
+    # against the sender's ed25519 key and dropped on mismatch, always.
+    # With require_signed_replies the client additionally ignores
+    # UNSIGNED replies — the strict mode for clusters known to run
+    # optimistic_replies, where an unsigned reply can only come from a
+    # replica that skipped the vouching step (or an impersonator)
+    require_signed_replies: bool = False
 
 
 def decorrelated_backoff(base_s: float, cap_s: float, prev_s: float,
@@ -81,6 +88,10 @@ class BftClient(IReceiver):
         self._quorum_needed: Dict[int, int] = {}
         self._primary_hint = 0      # learned from replies' current_primary
         self._started = False
+        # per-replica reply verifiers, built lazily (optimistic replies:
+        # f+1 MATCHING SIGNED replies is the acceptance rule — each
+        # signature must check out before the reply may count)
+        self._verifiers: Dict[int, object] = {}
 
     def start(self) -> None:
         if not self._started:
@@ -98,6 +109,23 @@ class BftClient(IReceiver):
         except m.MsgError:
             return
         if not isinstance(msg, m.ClientReplyMsg) or msg.sender_id != sender:
+            return
+        if msg.signature:
+            # optimistic reply: no certificate backs it, the replica's
+            # own signature does — verify before it may count toward
+            # the matching quorum (a forged/garbled one is dropped,
+            # never cached: the honest replica's real reply must not be
+            # shadowed by a same-sender forgery)
+            try:
+                v = self._verifiers.get(sender)
+                if v is None:
+                    v = self._verifiers[sender] = \
+                        self.keys.verifier_of(sender)
+                if not v.verify(msg.signed_payload(), msg.signature):
+                    return
+            except Exception:  # noqa: BLE001 — bad sig == drop
+                return
+        elif self.cfg.require_signed_replies:
             return
         with self._lock:
             needed = self._quorum_needed.get(msg.req_seq_num)

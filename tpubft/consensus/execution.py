@@ -111,6 +111,12 @@ class _SpecRun:
     abort: bool = False
     acc: bool = False                     # accumulation bracket open
     span: Optional[object] = None
+    # checkpoint-boundary digests PRECOMPUTED at staging (ISSUE 18a):
+    # (seq, state_digest, head) — the lane parks between staging and
+    # seal, so the handler state cannot move; riding them on the
+    # speculation overlaps the expensive state digest with the combine
+    # window instead of paying it synchronously at the seal
+    ckpt_pre: Optional[Tuple[int, bytes, Optional[int]]] = None
 
 
 class ExecutionLane:
@@ -530,6 +536,15 @@ class ExecutionLane:
                 self._execute_slot(seq, pp, sp.pages_wb, sp.result,
                                    sp.executed_now)
                 sp.result.last = seq
+            if sp.result.last % self._ckpt_window == 0:
+                # checkpoint boundary: precompute the state digest NOW,
+                # inside the combine window, instead of at the seal.
+                # Read-your-writes: the owner thread sees the overlay's
+                # state and speculative head, which the seal commits
+                # unchanged (the lane parks in between). res_pages
+                # digest stays at the seal — pages_wb is not applied yet
+                sp.ckpt_pre = (sp.result.last, r.handler.state_digest(),
+                               getattr(blockchain, "last_block_id", None))
         except BaseException:  # noqa: BLE001 — discard + retry
             log.exception("speculative staging [%d..%d] failed; "
                           "overlay discarded", sp.first, sp.last)
@@ -592,7 +607,7 @@ class ExecutionLane:
         blockchain = getattr(self._r.handler, "blockchain", None)
         self._apply_run(sp.last - sp.first + 1, sp.result, sp.pages_wb,
                         sp.executed_now, blockchain, sp.acc, sp.span,
-                        spec_overlap_ms=overlap_ms)
+                        spec_overlap_ms=overlap_ms, ckpt_pre=sp.ckpt_pre)
 
     # ------------------------------------------------------------------
     # normal (committed) run execution
@@ -636,7 +651,9 @@ class ExecutionLane:
     def _apply_run(self, run_len: int, result: CompletedRun,
                    pages_wb: WriteBatch, executed_now, blockchain,
                    acc: bool, span,
-                   spec_overlap_ms: Optional[float] = None) -> None:
+                   spec_overlap_ms: Optional[float] = None,
+                   ckpt_pre: Optional[Tuple[int, bytes,
+                                            Optional[int]]] = None) -> None:
         """Coalesced apply: ONE ledger commit + ONE pages batch per run
         (a single atomic batch when they share a DB). Everything up to
         and including the LEDGER commit point is retriable
@@ -722,14 +739,22 @@ class ExecutionLane:
             # the next run mutates state
             if result.last % self._ckpt_window == 0:
                 try:
-                    state_digest = r.handler.state_digest()
+                    if ckpt_pre is not None and ckpt_pre[0] == result.last:
+                        # digests rode the speculation (precomputed at
+                        # staging while the combine was still in flight):
+                        # the boundary no longer forces a synchronous
+                        # state walk at the seal
+                        _, state_digest, head = ckpt_pre
+                    else:
+                        state_digest = r.handler.state_digest()
+                        # ledger height snapshotted WITH the digest
+                        # (same thread, same boundary): resolves the
+                        # certified digest to a block for the
+                        # thin-replica anchor
+                        head = getattr(blockchain, "last_block_id", None)
                     if r.state_transfer is not None:
                         r.state_transfer.on_checkpoint_created(
                             result.last, state_digest)
-                    # ledger height snapshotted WITH the digest (same
-                    # thread, same boundary): resolves the certified
-                    # digest to a block for the thin-replica anchor
-                    head = getattr(blockchain, "last_block_id", None)
                     result.checkpoint = (result.last, state_digest,
                                          r.res_pages.digest(), head)
                 except Exception:  # noqa: BLE001 — skip OUR checkpoint

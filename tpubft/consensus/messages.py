@@ -200,12 +200,22 @@ class ClientReplyMsg(ConsensusMsg):
     reply: bytes
     replica_specific_info: bytes  # RSI — differs per replica, excluded from
                                   # quorum matching (reference rsiLength)
+    # per-replica signature over the preceding fields (trailing, so
+    # signed_payload() covers everything before it). Empty on the
+    # certificate-backed path; populated under optimistic replies
+    # (ReplicaConfig.optimistic_replies), where the client's f+1
+    # matching quorum rests on these individual signatures instead of
+    # the threshold certificate (arXiv 2407.12172). The canonical
+    # persisted reply-ring form always zeroes it, so ledger/page bytes
+    # are identical with the mode on or off.
+    signature: bytes = b""
     SPEC = [("sender_id", "u32"), ("req_seq_num", "u64"),
             ("current_primary", "u32"), ("reply", "bytes"),
-            ("replica_specific_info", "bytes")]
+            ("replica_specific_info", "bytes"), ("signature", "bytes")]
 
     def matching_digest(self) -> bytes:
-        """Digest over the parts that must match across replicas."""
+        """Digest over the parts that must match across replicas (the
+        per-replica signature and RSI are excluded)."""
         return sha256(struct.pack("<Q", self.req_seq_num) + self.reply)
 
 

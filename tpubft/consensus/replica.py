@@ -461,6 +461,14 @@ class Replica(IReceiver):
             "exec_spec_aborts")
         self.m_exec_spec_overlap = self.metrics.register_gauge(
             "exec_spec_overlap_ms")
+        # optimistic reply plane: slots released to the client-visible
+        # path on a structurally-valid commit cert before its pairing
+        # verify landed, and deferred verifies that came back BAD on a
+        # slot already released (poisons the plane for the view)
+        self.m_opt_replies = self.metrics.register_counter(
+            "optimistic_releases")
+        self.m_cert_async_fails = self.metrics.register_counter(
+            "cert_async_failures")
         # fused combine plane: flushes drained and slots combined —
         # combined_slots / combine_batches is the amortization factor
         # (the `status get kernels` bls_msm batch stats show the same
@@ -617,6 +625,21 @@ class Replica(IReceiver):
         # speculatively-submitted slots whose commit certificate has not
         # confirmed yet, in seq order; dispatcher-thread only
         self._spec_inflight: List[int] = []
+        # --- optimistic reply plane (ISSUE 18 / ROADMAP item 4) ---
+        # replies go out on a STRUCTURALLY-valid commit cert while the
+        # pairing verify runs behind; requires async verification (the
+        # deferred check IS the async job) and is reply-visibility only
+        self._opt_replies = bool(cfg.optimistic_replies
+                                 and cfg.async_verification)
+        # a deferred verify that fails on an already-released slot
+        # poisons the plane until the next view change (forged certs
+        # mean an active equivocator — stop trusting structure alone)
+        self._opt_poisoned = False
+        # contiguous frontier of slots whose commit certificate has
+        # VERIFIED (not just structurally accepted): in optimistic mode
+        # the persisted last_executed watermark is clamped to this, so a
+        # restart never resumes past evidence that was still in flight
+        self._verified_upto = self.last_executed
         # speculation needs a rollback substrate: the lane, an
         # accumulation-capable ledger behind the handler (handlers
         # without one — e.g. the counter app — apply irreversibly during
@@ -1334,6 +1357,11 @@ class Replica(IReceiver):
                 sender_id=self.id, req_seq_num=req.req_seq_num,
                 current_primary=self.primary, reply=payload,
                 replica_specific_info=b"")
+            if self._opt_replies:
+                # optimistic plane: reads need the same per-replica
+                # vouching as writes — a strict client accepts nothing
+                # short of f+1 matching SIGNED replies
+                reply.signature = self.sig.sign(reply.signed_payload())
             self.comm.send(client, reply.pack())
             return
         cached = self.clients.cached_reply(client, req.req_seq_num)
@@ -1610,11 +1638,11 @@ class Replica(IReceiver):
             self._send_partial_commit_proof(info)
         self._drain_early_shares(info)
         self._drain_early_certs(info)
-        # fast-path proposals have no prepare round: their combine
-        # window opens HERE, so speculation starts at acceptance (the
-        # slow path waits for prepare-quorum — _accept_prepare_full).
-        # After the early-evidence drains: a slot that just committed
-        # from buffered certs takes the normal path instead.
+        # speculation starts HERE on every path (ISSUE 18a): the
+        # combine window opens at acceptance and the overlay covers the
+        # whole prepare+commit round. After the early-evidence drains: a
+        # slot that just committed from buffered certs takes the normal
+        # path instead.
         self._pump_speculation()
 
     # ------------------------------------------------------------------
@@ -2121,6 +2149,23 @@ class Replica(IReceiver):
         if info.committed or (kind == "prepare" and info.prepared):
             return
         verifier, d = tools
+        # --- optimistic release (ISSUE 18): the structural check above
+        # bound this cert to OUR accepted PrePrepare's digest; on the
+        # slow path a VERIFIED prepare certificate (2f+c+1) already
+        # vouches for the batch. Release the slot to the client-visible
+        # path now and let the pairing verify land behind — a later BAD
+        # verdict poisons the plane (see _on_cert_verified) but commits
+        # still gate last_executed persistence (_apply_exec_runs clamp).
+        if self._opt_replies and self.cfg.async_verification \
+                and not self._opt_poisoned and not info.opt_committed \
+                and kind != "prepare" \
+                and (kind == "fast" or info.prepared):
+            info.opt_committed = True
+            info.opt_committed_ns = time.monotonic_ns()
+            flight.record(flight.EV_OPT_REPLY, seq=msg.seq_num,
+                          view=msg.view, arg=1 if kind == "fast" else 0)
+            self.m_opt_replies.inc()
+            self._execute_committed()
         if not self.cfg.async_verification:
             if self._verify_cert_inline(verifier, d, msg.sig):
                 self._accept_cert(msg, kind)
@@ -2173,6 +2218,19 @@ class Replica(IReceiver):
             tools = self._cert_tools(msg, kind)
             if tools is not None and tools != "early":
                 self._accept_cert(msg, kind)
+        elif (info is not None and info.opt_committed
+                and not info.committed and kind != "prepare"):
+            # the deferred pairing check FAILED on a slot we already
+            # released optimistically: an actively-forging peer slipped a
+            # structurally-valid cert past us. The reply the client got
+            # is still backed by a verified prepare quorum / matching
+            # f+1 replies client-side, but stop trusting structure alone
+            # until the view changes away from whoever is forging
+            self._opt_poisoned = True
+            self.m_cert_async_fails.inc()
+            log.error("deferred cert verify FAILED on optimistically "
+                      "released slot %d (kind=%s) — optimistic plane "
+                      "poisoned until next view change", msg.seq_num, kind)
         # certs parked while this job was in flight get their turn now
         # (one may be the genuine one if this verdict was a forgery's);
         # the first re-handled becomes the next in-flight job, the rest
@@ -2213,13 +2271,38 @@ class Replica(IReceiver):
         with self._tran() as st:
             st.seq(msg.seq_num).prepare_full = msg.pack()
         self._send_commit_partial(info)
-        # prepare-quorum: 2f+c+1 replicas vouch for this batch while the
-        # commit shares are still combining — the speculation window the
-        # ROADMAP item names (slow path)
+        # speculation normally started at PP acceptance (ISSUE 18a);
+        # this re-pump catches slots that could not speculate then
+        # (e.g. ordered behind a barrier batch that has since drained)
         self._pump_speculation()
 
     def _on_commit_full(self, msg: m.CommitFullMsg) -> None:
         self._handle_full_cert(msg, "commit")
+
+    def _note_cert_verified(self, info: SeqNumInfo) -> None:
+        """Async-certificate bookkeeping (optimistic mode): the slot's
+        commit certificate finished its deferred pairing verify. Records
+        how long the certificate trailed the optimistic release and
+        advances the verified frontier that clamps the persisted
+        last_executed watermark (min of two monotone counters)."""
+        if not self._opt_replies:
+            return
+        if info.opt_committed:
+            lag_us = max(
+                0, (time.monotonic_ns() - info.opt_committed_ns) // 1000)
+            flight.record(flight.EV_CERT_ASYNC_DONE, seq=info.seq_num,
+                          view=self.view)
+            flight.record(flight.EV_CERT_ASYNC_LAG, seq=info.seq_num,
+                          view=self.view, arg=lag_us)
+        # contiguous walk: committed ⇒ verified (commits only flip via
+        # _accept_cert after the verify verdict / stable checkpoint)
+        v = max(self._verified_upto, self.last_stable)
+        while True:
+            nxt = self.window.peek(v + 1)
+            if nxt is None or not nxt.committed:
+                break
+            v += 1
+        self._verified_upto = v
 
     def _accept_commit_full(self, msg: m.CommitFullMsg) -> None:
         info = self.window.get(msg.seq_num)
@@ -2230,6 +2313,7 @@ class Replica(IReceiver):
         info.commit_full = msg
         info.committed = True
         self.m_slow_commits.inc()
+        self._note_cert_verified(info)
         if self.is_primary and info.pre_prepare is not None:
             if info.pre_prepare.first_path != int(m.CommitPath.SLOW):
                 self.controller.on_slow_fallback(msg.seq_num)
@@ -2254,6 +2338,7 @@ class Replica(IReceiver):
         info.full_commit_proof = msg
         info.committed = True
         self.m_fast_commits.inc()
+        self._note_cert_verified(info)
         if self.is_primary:
             self.controller.on_fast_path_commit(msg.seq_num)
         with self._tran() as st:
@@ -2445,7 +2530,8 @@ class Replica(IReceiver):
                 # the committed path
                 self._abort_speculation("window-moved")
                 break
-            if not info.committed:
+            if not info.committed \
+                    and not (self._opt_replies and info.opt_committed):
                 break
             if self.exec_lane.confirm(nxt, info.pre_prepare.digest()):
                 self._spec_inflight.pop(0)
@@ -2468,10 +2554,18 @@ class Replica(IReceiver):
                 self._maybe_announce_restart_ready()
                 break
             info = self.window.peek(nxt)
-            if info is None or not info.committed or info.executed \
+            if info is None or info.executed \
                     or info.exec_submitted or info.spec_submitted:
                 break
+            if not info.committed \
+                    and not (self._opt_replies and info.opt_committed):
+                break
             if self._batch_needs_dispatcher(info.pre_prepare):
+                # barrier batches (INTERNAL/RECONFIG) mutate
+                # dispatcher-owned subsystems irreversibly: they wait
+                # for the VERIFIED commit even under optimistic replies
+                if not info.committed:
+                    break
                 if self._spec_inflight:
                     # speculative slots ahead of the barrier are still
                     # awaiting their commits: the barrier cannot run yet
@@ -2499,13 +2593,16 @@ class Replica(IReceiver):
         self._pump_speculation()
 
     def _pump_speculation(self) -> None:
-        """Hand every next consecutive NOT-yet-committed slot with
-        enough evidence to the lane as SPECULATIVE: prepare-quorum on
-        the slow path, PrePrepare acceptance on the fast paths (whose
-        combine window opens at acceptance). The lane executes it into
-        a never-durable overlay while the threshold shares combine;
-        replies and last_executed stay strictly post-commit (the seal).
-        Barrier batches (INTERNAL/RECONFIG) never speculate."""
+        """Hand every next consecutive NOT-yet-committed slot to the
+        lane as SPECULATIVE at PrePrepare ACCEPTANCE — on every path
+        (ISSUE 18a; previously the slow path waited for its
+        prepare-quorum). The overlay now covers the whole
+        prepare+commit window; abort safety is unchanged (the overlay
+        is never durable and the seal still requires the committed
+        digest to confirm). Replies and last_executed stay strictly
+        post-commit — post-release under optimistic replies, where the
+        structural cert + verified prepare quorum stand in. Barrier
+        batches (INTERNAL/RECONFIG) never speculate."""
         if not self._spec_enabled or self.exec_lane is None \
                 or not self._running or self.in_view_change:
             return
@@ -2676,7 +2773,19 @@ class Replica(IReceiver):
         # integrate in group-sized batches, so the dispatcher's fsync
         # rate drops by the group factor too
         with self._tran() as st:
-            st.last_executed_seq = self.last_executed
+            if self._opt_replies:
+                # optimistic mode: never persist past the verified-commit
+                # frontier — a restart must not resume from a watermark
+                # supported only by structurally-accepted (unverified)
+                # certificates. Re-executing the durable-but-unpersisted
+                # tail is replay-safe: the reply ring's at-most-once
+                # dedup skips it (min of two monotones stays monotone)
+                self._verified_upto = max(self._verified_upto,
+                                          self.last_stable)
+                st.last_executed_seq = min(self.last_executed,
+                                           self._verified_upto)
+            else:
+                st.last_executed_seq = self.last_executed
         crashpoint("meta.watermark", rid=self.id)
         self._maybe_announce_restart_ready()
         self._try_send_pre_prepare()
@@ -2729,6 +2838,13 @@ class Replica(IReceiver):
         reply = m.ClientReplyMsg(sender_id=self.id, req_seq_num=req_seq,
                                  current_primary=self.primary, reply=payload,
                                  replica_specific_info=b"")
+        if self._opt_replies:
+            # optimistic replies: the client can no longer lean on the
+            # certificate, so each replica vouches individually — f+1
+            # MATCHING SIGNED replies is the client's acceptance rule.
+            # sign() is thread-safe (pure signer + counter), so the
+            # execution lane may call this off the dispatcher
+            reply.signature = self.sig.sign(reply.signed_payload())
         # at-most-once state rides reserved pages so it survives crashes
         # AND state transfer (reference keeps client replies in res pages).
         # Persist a CANONICAL form — per-replica fields (sender, primary)
@@ -3508,6 +3624,9 @@ class Replica(IReceiver):
         self.in_view_change = False
         self.pending_view = None
         self._pending_entry = None
+        # the forger (if any) that poisoned the optimistic plane is the
+        # old view's problem; the new view starts trusting again
+        self._opt_poisoned = False
         self.restrictions = restrictions
         self.m_view.set(new_view)
         log.info("entered view %d (primary=%d, %d restricted seqnums)",

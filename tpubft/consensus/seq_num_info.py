@@ -35,6 +35,11 @@ class SeqNumInfo:
     prepared: bool = False
     committed: bool = False
     executed: bool = False
+    # optimistic reply plane: the slot was released to the client-visible
+    # path on a STRUCTURALLY-valid commit cert (pairing verify still in
+    # flight) — reply visibility only, `committed` still gates persistence
+    opt_committed: bool = False
+    opt_committed_ns: int = 0                  # monotonic_ns at release
     # slot handed to the execution lane (run in flight or queued): the
     # dispatcher's guard against double-submitting a slot whose
     # committed certificate is re-accepted while the lane still owns it

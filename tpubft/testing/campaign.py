@@ -751,6 +751,104 @@ def scenario_spec_abort_equivocation(ctx: ScenarioContext) -> dict:
     return {"recovery_s": round(recovery, 3), "spec_aborts": aborts}
 
 
+def scenario_optimistic_reply_cert_blackout(ctx: ScenarioContext) -> dict:
+    """ISSUE 18: equivocating primary + a full commit-share/certificate
+    blackout under `optimistic_replies`. The optimistic plane serves
+    clients from f+1 matching INDIVIDUALLY-SIGNED replies — but a
+    release still requires a structurally-valid commit certificate, so
+    with every commit-path message suppressed no replica executes and a
+    strict client must time out rather than accept anything weaker than
+    its f+1 signed quorum. After the heal the cluster view-changes away
+    from the equivocator, the write commits, the honest replicas
+    converge byte-identically, and the optimistic plane re-engages
+    (releases fire on the new view's certificates)."""
+    from tpubft.apps import skvbc
+    from tpubft.bftclient.client import TimeoutError_
+    from tpubft.consensus import messages as m
+    from tpubft.kvbc import KeyValueBlockchain
+    from tpubft.storage.memorydb import MemoryDB
+    from tpubft.testing.cluster import InProcessCluster
+    dbs: dict = {}
+
+    def handler_factory(r):
+        db = dbs.setdefault(r, MemoryDB())
+        return skvbc.SkvbcHandler(
+            KeyValueBlockchain(db, use_device_hashing=False))
+
+    # every message that can carry commit shares or a formed commit
+    # certificate — slow path, fast path, and the PR 17 aggregation
+    # overlay (message code = first two LE header bytes)
+    cert_codes = {int(c) for c in (
+        m.MsgCode.CommitPartial, m.MsgCode.CommitFull,
+        m.MsgCode.PartialCommitProof, m.MsgCode.FullCommitProof,
+        m.MsgCode.AggregateShare)}
+    healed = threading.Event()
+
+    def blackout(s, d, data):
+        if not healed.is_set() \
+                and int.from_bytes(data[:2], "little") in cert_codes:
+            return None
+        return data
+
+    cfg = dict(_FAST_VC)
+    cfg["optimistic_replies"] = True
+    ctx.event("byzantine", replica=0, strategy="equivocate")
+    ctx.event("blackout", what="commit-shares+certs")
+    key = b"lit-%d" % ctx.randint("key", 1, 999)
+    with InProcessCluster(f=1, seed=ctx.cluster_seed(),
+                          cfg_overrides=cfg,
+                          handler_factory=handler_factory,
+                          byzantine={0: "equivocate"}) as cluster:
+        cluster.bus.add_hook(blackout)
+        kv = skvbc.SkvbcClient(
+            cluster.client(0, require_signed_replies=True))
+        # dark phase: certs cannot form, so nothing executes and no
+        # signed reply exists anywhere — acceptance on anything short of
+        # f+1 matching signatures would be the bug this scenario hunts
+        try:
+            kv.write([(b"dark", b"0")], timeout_ms=2500)
+            raise AssertionError(
+                "client accepted a write during the cert blackout")
+        except TimeoutError_:
+            pass
+        for i in (1, 2, 3):
+            assert cluster.replicas[i].last_executed == 0, (
+                f"replica {i} executed without a commit certificate "
+                "during the blackout")
+            assert cluster.metric(
+                i, "counters", "optimistic_releases") == 0, (
+                f"replica {i} optimistically released a slot with the "
+                "cert plane dark")
+        ctx.event("heal")
+        healed.set()
+        t0 = time.monotonic()
+        r = kv.write([(key, b"committed")], timeout_ms=60000)
+        recovery = time.monotonic() - t0
+        assert r.success, "cluster never recovered from the blackout"
+        for i in (1, 2, 3):
+            assert cluster.replicas[i].view >= 1, \
+                f"replica {i} never left the equivocating primary's view"
+        # the optimistic plane re-engages on the new view's certs
+        ctx.wait_until(
+            lambda: sum(cluster.metric(i, "counters",
+                                       "optimistic_releases")
+                        for i in (1, 2, 3)) > 0,
+            15, what="optimistic releases after heal")
+        # honest replicas converge byte-identically (the dark write may
+        # or may not have survived in queues — they must only AGREE)
+        ctx.wait_until(
+            lambda: len({(cluster.handlers[i].blockchain.last_block_id,
+                          cluster.handlers[i].blockchain.state_digest())
+                         for i in (1, 2, 3)}) == 1,
+            20, what="honest ledgers converge after the blackout")
+        val = kv.read([key])
+        assert val == {key: b"committed"}, val
+        releases = sum(cluster.metric(i, "counters",
+                                      "optimistic_releases")
+                       for i in (1, 2, 3))
+    return {"recovery_s": round(recovery, 3), "opt_releases": releases}
+
+
 def scenario_crashpoint_exec_post_apply(ctx: ScenarioContext) -> dict:
     """Crashpoint drill 1 — exec.post_apply: a replica dies after the
     run's durable apply but before watermark/bookkeeping. Recovery from
@@ -1238,6 +1336,10 @@ def smoke_matrix() -> List[ScenarioSpec]:
                      scenario_spec_abort_equivocation,
                      "inproc", 90, tags=("byzantine", "view-change",
                                          "speculation")),
+        ScenarioSpec("optimistic-reply-cert-blackout",
+                     scenario_optimistic_reply_cert_blackout,
+                     "inproc", 120, tags=("byzantine", "view-change",
+                                          "optimistic-replies")),
         ScenarioSpec("fused-flush-bad-share", scenario_fused_flush_bad_share,
                      "inproc", 90, tags=("byzantine", "combine")),
         ScenarioSpec("autotune-stability", scenario_autotune_stability,

@@ -131,6 +131,88 @@ def batch_amortize_policy(kernel_kind: str,
     return policy
 
 
+def optimistic_combine_policy(inner: Policy) -> Policy:
+    """Wrap the combine-plane amortization policy for the optimistic
+    reply plane (ISSUE 18): once replies stop waiting on the combine,
+    shrinking the flush window buys the client NOTHING — the cert_lag
+    overlay (optimistic release → verified certificate) shows fresh
+    samples exactly when certificates form off the critical path, so a
+    SHRINK vote from the inner policy is downgraded to HOLD while that
+    signal is fresh. GROW stays allowed: wider flush windows amortize
+    the deferred combine even harder, which is the whole point."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        vote = inner(cur, prev, knob)
+        if vote != SHRINK or prev is None:
+            return vote
+        fresh_lag = (int(cur.stages.get("cert_lag", {}).get("count", 0))
+                     > int(prev.stages.get("cert_lag", {})
+                           .get("count", 0)))
+        return HOLD if fresh_lag else vote
+
+    return policy
+
+
+def breaker_readmission_policy() -> Policy:
+    """`breaker_cooldown_ms` from re-admission OUTCOMES: a trip that
+    lands after a recovery means the breaker re-admitted traffic too
+    early and the device re-failed under it — GROW the cooldown. An
+    interval whose recoveries advance with NO new trips means the plane
+    held after re-admission — SHRINK back toward faster re-admission.
+    Intervals without fresh breaker history hold. (The controller's
+    degraded rule guarantees policies only run with every breaker
+    CLOSED, so this reads the trip/recovery COUNTER deltas — the
+    history of re-admissions — never live breaker state.)"""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if prev is None:
+            return HOLD
+        d_trips = d_recov = 0
+        for name, b in cur.breakers.items():
+            pb = prev.breakers.get(name, {})
+            d_trips += max(0, int(b.get("trips", 0))
+                           - int(pb.get("trips", 0)))
+            d_recov += max(0, int(b.get("recoveries", 0))
+                           - int(pb.get("recoveries", 0)))
+        if d_trips > 0:
+            return GROW
+        if d_recov > 0:
+            return SHRINK
+        return HOLD
+
+    return policy
+
+
+def device_min_batch_policy() -> Policy:
+    """`device_min_verify_batch` (the smallest batch worth a device
+    launch) from the kernel profiler's WARM per-item cost of the
+    ed25519 verify kernel: a falling per-item cost means the device is
+    amortizing well at current sizes — SHRINK the floor so smaller
+    batches ride it too; a rising per-item cost means launches stopped
+    amortizing (the floor admits batches too small to pay the dispatch
+    overhead) — GROW it back toward host territory. No fresh kernel
+    calls => HOLD."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if prev is None or kernel_calls(cur, "ed25519") \
+                <= kernel_calls(prev, "ed25519"):
+            return HOLD
+        a = kernel_per_item_us(cur, "ed25519")
+        b = kernel_per_item_us(prev, "ed25519")
+        if a is None or b is None or b <= 0.0:
+            return HOLD
+        if a <= b * FALLING_RATIO:
+            return SHRINK
+        if a * FALLING_RATIO >= b:
+            return GROW
+        return HOLD
+
+    return policy
+
+
 def exec_accumulation_policy() -> Policy:
     """Shrink accumulation when `exec` dominates the slot breakdown
     (long coalesced runs are serializing replies behind one apply);

@@ -41,10 +41,13 @@ from tpubft.tuning.controller import TuningController
 from tpubft.tuning.knobs import Knob, KnobRegistry, load_seed
 from tpubft.tuning.policies import (admission_watermark_policy,
                                     batch_amortize_policy,
+                                    breaker_readmission_policy,
                                     crypto_shard_policy,
+                                    device_min_batch_policy,
                                     durability_amortize_policy,
                                     ecdsa_crossover_policy,
-                                    exec_accumulation_policy)
+                                    exec_accumulation_policy,
+                                    optimistic_combine_policy)
 from tpubft.utils import flight
 from tpubft.utils.logging import get_logger
 
@@ -107,8 +110,14 @@ def build_replica_tuning(replica, cfg) -> TuningController:
     K("combine_flush_us", cfg.combine_flush_us, 0, MAX_FLUSH_US,
       lambda v: replica.collector_pool.reconfigure(flush_us=v),
       "bls_msm per-item cost vs commit p50 share", "us")
-    controller.add_policy("combine_flush_us",
-                          batch_amortize_policy("bls_msm", "commit"))
+    # under optimistic replies the combine runs OFF the client-visible
+    # path (ISSUE 18): fresh cert_lag samples veto the SHRINK votes —
+    # narrowing the flush window would trade amortization for a latency
+    # nobody is waiting on anymore
+    _combine = batch_amortize_policy("bls_msm", "commit")
+    if cfg.optimistic_replies:
+        _combine = optimistic_combine_policy(_combine)
+    controller.add_policy("combine_flush_us", _combine)
     # combine_batch_max is WIRE-VISIBLE and therefore pin/catalog-only
     # (ISSUE 17): the combine-flush drain order determines which share
     # subset a certificate aggregates over, and under share aggregation
@@ -180,12 +189,14 @@ def build_replica_tuning(replica, cfg) -> TuningController:
           "ed25519.shard per-item cost vs full-batch trend", "chips")
         controller.add_policy("crypto_shard_count", crypto_shard_policy())
 
-    # --- catalog/pin-only knobs (no policy yet; seedable, freezable,
-    # reset-on-degradation like everything else) ---
+    # --- device-launch floor (ISSUE 18 satellite): the smallest batch
+    # worth a device ride follows the ed25519 kernel's warm per-item
+    # trend — falling cost lowers the floor, rising cost raises it ---
     K("device_min_verify_batch", cfg.device_min_verify_batch, 1,
       MAX_BATCH, lambda v: setattr(replica.sig, "device_min_batch", v),
-      "host batch sizing floor for the device ride", "sigs")
-    controller.track("device_min_verify_batch")
+      "ed25519 warm per-item cost trend", "sigs")
+    controller.add_policy("device_min_verify_batch",
+                          device_min_batch_policy())
 
     def apply_st_window(v: int) -> None:
         # late-bound: the kvbc layer attaches state transfer after the
@@ -203,9 +214,13 @@ def build_replica_tuning(replica, cfg) -> TuningController:
         from tpubft.ops.dispatch import device_breaker
         device_breaker().configure(cooldown_s=v / 1e3)
 
+    # re-admission outcomes drive the cooldown (ISSUE 18 satellite): a
+    # trip after a recovery = re-admitted too early, grow; recoveries
+    # holding with no new trips = shrink back toward fast re-admission
     K("breaker_cooldown_ms", cfg.breaker_cooldown_ms, 100, 120_000,
       apply_breaker_cooldown, "breaker trip/recovery history", "ms")
-    controller.track("breaker_cooldown_ms")
+    controller.add_policy("breaker_cooldown_ms",
+                          breaker_readmission_policy())
 
     # agg_fanout is WIRE-VISIBLE and pin/catalog-only (ISSUE 17): every
     # replica derives the aggregation overlay deterministically from

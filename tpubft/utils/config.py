@@ -340,6 +340,24 @@ class ReplicaConfig:
     # silently inactive otherwise. False = legacy strictly-post-commit
     # execution.
     speculative_execution: bool = True
+    # optimistic reply plane (arXiv 2407.12172): serve clients from f+1
+    # matching INDIVIDUALLY-SIGNED replies instead of waiting for the
+    # threshold certificate. With this on, a backup releases a slot to
+    # the execution/durability pipeline as soon as a structurally-bound
+    # commit certificate arrives over a VERIFIED prepare quorum (slow
+    # path) or fast-path proposal — the expensive pairing check of the
+    # combined signature completes asynchronously off the reply path —
+    # and every ClientReplyMsg carries the replica's own signature so
+    # the client's f+1 matcher can authenticate each vote. The compact
+    # certificate still forms on the unchanged combine/aggregation path
+    # (checkpointing, state transfer, audit), and `last_executed`
+    # PERSISTENCE stays gated on verified commits (the optimistic
+    # window is reply-visibility only). A certificate that fails its
+    # deferred check poisons the optimistic plane for the rest of the
+    # view (certificate-gated replies resume). Requires the execution
+    # lane + speculation substrate to pay off; without them replies
+    # simply stay certificate-gated.
+    optimistic_replies: bool = False
 
     # retransmissions
     retransmissions_enabled: bool = True

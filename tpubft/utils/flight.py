@@ -109,6 +109,16 @@ EV_AGG_ROOT = 29        # aggregation overlay: root absorbed a partial
 EV_AGG_FALLBACK = 30    # aggregation overlay: parent timeout fired —
 #                         share re-sent DIRECT to the collector
 #                         (dispatcher; arg=share kind 0=prep/1=commit)
+# optimistic reply plane (ReplicaConfig.optimistic_replies)
+EV_OPT_REPLY = 31       # slot released to the reply pipeline on a
+#                         structurally-bound commit cert BEFORE its
+#                         pairing check (dispatcher; arg=0 slow/1 fast)
+EV_CERT_ASYNC_DONE = 32  # deferred combined-cert check landed for an
+#                          optimistically-released slot (dispatcher)
+EV_CERT_ASYNC_LAG = 33  # lag sample for the deferred combine tail:
+#                         optimistic release -> verified cert
+#                         (dispatcher; arg=lag in µs — feeds the
+#                         slot.cert_lag overlay stage)
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -127,22 +137,30 @@ EV_NAMES = {
     EV_PREEXEC_CONFLICT: "preexec_conflict", EV_TUNE: "tune",
     EV_DUR_GROUP: "dur_group", EV_AGG_FORWARD: "agg_forward",
     EV_AGG_ROOT: "agg_root", EV_AGG_FALLBACK: "agg_fallback",
+    EV_OPT_REPLY: "opt_reply", EV_CERT_ASYNC_DONE: "cert_async_done",
+    EV_CERT_ASYNC_LAG: "cert_async_lag",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
 _SLOT_CODES = frozenset((EV_ADM_ADMIT, EV_PP_DISPATCH, EV_PP_ACCEPT,
                          EV_PREPARED, EV_COMMITTED, EV_EXEC_ENQ,
                          EV_EXEC_APPLY, EV_REPLY, EV_SPEC_ENQ,
-                         EV_SPEC_SEAL, EV_SPEC_ABORT))
+                         EV_SPEC_SEAL, EV_SPEC_ABORT,
+                         EV_CERT_ASYNC_LAG))
 
 # the six PIPELINE stages partition a slot's lifetime (they sum to the
 # slot total); spec_overlap is an OVERLAY — the slice of the commit
 # window reclaimed by speculative execution — and is excluded from the
 # total (it runs concurrently with `commit`, > 0 only on slots whose
-# speculative run actually sealed)
+# speculative run actually sealed). cert_lag is the second overlay:
+# optimistic release -> verified certificate, the deferred-combine tail
+# that runs AFTER the client already has its reply (> 0 only under
+# ReplicaConfig.optimistic_replies; fed by EV_CERT_ASYNC_LAG samples,
+# which usually land after the slot finalized on EV_REPLY — so it is
+# tracked as a sample stream, never part of a slot's total)
 PIPELINE_STAGES = ("adm_wait", "dispatch", "prepare", "commit", "exec",
                    "reply")
-STAGES = PIPELINE_STAGES + ("spec_overlap",)
+STAGES = PIPELINE_STAGES + ("spec_overlap", "cert_lag")
 
 RING_SIZE = max(64, int(os.environ.get("TPUBFT_FLIGHT_RING", "4096")
                         or 4096))
@@ -308,6 +326,19 @@ class SlotTracker:
         self._done: "deque[Dict]" = deque(maxlen=self.KEEP)
         self._hists: Dict[str, object] = {}
         self._finalized = 0
+        # cert_lag overlay samples, (rid, lag_ms): EV_CERT_ASYNC_LAG
+        # usually arrives AFTER its slot finalized on EV_REPLY (that is
+        # the whole point of the optimistic reply plane), so the
+        # deferred-combine tail is tracked as its own bounded sample
+        # stream instead of a per-slot field
+        self._cert_lag: "deque[Tuple[int, float]]" = deque(maxlen=self.KEEP)
+        # recently-finalized slot keys: with optimistic replies the
+        # verified-commit event (EV_COMMITTED) lands AFTER the slot
+        # already finalized on EV_REPLY — without this guard the late
+        # event would resurrect the slot as a live entry that never
+        # finalizes and eventually evicts genuinely-live slots
+        self._folded: "deque[Tuple[int, int]]" = deque()
+        self._folded_set: set = set()
         # per-replica finalized counts: an rid-filtered summary must
         # report ITS replica's progress (the autotuner's fresh-signal
         # gate), not the process total — in a multi-replica process a
@@ -331,12 +362,22 @@ class SlotTracker:
 
     def on_event(self, rid: int, code: int, seq: int, view: int,
                  arg: int, t_ns: int) -> None:
+        if code == EV_CERT_ASYNC_LAG:
+            # overlay sample (arg = lag in µs): folded independently of
+            # the slot record, which is typically already finalized
+            lag_ms = arg / 1e3
+            with self._mu:
+                self._cert_lag.append((rid, lag_ms))
+            self._hist("cert_lag").record(arg)      # histograms in µs
+            return
         key = (rid, seq)
         with self._mu:
             slot = self._live.get(key)
             if slot is None:
-                if code in (EV_REPLY, EV_SPEC_ABORT):
-                    return              # replay of an already-folded slot
+                if (code in (EV_REPLY, EV_SPEC_ABORT)
+                        or key in self._folded_set):
+                    return              # replay / late event on a
+                    #                     slot that already folded
                 if len(self._live) >= self.MAX_LIVE:
                     # bounded: evict the oldest live entry (a wedged or
                     # view-changed-away slot must not pin memory)
@@ -357,6 +398,10 @@ class SlotTracker:
             if code != EV_REPLY:
                 return
             del self._live[key]
+            self._folded_set.add(key)
+            self._folded.append(key)
+            if len(self._folded) > self.MAX_LIVE:
+                self._folded_set.discard(self._folded.popleft())
         self._finalize(slot)
 
     @staticmethod
@@ -384,6 +429,11 @@ class SlotTracker:
                                 slot.get("committed"))
                              if slot.get("spec_seal") is not None
                              else 0.0),
+            # per-slot placeholder: the deferred-combine tail lands
+            # AFTER the slot finalizes, so cert_lag is folded from the
+            # EV_CERT_ASYNC_LAG sample stream (see summary()), never
+            # from a slot's own timestamps
+            "cert_lag": 0.0,
         }
 
     def _finalize(self, slot: Dict) -> None:
@@ -413,9 +463,14 @@ class SlotTracker:
             live = len(self._live)
             finalized = (self._finalized if rid is None
                          else self._finalized_by_rid.get(rid, 0))
+            lag_samples = [ms for r, ms in self._cert_lag
+                           if rid is None or r == rid]
         stages: Dict[str, Dict] = {}
         for stage in STAGES:
-            vals = sorted(d["stages_ms"][stage] for d in done)
+            if stage == "cert_lag":
+                vals = sorted(lag_samples)
+            else:
+                vals = sorted(d["stages_ms"][stage] for d in done)
             n = len(vals)
             stages[stage] = {
                 "count": n,
@@ -440,6 +495,9 @@ class SlotTracker:
             self._done.clear()
             self._finalized = 0
             self._finalized_by_rid.clear()
+            self._cert_lag.clear()
+            self._folded.clear()
+            self._folded_set.clear()
 
 
 _tracker = SlotTracker()
