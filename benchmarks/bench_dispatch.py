@@ -22,8 +22,21 @@ Completion is observed on the CONSUMER side (admission `processed`
 marker / dispatcher `handled_external`, empty queues, no in-flight
 verifies), so elapsed time covers the whole pipeline drain.
 
+A third scenario, `--principals N` (ISSUE 19), measures the
+million-principal client plane: a backup replica configured with an
+N-client universe is flooded from principals strided across the whole
+range, then the flood is replayed (the retransmit pass). The client
+pubkey table is VIRTUAL (derived on demand from the cluster seed, never
+materialized), the client table is the bounded LRU, and the leg asserts
+the structural claims — resident records stay under `client_table_max`,
+RSS stays under an absolute ceiling, and the verified-signature memo
+hit-rate on the replay pass holds at N relative to the 10k baseline leg
+run first in the same process. At full scale the leg runs a
+sharded-vs-unsharded admission A/B (admission_key_sharding on/off).
+
 Usage: python -m benchmarks.bench_dispatch [--msgs 1200] [--distinct 64]
        [--samples 2] [--workers 2] [--smoke]
+       [--principals 1000000 [--table-max 2048] [--rss-ceiling-mb 4096]]
 Prints one JSON line per (shape, mode, sample) plus a summary line with
 the per-shape median speedups. --smoke runs a tiny fixed shape for
 tier-1 (tests/test_bench_dispatch_smoke.py).
@@ -34,7 +47,8 @@ import argparse
 import json
 import statistics
 import time
-from typing import List, Optional
+from collections import OrderedDict
+from typing import Iterator, List, Mapping, Optional
 
 from tpubft.comm.interfaces import (ConnectionStatus, ICommunication,
                                     IReceiver, NodeNum)
@@ -119,13 +133,17 @@ def _drain_done(rep, injected: int, distinct: int) -> bool:
 
 
 def _run_flood(rep, flood: List[tuple], distinct: int,
-               timeout_s: float = 300.0) -> Optional[float]:
+               timeout_s: float = 300.0,
+               injected_before: int = 0) -> Optional[float]:
+    """`injected_before`: messages this replica already consumed in a
+    prior pass (the ingest markers are cumulative — a replay pass must
+    wait for ITS messages, not return on the first pass's count)."""
     t0 = time.perf_counter()
     for cid, raw in flood:
         rep.on_new_message(cid, raw)
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
-        if _drain_done(rep, len(flood), distinct):
+        if _drain_done(rep, injected_before + len(flood), distinct):
             return time.perf_counter() - t0
         time.sleep(0.002)
     return None
@@ -206,6 +224,220 @@ def run(msgs: int, distinct: int, samples: int, workers: int,
     print(json.dumps(summary), flush=True)
     rows.append(summary)
     return rows
+
+
+# ---------------------------------------------------------------------
+# --principals: million-principal client plane (ISSUE 19)
+# ---------------------------------------------------------------------
+
+class LazyClientKeys(Mapping):
+    """Virtual `client_pubkeys` for huge principal universes: derives a
+    principal's pubkey on demand from the cluster seed (the exact bytes
+    ClusterKeys.generate would have produced) instead of materializing
+    N entries up front. SigManager keeps non-dict mappings by reference
+    for precisely this shape; a small LRU memo keeps repeat lookups
+    from the verify plane cheap without growing with the universe."""
+
+    _MEMO_MAX = 8192
+
+    def __init__(self, seed: bytes, scheme: str, first_client: int,
+                 count: int, extra: dict) -> None:
+        from tpubft.consensus.keys import _derive_seed
+        from tpubft.crypto.cpu import make_signer
+        self._derive = lambda cl: make_signer(
+            scheme, seed=_derive_seed(seed, "client", cl)).public_bytes()
+        self._range = range(first_client, first_client + count)
+        self._extra = dict(extra)      # operator principal
+        self._memo: "OrderedDict[int, bytes]" = OrderedDict()
+
+    def __getitem__(self, cl: int) -> bytes:
+        pk = self._extra.get(cl)
+        if pk is not None:
+            return pk
+        if cl not in self._range:
+            raise KeyError(cl)
+        pk = self._memo.get(cl)
+        if pk is None:
+            pk = self._memo[cl] = self._derive(cl)
+            while len(self._memo) > self._MEMO_MAX:
+                self._memo.popitem(last=False)
+        return pk
+
+    def __len__(self) -> int:
+        return len(self._range) + len(self._extra)
+
+    def __iter__(self) -> Iterator[int]:
+        yield from self._range
+        yield from (k for k in self._extra if k not in self._range)
+
+
+def _rss_mb() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) // 1024
+    return -1
+
+
+def _make_principals_replica(scale: int, workers: int, **cfg_overrides):
+    """Backup replica fronting a `scale`-principal client universe.
+    Client key material is virtual (LazyClientKeys) and the client table
+    is the bounded pager (client_table_max must stay > 0 here — the
+    legacy eager table would materialize `scale` records at boot)."""
+    from tpubft.apps.counter import CounterHandler
+    cfg = ReplicaConfig(replica_id=1, f_val=F,
+                        num_of_client_proxies=scale,
+                        admission_workers=workers,
+                        view_change_timer_ms=3_600_000,
+                        **cfg_overrides)
+    assert cfg.client_table_max > 0, "principals bench needs paged table"
+    keys = ClusterKeys.generate(cfg, 0, seed=SEED)   # 0 eager client keys
+    first_client = cfg.n_val + cfg.num_ro_replicas
+    keys.client_pubkeys = LazyClientKeys(
+        SEED, keys.client_sig_scheme, first_client, scale,
+        extra=keys.client_pubkeys)
+    rep = Replica(cfg, keys.for_node(1), NullComm(), CounterHandler())
+    rep.start()
+    return rep, first_client
+
+
+def _principal_flood(scheme: str, first_client: int, scale: int,
+                     distinct: int, base_seq: int) -> List[tuple]:
+    """`distinct` signed requests from principals strided across the
+    whole universe (each principal sends once — the cold-contact shape
+    that exercises demand paging, not per-client request streams)."""
+    from tpubft.consensus.keys import _derive_seed
+    from tpubft.crypto.cpu import make_signer
+    stride = max(1, scale // distinct)
+    out = []
+    for i in range(min(distinct, scale)):
+        cid = first_client + i * stride
+        signer = make_signer(scheme, seed=_derive_seed(SEED, "client", cid))
+        req = m.ClientRequestMsg(sender_id=cid, req_seq_num=base_seq,
+                                 flags=0, request=b"p-%d" % i,
+                                 cid="", signature=b"")
+        req.signature = signer.sign(req.signed_payload())
+        out.append((cid, req.pack()))
+    return out
+
+
+def _principals_leg(scale: int, distinct: int, workers: int,
+                    table_max: int, sharded: bool) -> dict:
+    """One leg: cold flood from `distinct` principals out of a `scale`
+    universe, then a replay of the same bytes (the retransmit pass the
+    verify memo and client-table LRU exist for)."""
+    # autotuning off: the client_table_max knob would (correctly) GROW
+    # under a 100%-cold-miss flood, but this leg measures the FIXED
+    # bound — the knob's reactions are unit-test/bench_autotune scope
+    rep, first_client = _make_principals_replica(
+        scale, workers, client_table_max=table_max,
+        admission_key_sharding=sharded, autotune_enabled=False)
+    try:
+        base_seq = int(time.time() * 1e6)
+        flood = _principal_flood(rep.keys.client_sig_scheme, first_client,
+                                 scale, distinct, base_seq)
+        t0 = time.perf_counter()
+        dt_cold = _run_flood(rep, flood, len(flood))
+        dt_replay = _run_flood(rep, flood, len(flood),
+                               injected_before=len(flood)) \
+            if dt_cold is not None else None
+        total = time.perf_counter() - t0
+        sm = rep.sig.metrics.counters
+        memo_hits = sm["memo_hits"].value
+        row = {
+            "bench": "dispatch_principals", "principals": scale,
+            "distinct": len(flood), "workers": workers,
+            "mode": "sharded" if sharded and workers > 1 else "unsharded",
+            "client_table_max": table_max,
+            "cold_secs": round(dt_cold, 3) if dt_cold else None,
+            "replay_secs": round(dt_replay, 3) if dt_replay else None,
+            "msgs_per_sec": round(2 * len(flood) / total, 1)
+            if dt_replay else None,
+            "rss_mb": _rss_mb(),
+            "resident_clients": rep.clients.resident_count,
+            "client_table": {"hits": rep.clients.table_hits,
+                             "misses": rep.clients.table_misses,
+                             "evictions": rep.clients.table_evictions},
+            # replay-pass memo hit-rate: of the replayed signatures, how
+            # many were shed by the verified-signature memo
+            "memo_hits": memo_hits,
+            "memo_hit_rate": round(memo_hits / len(flood), 3),
+            "sig": {k: sm[k].value for k in
+                    ("batched_verifies", "scalar_fallbacks",
+                     "verifier_evictions")},
+        }
+        if rep.admission is not None:
+            row["adm"] = {k: v.value
+                          for k, v in rep.admission.metrics.counters.items()}
+        return row
+    finally:
+        rep.stop()
+
+
+def run_principals(principals: int, distinct: int, workers: int,
+                   table_max: int, rss_ceiling_mb: int,
+                   baseline: int = 10_000) -> List[dict]:
+    """The ISSUE 19 scenario: 10k-principal baseline leg, then the full-
+    scale leg(s). At full scale, sharded-vs-unsharded admission A/B.
+    Asserts the structural claims (bounded residency, RSS ceiling, memo
+    hit-rate holding vs the baseline) — a regression fails the bench,
+    not just a number in a row."""
+    # the flood must outrun the table or the leg never proves eviction
+    distinct = max(distinct, table_max + table_max // 2)
+    legs = [(min(baseline, principals), True)]
+    if principals > baseline:
+        legs += [(principals, True)]
+        if workers > 1:
+            legs += [(principals, False)]
+    rows = []
+    for scale, sharded in legs:
+        row = _principals_leg(scale, distinct, workers, table_max, sharded)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base, tail = rows[0], rows[1:]
+    summary = {"bench": "dispatch_principals_summary",
+               "principals": principals, "distinct": distinct,
+               "workers": workers, "client_table_max": table_max,
+               "rss_ceiling_mb": rss_ceiling_mb}
+    if len(tail) == 2:      # sharded + unsharded full-scale pair
+        a, b = tail[0]["msgs_per_sec"], tail[1]["msgs_per_sec"]
+        if a and b:
+            summary["sharded_speedup"] = round(a / b, 2)
+    for row in rows:
+        assert row["replay_secs"] is not None, f"leg did not drain: {row}"
+        # bounded residency: the LRU held (the pinned-burst slack is
+        # _EVICT_SCAN_MAX, tiny next to the bound)
+        assert row["resident_clients"] <= table_max + 8, row
+        assert row["rss_mb"] < rss_ceiling_mb, \
+            f"RSS {row['rss_mb']}MB over {rss_ceiling_mb}MB ceiling"
+    for row in tail:
+        # the replay-pass memo hit-rate must hold at full scale: the
+        # memo is keyed by (principal, digest, sig), so universe size
+        # must not dilute it
+        assert row["memo_hit_rate"] >= 0.9 * base["memo_hit_rate"], \
+            (row["memo_hit_rate"], base["memo_hit_rate"])
+    summary["ok"] = True
+    print(json.dumps(summary), flush=True)
+    rows.append(summary)
+    return rows
+
+
+def smoke_principals() -> dict:
+    """Tier-1 shape: a 10k-principal universe, a flood wider than the
+    client table, replayed — asserts bounded residency, real evictions,
+    demand re-paging, and the replay memo shed (structure, not speed)."""
+    rows = run_principals(principals=10_000, distinct=96, workers=1,
+                          table_max=64, rss_ceiling_mb=8192)
+    leg = rows[0]
+    return {
+        "ok": bool(rows[-1].get("ok")),
+        "drained": leg["replay_secs"] is not None,
+        "bounded": leg["resident_clients"] <= 64 + 8,
+        "evicted": leg["client_table"]["evictions"] > 0,
+        "repaged": leg["client_table"]["misses"] > leg["distinct"] // 2,
+        "memo_shed": leg["memo_hits"] > 0,
+        "leg": leg,
+    }
 
 
 def smoke() -> dict:
@@ -355,6 +587,13 @@ def main() -> None:
     ap.add_argument("--workers", type=int, default=1,
                     help="admission_workers for the ON mode")
     ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--principals", type=int, default=0,
+                    help="million-principal client-plane scenario: "
+                         "universe size for the full-scale leg")
+    ap.add_argument("--table-max", type=int, default=2048,
+                    help="client_table_max for the principals legs")
+    ap.add_argument("--rss-ceiling-mb", type=int, default=4096,
+                    help="asserted RSS ceiling for the principals legs")
     ap.add_argument("--profile", action="store_true",
                     help="attach the flight recorder's stage breakdown "
                          "and kernel profile to the summary row")
@@ -364,6 +603,10 @@ def main() -> None:
     args = ap.parse_args()
     if args.smoke:
         print(json.dumps(smoke()), flush=True)
+        return
+    if args.principals:
+        run_principals(args.principals, args.distinct * 8, args.workers,
+                       args.table_max, args.rss_ceiling_mb)
         return
     if args.device_fault:
         print(json.dumps(device_fault()), flush=True)

@@ -285,6 +285,20 @@ def test_admission_off_state_equivalence():
     assert on_read == off_read == total
 
 
+def test_sharded_router_state_equivalence():
+    """ISSUE 19 key-sharded admission: routing datagrams to workers by
+    principal hash (admission_key_sharding on) vs the shared-buffer
+    plane (off), same worker count — identical state-machine results.
+    The router changes WHICH worker verifies a message, never what is
+    admitted, shed, or ordered."""
+    on_states, on_read, total = _run_workload(
+        {"admission_workers": 2})
+    off_states, off_read, _ = _run_workload(
+        {"admission_workers": 2, "admission_key_sharding": False})
+    assert on_states == off_states == [total] * 4
+    assert on_read == off_read == total
+
+
 def test_stuck_admission_drain_does_not_serialize_seqnums():
     """The admission-plane counterpart of test_crypto_tpu_backend.
     test_ordering_continues_while_batch_in_flight: with >1 admission

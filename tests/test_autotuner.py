@@ -14,11 +14,12 @@ from tpubft.tuning.knobs import (GROW, HOLD, SHRINK, Knob, KnobRegistry,
                                  load_seed, write_seed)
 from tpubft.tuning.policies import (Telemetry, batch_amortize_policy,
                                     breaker_readmission_policy,
+                                    client_table_policy,
                                     device_min_batch_policy,
                                     ecdsa_crossover_policy,
                                     exec_accumulation_policy,
                                     optimistic_combine_policy,
-                                    stage_fraction)
+                                    st_window_policy, stage_fraction)
 from tpubft.utils import flight
 
 
@@ -303,6 +304,52 @@ class TestPolicies:
                                  "warm_avg_ms": 1.5}})
         assert pol(grow_cur, grow_prev, _knob()) == GROW
 
+    def test_st_window_policy(self):
+        pol = st_window_policy()
+        prev = _tel(counters={"st_bytes_delta": 1_000_000.0,
+                              "st_failovers_delta": 0.0})
+        # byte rate rising interval-over-interval: widen the pipeline
+        rising = _tel(counters={"st_bytes_delta": 1_500_000.0,
+                                "st_failovers_delta": 0.0})
+        assert pol(rising, prev, _knob()) == GROW
+        # any fresh failover shrinks — even if the rate also rose (a
+        # wide window multiplies the data parked behind a dead source)
+        failed = _tel(counters={"st_bytes_delta": 1_500_000.0,
+                                "st_failovers_delta": 1.0})
+        assert pol(failed, prev, _knob()) == SHRINK
+        # falling rate: hold (failover, not throughput, drives shrink)
+        falling = _tel(counters={"st_bytes_delta": 400_000.0})
+        assert pol(falling, prev, _knob()) == HOLD
+        # idle transfer plane / first interval: hold
+        assert pol(_tel(), prev, _knob()) == HOLD
+        assert pol(rising, _tel(), _knob()) == HOLD
+        assert pol(rising, None, _knob()) == HOLD
+
+    def test_client_table_policy(self):
+        pol = client_table_policy()
+        prev = _tel()
+        # thrash: evictions and a high miss rate in the same interval —
+        # the hot set doesn't fit, grow the bound
+        thrash = _tel(counters={"client_table_hits_delta": 60.0,
+                                "client_table_misses_delta": 40.0,
+                                "client_table_evictions_delta": 35.0})
+        assert pol(thrash, prev, _knob(value=1024)) == GROW
+        # cold-start fill (misses but NO evictions, resident near the
+        # bound): not thrash — hold
+        filling = _tel(counters={"client_table_hits_delta": 10.0,
+                                 "client_table_misses_delta": 90.0},
+                       depths={"client_table": 900})
+        assert pol(filling, prev, _knob(value=1024)) == HOLD
+        # slack: traffic with zero evictions and the resident set far
+        # under the bound — hand the memory back
+        slack = _tel(counters={"client_table_hits_delta": 100.0,
+                               "client_table_misses_delta": 1.0},
+                     depths={"client_table": 80})
+        assert pol(slack, prev, _knob(value=1024)) == SHRINK
+        # idle table / first interval: hold
+        assert pol(_tel(), prev, _knob(value=1024)) == HOLD
+        assert pol(thrash, None, _knob(value=1024)) == HOLD
+
 
 # ----------------------------------------------------------------------
 # controller
@@ -553,7 +600,7 @@ EXPECTED_KNOBS = {
     "combine_batch_max", "execution_max_accumulation",
     "admission_high_watermark", "ecdsa_crossover_b",
     "device_min_verify_batch", "st_window_ranges", "breaker_cooldown_ms",
-    "durability_group_max", "durability_window_us",
+    "durability_group_max", "durability_window_us", "client_table_max",
 }
 
 
@@ -589,6 +636,9 @@ def test_replica_tuning_catalog_and_status():
         # actuator seam is live: a manual store reaches the lane
         rep.tuning.registry.set("execution_max_accumulation", 4)
         assert rep.exec_lane.max_accumulation == 4
+        # ... and the paged client table's residency bound
+        rep.tuning.registry.set("client_table_max", 512)
+        assert rep.clients.max_resident == 512
 
 
 def test_replica_autotune_disabled():

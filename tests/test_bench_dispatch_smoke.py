@@ -33,3 +33,24 @@ def test_bench_dispatch_smoke(threadcheck):
     # no dispatcher/admission stall during the run (lock-order
     # inversions raise inside the run itself)
     assert get_watchdog().stall_reports == before, out
+
+
+def test_bench_principals_smoke(threadcheck):
+    """The million-principal client plane's tier-1 shape (ISSUE 19): a
+    10k-principal universe behind a 64-slot client table, flooded wider
+    than the table and replayed. Structural gates only — bounded
+    residency, real LRU evictions, demand paging misses, and the
+    replay pass shed by the verified-signature memo — under the same
+    THREADCHECK instrumentation as the flood smoke (the demand pager
+    runs on admission/dispatcher threads against the table lock)."""
+    from tpubft.utils.racecheck import get_watchdog
+    before = get_watchdog().stall_reports
+    from benchmarks.bench_dispatch import smoke_principals
+    out = smoke_principals()
+    assert out["ok"], out
+    assert out["drained"], out
+    assert out["bounded"], out
+    assert out["evicted"], out
+    assert out["repaged"], out
+    assert out["memo_shed"], out
+    assert get_watchdog().stall_reports == before, out

@@ -1,0 +1,194 @@
+"""Paged client table (ISSUE 19): bounded LRU residency, pending
+pinning, and the evict→re-page round trip that must preserve
+at-most-once execution exactly as a crash→restart does.
+
+Unit half: a fake pager standing in for the reply-ring rebuild, so the
+LRU mechanics (bound under churn, pin rotation, counters) are pinned
+without a cluster. Integration half: a live cluster whose replicas run
+the REAL demand pager (Replica._page_in_client) — a record dropped from
+the table must come back from reserved pages with its reply cache and
+the restore seal intact.
+"""
+import time
+
+import pytest
+
+from tpubft.apps import counter
+from tpubft.consensus.clients_manager import (_EVICT_SCAN_MAX, _ClientInfo,
+                                              ClientsManager)
+from tpubft.consensus.messages import ClientReplyMsg
+from tpubft.testing.cluster import InProcessCluster
+
+
+def _reply(seq: int, payload: bytes = b"r") -> ClientReplyMsg:
+    return ClientReplyMsg(sender_id=0, req_seq_num=seq, current_primary=0,
+                          reply=payload, replica_specific_info=b"")
+
+
+# ---------------------------------------------------------------------
+# unit: LRU mechanics over a fake pager
+# ---------------------------------------------------------------------
+
+def test_paged_table_lru_bound_under_churn():
+    """Touching far more principals than the bound keeps residency at
+    the bound — every miss demand-pages, every overflow evicts — and a
+    re-touch of a hot record is a hit that refreshes recency."""
+    cm = ClientsManager(range(0, 1000), max_resident=16,
+                        pager=lambda c: _ClientInfo())
+    for cid in range(500):
+        cm.was_executed(cid, 1)
+    assert cm.resident_count == 16
+    assert cm.table_misses == 500
+    assert cm.table_evictions == 500 - 16
+    # recency: the LRU end is the oldest touched; hitting it twice
+    # keeps it resident through further churn
+    cm.was_executed(484, 1)
+    assert cm.table_hits == 1
+    for cid in range(500, 515):
+        cm.was_executed(cid, 1)
+    assert cm.resident_count == 16
+    assert 484 in cm._clients          # refreshed, survived 15 inserts
+    # live retune (autotuner actuator): shrinking evicts on next inserts
+    cm.set_max_resident(4)
+    cm.was_executed(900, 1)
+    assert cm.resident_count <= 16     # bounded-work eviction, not O(n)
+    for cid in range(901, 920):
+        cm.was_executed(cid, 1)
+    assert cm.resident_count == 4
+
+
+def test_paged_table_pending_pins_resident():
+    """Records with in-flight requests are memory-only state and must
+    never be evicted — they rotate to the hot end instead; once the
+    request executes, churn evicts them normally."""
+    cm = ClientsManager(range(0, 100), max_resident=4,
+                        pager=lambda c: _ClientInfo())
+    for cid in range(4):
+        cm.add_pending(cid, 1)
+    for cid in range(4, 50):
+        cm.was_executed(cid, 1)
+    for cid in range(4):
+        assert cm.has_pending(cid), cid       # pinned through the churn
+    # the burst of pinned candidates may leave the table briefly over
+    # bound (the O(1) eviction scan gives up), never unboundedly so
+    assert cm.resident_count <= 4 + _EVICT_SCAN_MAX
+    for cid in range(4):
+        cm.on_request_executed(cid, 1, _reply(1))
+    for cid in range(50, 90):
+        cm.was_executed(cid, 1)
+    assert cm.resident_count <= 4 + _EVICT_SCAN_MAX
+    assert not any(cm.has_pending(c) for c in range(4))
+
+
+def test_paged_table_evict_repage_round_trip():
+    """At-most-once across evict→reload: an executed request's record
+    churned out of the table must come back from the pager DENYING
+    re-execution, serving the cached reply, and refusing unseen seqs at
+    or below the watermark (the restore seal) — exactly once, not
+    at-least-once, across the page boundary."""
+    store = {}                         # the "reply ring": cid -> replies
+
+    def pager(cid):
+        info = _ClientInfo()
+        for seq, reply in sorted(store.get(cid, {}).items()):
+            info.replies[seq] = reply
+            info.last_executed_req = max(info.last_executed_req, seq)
+        # the restore seal _page_in_client applies: the persisted ring
+        # is bounded, so below-watermark absences are refusals
+        if info.last_executed_req > info.evicted_high:
+            info.evicted_high = info.last_executed_req
+        return info
+
+    cm = ClientsManager(range(0, 64), max_resident=2, pager=pager)
+    reply = _reply(10, b"the-answer")
+    cm.add_pending(5, 10)
+    cm.on_request_executed(5, 10, reply)
+    store[5] = {10: reply}             # persisted BEFORE the table knew
+    for cid in (1, 2, 3, 4):           # churn client 5 out
+        cm.was_executed(cid, 0)
+    assert 5 not in cm._clients
+    assert cm.table_evictions >= 1
+    # re-contact: the pager rebuilt an equivalent record
+    assert cm.was_executed(5, 10)
+    assert not cm.can_become_pending(5, 10)
+    assert cm.cached_reply(5, 10) == reply
+    # reload seal: an unseen below-watermark seq may have executed-and-
+    # evicted — refused; above the watermark is fresh
+    assert not cm.can_become_pending(5, 9)
+    assert cm.can_become_pending(5, 11)
+
+
+def test_unbounded_table_ignores_retune_and_invalidate():
+    """A pager-less table (legacy eager shape) has no way to rebuild a
+    dropped record: max_resident stays 0 and invalidate_all is a no-op."""
+    cm = ClientsManager([10, 11], max_resident=8)
+    assert cm.max_resident == 0
+    cm.set_max_resident(4)
+    assert cm.max_resident == 0
+    cm.on_request_executed(10, 1, _reply(1))
+    cm.invalidate_all()
+    assert cm.cached_reply(10, 1) is not None
+
+
+# ---------------------------------------------------------------------
+# integration: the real pager over live reply-ring pages
+# ---------------------------------------------------------------------
+
+def test_evicted_client_repages_from_reply_ring():
+    """Drop every resident record on a live replica (what eviction does
+    to one client, what an ST page install does to all), then re-contact:
+    the REAL pager rebuilds the record from the reply-ring pages — reply
+    served, re-execution refused, restore seal applied."""
+    with InProcessCluster(f=1) as cluster:
+        cl = cluster.client(0)
+        cl.start()
+        assert counter.decode_reply(cl.send_write(counter.encode_add(7))) \
+            == 7
+        rep0 = cluster.replicas[0]
+        cid = cl.cfg.client_id
+        assert rep0.clients.max_resident > 0      # paged mode is default
+        # wait for the reply to be durable + published on THIS replica
+        deadline = time.monotonic() + 10
+        seq = None
+        while time.monotonic() < deadline and seq is None:
+            info = rep0.clients._clients.get(cid)
+            if info is not None and info.replies:
+                seq = max(info.replies)
+            else:
+                time.sleep(0.02)
+        assert seq is not None
+        misses_before = rep0.clients.table_misses
+        rep0.clients.invalidate_all()
+        assert cid not in rep0.clients._clients
+        # re-contact: demand-paged back from the ring
+        assert rep0.clients.was_executed(cid, seq)
+        assert rep0.clients.table_misses == misses_before + 1
+        paged = rep0.clients.cached_reply(cid, seq)
+        assert paged is not None
+        assert counter.decode_reply(paged.reply) == 7
+        assert not rep0.clients.can_become_pending(cid, seq)
+        # restore seal: unseen seqs at/below the watermark are refused
+        assert not rep0.clients.can_become_pending(cid, seq - 1)
+        assert rep0.clients.can_become_pending(cid, seq + 1)
+
+
+@pytest.mark.slow
+def test_live_eviction_under_tiny_table_keeps_cluster_correct():
+    """client_table_max=1 across a multi-principal workload: the table
+    churns on every replica (real evictions + real demand re-pages mid-
+    consensus) and the state machine still executes each write exactly
+    once."""
+    with InProcessCluster(f=1, num_clients=2,
+                          cfg_overrides={"client_table_max": 1,
+                                         "autotune_enabled": False}) \
+            as cluster:
+        c0, c1 = cluster.client(0), cluster.client(1)
+        total = 0
+        for i, cl in enumerate((c0, c1, c0, c1, c0)):
+            total += i + 1
+            assert counter.decode_reply(
+                cl.send_write(counter.encode_add(i + 1))) == total
+        assert any(r.clients.table_evictions > 0
+                   for r in cluster.replicas.values())
+        assert all(r.clients.resident_count <= 1 + _EVICT_SCAN_MAX
+                   for r in cluster.replicas.values())

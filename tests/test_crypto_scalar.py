@@ -73,6 +73,24 @@ def test_curve_parameters_mirror_device_kernels():
     assert scalar.CURVES == DEVICE_CURVES
 
 
+def test_ed25519_sign_batch_byte_identical():
+    """The batched signer (one Montgomery inversion for the whole
+    batch) must land the exact bytes of the per-item RFC 8032 path —
+    clients accept f+1 MATCHING replies, so replicas may never disagree
+    on a signature's bytes."""
+    sk = scalar.ed25519_seed_to_private(b"batch-sign-seed")
+    pk = scalar.ed25519_public_key(sk)
+    msgs = [b"reply-%d" % i for i in range(17)] + [b"", b"\x00" * 200]
+    batch = scalar.ed25519_sign_batch(sk, msgs, pk=pk)
+    assert batch == [scalar.ed25519_sign(sk, m, pk=pk) for m in msgs]
+    for m, sig in zip(msgs, batch):
+        assert scalar.ed25519_verify(pk, m, sig)
+    assert scalar.ed25519_sign_batch(sk, []) == []
+    # signer-level seam: the cpu signer's sign_batch agrees with sign
+    s = cpu.Ed25519Signer.generate(seed=b"batch-sign-seed2")
+    assert s.sign_batch(msgs[:5]) == [s.sign(m) for m in msgs[:5]]
+
+
 # ---------------- scalar sign → device kernel verify ----------------
 
 # ~22 s of kernel compiles; every tpu-backend cluster test exercises

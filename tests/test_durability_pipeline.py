@@ -375,7 +375,8 @@ def test_inflight_dedup_across_sealed_runs():
             _Rep.executions += 1
             return b"payload"
 
-        def _build_reply(self, client, req_seq, payload, pages_wb):
+        def _build_reply(self, client, req_seq, payload, pages_wb,
+                         defer_sign=False):
             return _Reply(), b"wire"
 
         class m_exec_lane_depth:  # noqa: N801 — gauge stub
@@ -530,6 +531,19 @@ def test_pipeline_on_off_ledger_equivalence(tmp_path):
     assert on["state_digest"] == off["state_digest"]
     assert on["reply_pages"] and on["reply_pages"] == off["reply_pages"]
     assert on["blocks"] == off["blocks"]
+
+
+def test_sharded_admission_ledger_equivalence(tmp_path):
+    """ISSUE 19 key-sharded admission, the durable half of the
+    equivalence claim: the same workload through sharded vs shared-
+    buffer admission (same worker count) lands byte-identical ledger
+    blocks, state digest, and reply-ring / at-most-once pages."""
+    on = _run_workload(tmp_path, "shard_on", admission_workers=2)
+    off = _run_workload(tmp_path, "shard_off", admission_workers=2,
+                        admission_key_sharding=False)
+    assert on["state_digest"] == off["state_digest"]
+    assert on["blocks"] == off["blocks"]
+    assert on["reply_pages"] and on["reply_pages"] == off["reply_pages"]
 
 
 def test_group_max_one_degenerates_to_per_run_path(tmp_path):

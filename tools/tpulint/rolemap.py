@@ -160,6 +160,16 @@ API_SEEDS: Dict[FuncId, FrozenSet[str]] = {
         frozenset({"client_api"}),
     ("tpubft/bftclient/client.py", "BftClient", "on_new_message"):
         frozenset({"transport"}),
+    # session multiplexer (ISSUE 19): like the raw client sends, mux
+    # sessions are driven by arbitrary application threads — the
+    # per-session lane lock and per-principal semaphore are the
+    # cross-thread surface in front of the shared BftClient
+    ("tpubft/bftclient/pool.py", "MuxSession", "write"):
+        frozenset({"client_api"}),
+    ("tpubft/bftclient/pool.py", "MuxSession", "read"):
+        frozenset({"client_api"}),
+    ("tpubft/bftclient/pool.py", "MuxSession", "write_batch"):
+        frozenset({"client_api"}),
     # thin-replica commit-listener hop: the ledger's run listeners fire
     # on whichever thread sealed the commit — the execution lane
     # (end_accumulation), the dispatcher (inline execution, ST link

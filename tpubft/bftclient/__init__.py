@@ -1,4 +1,6 @@
 """BFT client stack (reference /root/reference/client/bftclient/)."""
 from tpubft.bftclient.client import BftClient, ClientConfig, Quorum
+from tpubft.bftclient.pool import ClientPool, MuxSession, SessionMux
 
-__all__ = ["BftClient", "ClientConfig", "Quorum"]
+__all__ = ["BftClient", "ClientConfig", "Quorum", "ClientPool",
+           "MuxSession", "SessionMux"]

@@ -131,6 +131,20 @@ _CRITICAL_CODES = frozenset(int(c) for c in (
 # fresh client load — the sheddable class under overload
 _CLIENT_CODES = frozenset((int(m.MsgCode.ClientRequest),
                            int(m.MsgCode.ClientBatchRequest)))
+# client principal for shard routing: u32 sender_id at wire offset 2
+# (the same fixed prefix every peek uses)
+_SENDER = struct.Struct("<I")
+
+
+def shard_of(sender_id: int, shards: int) -> int:
+    """Stable shard for a client principal: Knuth multiplicative hash of
+    the wire sender_id. Deterministic across drains/restarts (the whole
+    point — each worker's SigManager verify batches, memo and comb
+    caches see a disjoint, STABLE slice of the key population, so
+    per-principal key material stays hot per shard instead of being
+    diluted across every worker), and mixing keeps adjacent principal
+    ids from landing in lockstep with any client-side id striping."""
+    return ((sender_id * 2654435761) & 0xFFFFFFFF) % shards
 
 
 class AdmissionPipeline:
@@ -149,7 +163,7 @@ class AdmissionPipeline:
                  name: str = "admission", ckpt_window: int = 0,
                  high_watermark: int = 0, low_watermark: int = 0,
                  beat_fn: Optional[Callable[[], None]] = None,
-                 rid: int = -1):
+                 rid: int = -1, shard_by_key: bool = True):
         self._sig = sig
         self._info = info
         self._sink = sink
@@ -169,6 +183,17 @@ class AdmissionPipeline:
         # whole transport burst (the recvmmsg drain) enters under ONE
         # lock round (extend + one wake), not a lock cycle per datagram
         self._buf: "deque[Tuple[int, bytes]]" = deque()
+        # key-sharded client routing (million-principal client plane):
+        # with >1 workers, CLIENT datagrams route to a per-worker shard
+        # buffer by a stable hash of the wire principal, so each
+        # worker's verify batches / memo / comb caches see a disjoint,
+        # stable key population. Critical + other traffic stays on the
+        # shared queues (any worker drains it — liveness machinery must
+        # never wait behind one shard's backlog). Empty list = routing
+        # off (single worker, or shard_by_key=False for the A/B).
+        self._shards: List["deque[Tuple[int, bytes]]"] = (
+            [deque() for _ in range(self._n_workers)]
+            if shard_by_key and self._n_workers > 1 else [])
         # protocol-critical priority queue (see _CRITICAL_CODES): its
         # own headroom up to max_pending — a client flood filling _buf
         # can never push a view-change or checkpoint out
@@ -199,9 +224,12 @@ class AdmissionPipeline:
         self._threads: List[threading.Thread] = []
         self._running = False
         self._processed = 0
-        # client-principal topology is static: freeze it once so the
-        # worker-side gates never touch replica state
-        self._clients = frozenset(info.all_client_ids())
+        # client-principal topology is static: capture it once so the
+        # worker-side gates never touch replica state. Production
+        # topologies hand us a contiguous `range` (O(1) membership, O(1)
+        # memory at 1M principals); anything else is frozen to a set.
+        ids = info.all_client_ids()
+        self._clients = ids if isinstance(ids, range) else frozenset(ids)
         # instrumented under TPUBFT_THREADCHECK: admission worker ⇄
         # dispatcher lock ordering rides the global order graph
         self._stats_mu = make_lock(f"{name}.stats")
@@ -265,32 +293,45 @@ class AdmissionPipeline:
     # ------------------------------------------------------------------
     # ingest (transport threads)
     # ------------------------------------------------------------------
-    @staticmethod
-    def _class_of(raw: bytes) -> str:
+    def _class_of(self, raw: bytes) -> Tuple[str, int]:
         """Ingest class from the 2-byte code peek: 'crit' (protected
         priority queue), 'client' (sheddable under overload), 'other'
-        (consensus shares etc. — bounded but never watermark-shed)."""
+        (consensus shares etc. — bounded but never watermark-shed).
+        Second element is the client's shard route (worker index) when
+        key-sharded routing is on, else -1 (shared buffer)."""
         if len(raw) >= 2:
             (code,) = _CODE.unpack_from(raw)
             if code in _CRITICAL_CODES:
-                return "crit"
+                return "crit", -1
             if code in _CLIENT_CODES:
-                return "client"
-        return "other"
+                if self._shards and len(raw) >= 6:
+                    (principal,) = _SENDER.unpack_from(raw, 2)
+                    return "client", shard_of(principal, self._n_workers)
+                return "client", -1
+        return "other", -1
 
-    def _ingest_locked(self, sender: int, raw: bytes, cls: str) -> str:
+    def _client_depth(self) -> int:
+        """Queued client+other datagrams (caller holds self._cv)."""
+        return len(self._buf) + sum(map(len, self._shards))
+
+    def _ingest_locked(self, sender: int, raw: bytes,
+                       cls: Tuple[str, int]) -> str:
         """One datagram's ingest disposition under self._cv (`cls`
         precomputed by the caller OUTSIDE the lock — classification is
         stateless and must not extend the critical section):
         'ok' (buffered), 'shed' (overload watermark), 'full' (hard
         bound). Exactly one counter fires per disposition — the
-        accounting invariant tests and benches rely on."""
-        if cls == "crit":
+        accounting invariant tests and benches rely on. Watermarks and
+        the hard bound are computed over the TOTAL queued depth, so the
+        sharded router keeps byte-identical shed/drop accounting with
+        the shared-buffer path."""
+        kind, route = cls
+        if kind == "crit":
             if len(self._crit) >= self._max_pending:
                 return "full"
             self._crit.append((sender, raw))
             return "ok"
-        depth = len(self._buf) + len(self._crit)
+        depth = self._client_depth() + len(self._crit)
         if self._high:
             if not self._shedding and depth >= self._high:
                 self._shedding = True
@@ -298,11 +339,14 @@ class AdmissionPipeline:
             elif self._shedding and depth <= self._low:
                 self._shedding = False
                 self.adm_shedding.set(0)
-        if self._shedding and cls == "client":
+        if self._shedding and kind == "client":
             return "shed"
-        if len(self._buf) >= self._max_pending:
+        if self._client_depth() >= self._max_pending:
             return "full"
-        self._buf.append((sender, raw))
+        if route >= 0:
+            self._shards[route].append((sender, raw))
+        else:
+            self._buf.append((sender, raw))
         return "ok"
 
     def set_watermarks(self, high_watermark: int,
@@ -333,7 +377,15 @@ class AdmissionPipeline:
         with self._cv:
             d = self._ingest_locked(sender, raw, cls)
             if d == "ok":
-                self._cv.notify()
+                if self._shards:
+                    # one shared Condition across sharded workers: a
+                    # single notify could land on a worker whose shard
+                    # stayed empty while the routed worker sleeps out
+                    # its 0.1s wait — wake everyone, the non-owners
+                    # re-sleep immediately
+                    self._cv.notify_all()
+                else:
+                    self._cv.notify()
         if d == "full":
             self.adm_dropped_ingress.inc()
         elif d == "shed":
@@ -361,7 +413,7 @@ class AdmissionPipeline:
                 else:
                     full += 1
             if taken:
-                if taken > self._drain_max:
+                if self._shards or taken > self._drain_max:
                     self._cv.notify_all()
                 else:
                     self._cv.notify()
@@ -373,7 +425,8 @@ class AdmissionPipeline:
     @property
     def depth(self) -> int:
         # racy read is fine for a gauge
-        return len(self._buf) + len(self._crit)
+        return (len(self._buf) + len(self._crit)
+                + sum(map(len, self._shards)))
 
     @property
     def shedding(self) -> bool:
@@ -391,19 +444,29 @@ class AdmissionPipeline:
     # ------------------------------------------------------------------
     # worker loop
     # ------------------------------------------------------------------
-    def _next_batch(self) -> List[Tuple[int, bytes]]:
+    def _next_batch(self, idx: int = 0) -> List[Tuple[int, bytes]]:
         with self._cv:
-            if not self._buf and not self._crit:
+            mine = self._shards[idx] if self._shards else None
+            if not self._buf and not self._crit \
+                    and not (mine and len(mine)):
                 self._cv.wait(0.1)
             out: List[Tuple[int, bytes]] = []
             # protocol-critical first: under overload the liveness
             # machinery is parsed/verified ahead of queued client load
             while self._crit and len(out) < self._drain_max:
                 out.append(self._crit.popleft())
+            # own shard next (key-sharded routing: this worker's stable
+            # slice of the client principal population), then the shared
+            # buffer — so non-client traffic and unrouted clients never
+            # starve behind one shard's backlog
+            if mine is not None:
+                while mine and len(out) < self._drain_max:
+                    out.append(mine.popleft())
             while self._buf and len(out) < self._drain_max:
                 out.append(self._buf.popleft())
             if self._shedding \
-                    and len(self._buf) + len(self._crit) <= self._low:
+                    and self._client_depth() + len(self._crit) \
+                    <= self._low:
                 self._shedding = False
                 self.adm_shedding.set(0)
             return out
@@ -433,7 +496,7 @@ class AdmissionPipeline:
             self._stamp_beat(idx)     # health probe: a worker wedged
             # inside _drain stops stamping; once it is the stalest, the
             # probe age grows while depth does — that IS the stall
-            batch = self._next_batch()
+            batch = self._next_batch(idx)
             if not batch:
                 continue
             try:

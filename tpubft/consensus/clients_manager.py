@@ -5,12 +5,26 @@ Rebuild of the reference's ClientsManager
 highest executed request seqnum per client (for at-most-once execution),
 the pending (not yet committed) request, and caches the last reply so a
 retransmitted request gets the cached answer instead of re-execution.
+
+Million-principal shape: resident state is a bounded LRU over the
+reserved-pages machinery. `max_resident` caps how many `_ClientInfo`
+records stay in memory; a cold client's record is demand-paged back from
+its reply-ring pages through the `pager` callback (the replica wires
+`Replica._page_in_client`, which replays the same restore rule as a
+restart: ring membership + the oversize marker, sealed with the
+evict/reload floor). Eviction never loses at-most-once state because the
+reply ring IS the canonical record — execution persists every reply page
+before the in-memory table learns about it — so evict→reload is
+indistinguishable from a crash→restart for that one client, the
+semantics every restore test already pins down. Clients with in-flight
+(pending) requests are pinned resident: pending is memory-only state,
+and an active client is by definition hot.
 """
 from __future__ import annotations
 
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Callable, Optional
 
 from tpubft.consensus.messages import ClientBatchRequestMsg, ClientReplyMsg
 from tpubft.utils.racecheck import make_lock
@@ -33,6 +47,12 @@ REPLY_CACHE_PER_CLIENT = 2 * ClientBatchRequestMsg.MAX_BATCH
 # ARRIVE out of seq order (a later-allocated single can beat a batch to
 # the primary), so membership — not ordering — is the dedup test.
 MAX_PENDING_PER_CLIENT = 2 * ClientBatchRequestMsg.MAX_BATCH
+
+# how many LRU candidates one insert will pass over looking for an
+# evictable (pending-free) record before letting the table temporarily
+# exceed its bound — an O(1) cap so a burst of active clients degrades
+# to a slightly-over-budget table, never an O(resident) scan per insert
+_EVICT_SCAN_MAX = 8
 
 
 @dataclass
@@ -66,20 +86,101 @@ class ClientsManager:
     paths (admission check vs. reply-cache eviction) are guarded by one
     small lock (instrumented under TPUBFT_THREADCHECK)."""
 
-    def __init__(self, client_ids) -> None:
-        self._clients: Dict[int, _ClientInfo] = {c: _ClientInfo()
-                                                 for c in client_ids}
+    def __init__(self, client_ids, max_resident: int = 0,
+                 pager: Optional[Callable[[int], _ClientInfo]] = None
+                 ) -> None:
+        # the id universe: a `range` for production topologies (contiguous
+        # by construction — ReplicasInfo.all_client_ids — so membership is
+        # O(1) with O(1) memory even at 1M principals), any container with
+        # `in` otherwise (unit tests pass small lists)
+        self._universe = client_ids if isinstance(client_ids, range) \
+            else frozenset(client_ids)
+        # 0 = unbounded: every touched client stays resident (the legacy
+        # test-cluster shape, and the right answer when no pager exists)
+        self._max_resident = max_resident if pager is not None else 0
+        self._pager = pager
+        self._clients: "OrderedDict[int, _ClientInfo]" = OrderedDict()
+        if self._pager is None:
+            # eager population keeps the legacy O(clients)-resident shape
+            # for pager-less tables (unit tests, tiny topologies); a
+            # paged table starts empty and demand-pages
+            for c in self._universe:
+                self._clients[c] = _ClientInfo()
         self._mu = make_lock("clients_manager")
+        # table telemetry (racy reads fine — monotone counters)
+        self.table_hits = 0
+        self.table_misses = 0
+        self.table_evictions = 0
+
+    # ---- resident-table mechanics ----
+    @property
+    def resident_count(self) -> int:
+        return len(self._clients)
+
+    @property
+    def max_resident(self) -> int:
+        return self._max_resident
+
+    def set_max_resident(self, n: int) -> None:
+        """Autotuner actuator (client_table_max knob): retune the resident
+        bound live; shrinking evicts down on the next inserts rather than
+        synchronously (bounded work per operation)."""
+        if self._pager is not None:
+            self._max_resident = max(0, n)
+
+    def invalidate_all(self) -> None:
+        """Drop every pageable resident record (state transfer installed a
+        new page set under us — resident state may describe dead pages).
+        Pending is memory-only and the caller (view/ST machinery) clears
+        it separately; unbounded tables keep their records because no
+        pager could rebuild them."""
+        if self._pager is None:
+            return
+        with self._mu:
+            self._clients.clear()
+
+    def _resident(self, client_id: int) -> Optional[_ClientInfo]:
+        """Resident record for `client_id`, demand-paging it in (and LRU-
+        evicting past the bound) as needed. Caller holds self._mu. None
+        for ids outside the universe."""
+        info = self._clients.get(client_id)
+        if info is not None:
+            self._clients.move_to_end(client_id)
+            self.table_hits += 1
+            return info
+        if client_id not in self._universe:
+            return None
+        self.table_misses += 1
+        info = self._pager(client_id) if self._pager is not None \
+            else _ClientInfo()
+        self._clients[client_id] = info
+        if self._max_resident:
+            scanned = 0
+            while len(self._clients) > self._max_resident \
+                    and scanned < _EVICT_SCAN_MAX:
+                victim, vinfo = next(iter(self._clients.items()))
+                scanned += 1
+                if vinfo.pending:
+                    # pinned: in-flight requests are memory-only state —
+                    # rotate it to the MRU end and try the next candidate
+                    self._clients.move_to_end(victim)
+                    continue
+                # safe to drop: every executed reply was persisted to its
+                # ring page BEFORE this table learned of it, so the pager
+                # rebuilds an equivalent (restart-sealed) record
+                del self._clients[victim]
+                self.table_evictions += 1
+        return info
 
     def is_valid_client(self, client_id: int) -> bool:
-        return client_id in self._clients
+        return client_id in self._universe
 
     # ---- request admission (primary + all replicas) ----
     def can_become_pending(self, client_id: int, req_seq: int) -> bool:
-        info = self._clients.get(client_id)
-        if info is None:
-            return False
         with self._mu:
+            info = self._resident(client_id)
+            if info is None:
+                return False
             if self._executed(info, req_seq):
                 return False                   # already executed (dup)
             if req_seq in info.pending:
@@ -97,26 +198,31 @@ class ClientsManager:
         its record aged out of the bounded cache, which must be treated as
         executed). A lower seq than the newest execution is NOT evidence
         of a dup — requests complete out of order."""
-        info = self._clients.get(client_id)
-        if info is None:
-            return False
         with self._mu:
+            info = self._resident(client_id)
+            if info is None:
+                return False
             return self._executed(info, req_seq)
 
     def add_pending(self, client_id: int, req_seq: int, cid: str = "") -> None:
         with self._mu:
-            self._clients[client_id].pending[req_seq] = cid
+            info = self._resident(client_id)
+            if info is not None:
+                info.pending[req_seq] = cid
 
     def has_pending(self, client_id: int) -> bool:
-        return bool(self._clients[client_id].pending)
+        # resident-only read: a non-resident client cannot have pending
+        # requests (records with pending are pinned against eviction)
+        info = self._clients.get(client_id)
+        return bool(info is not None and info.pending)
 
     # ---- execution results ----
     def on_request_executed(self, client_id: int, req_seq: int,
                             reply: Optional[ClientReplyMsg]) -> None:
-        info = self._clients.get(client_id)
-        if info is None:
-            return
         with self._mu:
+            info = self._resident(client_id)
+            if info is None:
+                return
             if req_seq > info.last_executed_req:
                 info.last_executed_req = req_seq
             info.replies[req_seq] = reply
@@ -139,10 +245,10 @@ class ClientsManager:
         bounded per-client map so every element of an executed batch
         stays regenerable, not just the newest request). None for both
         never-executed and oversize-reply entries."""
-        info = self._clients.get(client_id)
-        if info is None:
-            return None
         with self._mu:
+            info = self._resident(client_id)
+            if info is None:
+                return None
             return info.replies.get(req_seq)
 
     def seal_restore(self, client_id: int) -> None:
@@ -151,7 +257,9 @@ class ClientsManager:
         any seq at or below the persisted newest-executed watermark that
         did not make it back into the ring may have executed and been
         evicted — refuse it. Without this seal, a restart would reopen the
-        at-most-once window for old validly-signed requests."""
+        at-most-once window for old validly-signed requests. The demand
+        pager applies the same seal to every record it rebuilds (an
+        evict/reload cycle is a single-client restart)."""
         info = self._clients.get(client_id)
         if info is not None and info.last_executed_req > info.evicted_high:
             info.evicted_high = info.last_executed_req

@@ -78,6 +78,11 @@ class CompletedRun:
     n_requests: int                       # executed (non-dedup) requests
     replies: List[Tuple[int, bytes]] = field(default_factory=list)
     reply_keys: List[Tuple[int, int]] = field(default_factory=list)
+    # optimistic-reply mode with the durability pipeline: replies built
+    # UNSIGNED during execution; the io thread signs the whole sealed
+    # group in one batched sign at the group boundary and appends the
+    # packed wire bytes to `replies` before the group burst
+    unsigned: List[Tuple[int, object]] = field(default_factory=list)
     # set by the durability pipeline when it already pushed `replies`
     # as part of the group-boundary send burst — the dispatcher's
     # integration pass must not send them a second time
@@ -821,6 +826,14 @@ class ExecutionLane:
         the dispatcher)."""
         r = self._r
         seen = self._run_seen
+        # batched reply signing (optimistic replies + durability
+        # pipeline): per-reply scalar signs during execution serialize
+        # ~100µs of comb math behind every request — defer them to the
+        # io thread, which signs the sealed GROUP in one batch at its
+        # fsync boundary (the reply cannot leave before that boundary
+        # anyway, so the deferral adds zero client-visible latency)
+        defer = getattr(r, "_opt_replies", False) \
+            and getattr(r, "durability", None) is not None
         for req in pp.client_requests():
             client = req.sender_id
             key = (client, req.req_seq_num)
@@ -838,7 +851,15 @@ class ExecutionLane:
             # GIL-atomic read; see _inflight.
             stashed = self._inflight.get(key)
             if stashed is not None:
-                result.replies.append((client, stashed.pack()))
+                if defer and not stashed.signature:
+                    # the stashed reply's own group has not signed it
+                    # yet — route the re-issue through THIS run's batch
+                    # sign instead of packing unsigned bytes (ed25519
+                    # signing is deterministic, so a double sign from
+                    # both groups lands identical bytes)
+                    result.unsigned.append((client, stashed))
+                else:
+                    result.replies.append((client, stashed.pack()))
                 continue
             if key in seen or r.clients.was_executed(client,
                                                      req.req_seq_num):
@@ -852,12 +873,15 @@ class ExecutionLane:
             payload = r._execute_request(req, seq)
             result.n_requests += 1
             reply, wire = r._build_reply(client, req.req_seq_num,
-                                         payload, pages_wb)
+                                         payload, pages_wb,
+                                         defer_sign=defer)
             executed_now.append((client, req.req_seq_num, reply))
             seen.add(key)
             result.reply_keys.append(key)
             if wire is not None:
                 result.replies.append((client, wire))
+            elif defer and not r.info.is_internal_client(client):
+                result.unsigned.append((client, reply))
         if r.cfg.time_service_enabled and pp.time:
             # agreed-time page writes must stay seq-ordered with the
             # reply pages for checkpoint digest determinism

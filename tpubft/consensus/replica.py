@@ -244,7 +244,16 @@ class Replica(IReceiver):
         self.window: ActiveWindow[SeqNumInfo] = ActiveWindow(
             cfg.work_window_size, SeqNumInfo)
         self.window.advance(st.last_stable_seq)
-        self.clients = ClientsManager(self.info.all_client_ids())
+        # bounded client table (million-principal shape): resident
+        # records LRU-capped at client_table_max, cold clients demand-
+        # paged back from their reply-ring reserved pages (the pager
+        # replays the per-client restart rule). 0 = legacy unbounded
+        # table with eager boot restore.
+        self.clients = ClientsManager(
+            self.info.all_client_ids(),
+            max_resident=cfg.client_table_max,
+            pager=(self._page_in_client
+                   if cfg.client_table_max > 0 else None))
         self.pending_requests: List[m.ClientRequestMsg] = []
         self.checkpoints: Dict[int, Dict[int, m.CheckpointMsg]] = {}
         # highest checkpoint seq stored per sender (memory bound: the
@@ -396,7 +405,8 @@ class Replica(IReceiver):
                 high_watermark=cfg.admission_high_watermark,
                 low_watermark=cfg.admission_low_watermark,
                 beat_fn=lambda: self.health.beat("admission"),
-                rid=cfg.replica_id)
+                rid=cfg.replica_id,
+                shard_by_key=cfg.admission_key_sharding)
             self.dispatcher.set_admitted_handler(self._on_admitted)
             self.health.register_probe(
                 "admission", cfg.health_stall_ms / 1e3,
@@ -719,9 +729,60 @@ class Replica(IReceiver):
         self._running = False
         self._restore_window(window_msgs)
 
+    def _page_in_client(self, client: int):
+        """Demand pager for the bounded client table: rebuild ONE
+        client's record from its reply-ring pages + oversize marker —
+        the same rule `_load_client_replies_from_pages` applies to every
+        client at boot, including the restore seal, so an evict/reload
+        cycle is a single-client restart. Cost is proportional to the
+        pages that EXIST for this client (one bounded range scan): a
+        never-seen principal pages in for O(log store)."""
+        from tpubft.consensus.clients_manager import (
+            REPLY_CACHE_PER_CLIENT as _RING, _ClientInfo)
+        info = _ClientInfo()
+        found = []
+        for _slot, raw in self.res_pages.scan(
+                "clientreplies", client * _RING, (client + 1) * _RING):
+            if not raw or raw[:1] != b"\x00":
+                continue
+            try:
+                reply = m.unpack(raw[1:])
+            except m.MsgError:
+                continue
+            if isinstance(reply, m.ClientReplyMsg):
+                # re-personalize the canonical page form
+                reply.sender_id = self.id
+                reply.current_primary = self.primary
+                found.append(reply)
+        # oldest-first insertion so later live evictions age correctly
+        for reply in sorted(found, key=lambda r: r.req_seq_num):
+            info.replies[reply.req_seq_num] = reply
+            if reply.req_seq_num > info.last_executed_req:
+                info.last_executed_req = reply.req_seq_num
+        raw = self.res_pages.load("clients", client)
+        if raw and raw[:1] == b"\x01":
+            # oversize-reply marker: at-most-once state only
+            seq = int.from_bytes(raw[1:9], "big")
+            info.replies.setdefault(seq, None)
+            if seq > info.last_executed_req:
+                info.last_executed_req = seq
+        # the restore seal (clients_manager.seal_restore): the persisted
+        # ring is bounded, so anything at or below the watermark that
+        # did not come back may have executed-and-evicted — refuse it
+        if info.last_executed_req > info.evicted_high:
+            info.evicted_high = info.last_executed_req
+        return info
+
     def _load_client_replies_from_pages(self) -> None:
         """Seed the at-most-once table + reply cache from reserved pages
         (reference: ClientsManager loadInfoFromReservedPages)."""
+        if self.cfg.client_table_max > 0:
+            # paged client table: records are demand-built one client at
+            # a time by _page_in_client under the same rules, so "reload
+            # everything" (boot, ST page install) is just dropping
+            # whatever is resident — never an O(clients) eager scan
+            self.clients.invalidate_all()
+            return
         from tpubft.consensus.clients_manager import \
             REPLY_CACHE_PER_CLIENT as _RING
         from tpubft.consensus.reserved_pages import ReservedPagesClient
@@ -2814,7 +2875,7 @@ class Replica(IReceiver):
         return b""
 
     def _build_reply(self, client: int, req_seq: int, payload: bytes,
-                     pages_batch=None):
+                     pages_batch=None, defer_sign: bool = False):
         """Build an executed request's reply + stage its persisted
         canonical form. Returns (reply_msg, wire_bytes_or_None) — the
         caller records it in the ClientsManager (immediately on the
@@ -2838,12 +2899,17 @@ class Replica(IReceiver):
         reply = m.ClientReplyMsg(sender_id=self.id, req_seq_num=req_seq,
                                  current_primary=self.primary, reply=payload,
                                  replica_specific_info=b"")
-        if self._opt_replies:
+        if self._opt_replies and not defer_sign:
             # optimistic replies: the client can no longer lean on the
             # certificate, so each replica vouches individually — f+1
             # MATCHING SIGNED replies is the client's acceptance rule.
             # sign() is thread-safe (pure signer + counter), so the
-            # execution lane may call this off the dispatcher
+            # execution lane may call this off the dispatcher. With
+            # `defer_sign` (execution lane + durability pipeline) the
+            # signature is batched per sealed GROUP on the io thread
+            # instead — the reply cannot leave before the group fsync,
+            # so deferring to that boundary is free; external replies
+            # then return wire=None and ride CompletedRun.unsigned
             reply.signature = self.sig.sign(reply.signed_payload())
         # at-most-once state rides reserved pages so it survives crashes
         # AND state transfer (reference keeps client replies in res pages).
@@ -2873,7 +2939,10 @@ class Replica(IReceiver):
                 REPLY_CACHE_PER_CLIENT as _RING
             save("clientreplies", client * _RING + req_seq % _RING,
                  canonical)
-        if self.info.is_internal_client(client):
+        if self.info.is_internal_client(client) \
+                or (defer_sign and self._opt_replies):
+            # internal replies are consumed in-process (never packed);
+            # deferred external replies pack AFTER the group sign
             return reply, None
         return reply, reply.pack()
 

@@ -71,12 +71,14 @@ class ReplicasInfo:
         reconfiguration/src/reconfiguration_handler.cpp)."""
         return self.first_internal_client_id + self.n
 
-    def all_client_ids(self) -> list:
-        """External clients + one internal client per replica + operator."""
-        return (list(range(self.first_client_id,
-                           self.first_client_id + self.num_clients))
-                + [self.internal_client_of(r) for r in self.replica_ids]
-                + [self.operator_id])
+    def all_client_ids(self) -> range:
+        """External clients + one internal client per replica + operator.
+        The id space is contiguous by construction (externals, then one
+        internal per replica, then the operator), so the universe is a
+        `range` — O(1) membership with O(1) memory, which is what keeps
+        million-principal topologies from materializing million-entry
+        sets in every consumer (ClientsManager, admission gates)."""
+        return range(self.first_client_id, self.operator_id + 1)
 
     def other_replicas(self, me: int) -> list:
         return [r for r in self.replica_ids if r != me]

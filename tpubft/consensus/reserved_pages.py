@@ -73,6 +73,20 @@ class ReservedPages:
         ledger readers observe sealed blocks."""
         self._db = db
 
+    def scan(self, category: str, lo_index: int,
+             hi_index: int) -> List[Tuple[int, bytes]]:
+        """EXISTING pages of `category` with lo_index <= index < hi_index,
+        as (index, data). One bounded range_iter — cost proportional to
+        the pages that exist in the range (zero for a cold client), never
+        to the range width: the demand pager's primitive, so paging in a
+        never-seen principal is O(log store), not O(ring slots)."""
+        out: List[Tuple[int, bytes]] = []
+        for k, v in self._db.range_iter(_FAMILY,
+                                        start=self._key(category, lo_index),
+                                        end=self._key(category, hi_index)):
+            out.append((int.from_bytes(k[-4:], "big"), v))
+        return out
+
     def all_pages(self) -> List[Tuple[bytes, bytes]]:
         return list(self._db.range_iter(_FAMILY))
 
@@ -108,6 +122,9 @@ class ReservedPagesClient:
 
     def load(self, index: int = 0) -> Optional[bytes]:
         return self._pages.load(self._category, index)
+
+    def scan(self, lo_index: int, hi_index: int):
+        return self._pages.scan(self._category, lo_index, hi_index)
 
     def save(self, data: bytes, index: int = 0) -> None:
         self._pages.save(self._category, index, data)

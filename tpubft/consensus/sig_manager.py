@@ -44,7 +44,8 @@ class SigManager:
                      [Sequence[Tuple[bytes, bytes, bytes]]],
                      List[bool]]] = None,
                  device_min_batch: int = 1,
-                 memo_capacity: int = 4096):
+                 memo_capacity: int = 4096,
+                 verifier_cache_max: int = 4096):
         self._keys = keys
         # cross-principal batch backend: [(scheme, pubkey, data, sig)] ->
         # verdicts in ONE dispatch per scheme (the TPU path; None =
@@ -60,13 +61,21 @@ class SigManager:
         # work window cannot order anyway)
         self.grace_seq_window = grace_seq_window
         # own copies: key exchange rotates keys per-replica-process, and the
-        # shared ClusterKeys dicts must not leak one node's view to others
+        # shared ClusterKeys dicts must not leak one node's view to others.
+        # Client keys are exempt — rotation never mutates them, so a
+        # virtual keyspace (a lazy Mapping deriving 1M principals' keys
+        # on demand, the bench_dispatch --principals shape) is kept by
+        # reference instead of being materialized into a 1M-entry dict.
         self._replica_pubkeys: Dict[int, bytes] = dict(keys.replica_pubkeys)
-        self._client_pubkeys: Dict[int, bytes] = dict(keys.client_pubkeys)
+        cpk = keys.client_pubkeys
+        self._client_pubkeys = dict(cpk) if type(cpk) is dict else cpk
         # rotation grace keys: principal -> (old pubkey, rotated_at)
         self._prev_pubkeys: Dict[int, Tuple[bytes, float]] = {}
         self._signer = keys.my_signer() if keys.my_sign_seed else None
-        self._verifiers: Dict[int, IVerifier] = {}
+        # bounded verifier cache: touched principals would otherwise pin
+        # one IVerifier each forever — O(principals) resident at scale
+        self._verifiers: "OrderedDict[int, IVerifier]" = OrderedDict()
+        self._verifier_cache_max = max(1, verifier_cache_max)
         self._prev_verifiers: Dict[int, IVerifier] = {}
         # verify() runs on the dispatcher AND on collector-pool workers
         # (async PP batches); key rotation + grace-key expiry mutate the
@@ -105,6 +114,20 @@ class SigManager:
         # through the coalesced cross-principal batch, and items that
         # fell back to the per-principal scalar loop
         self.memo_hits = self.metrics.register_counter("memo_hits")
+        # entries LRU-evicted from the bounded memo. At steady state a
+        # high eviction rate alongside a falling memo hit-rate means the
+        # live principal population outruns memo_capacity — the signal
+        # (with the client-table and comb-cache eviction counters) that
+        # distinguishes "cache too small" from "population churned"
+        # at million-principal scale (docs/OPERATIONS.md client-plane
+        # scaling section)
+        self.memo_evictions = self.metrics.register_counter(
+            "memo_evictions")
+        # per-principal verifier objects LRU-evicted from the bounded
+        # cache (re-created on next touch from the pubkey — an eviction
+        # costs one verifier construction, never correctness)
+        self.verifier_evictions = self.metrics.register_counter(
+            "verifier_evictions")
         self.batched_verifies = self.metrics.register_counter(
             "batched_verifies")
         self.scalar_fallbacks = self.metrics.register_counter(
@@ -126,6 +149,15 @@ class SigManager:
             "ecdsa_batched_host")
         self.pubkey_memo_hits = self.metrics.register_counter(
             "pubkey_memo_hits")
+        # bounded-LRU evictions in the scalar engine's per-principal
+        # caches (pubkey-decode entries / hot comb tables) attributed to
+        # this manager's verifies — read next to pubkey_memo_hits: a
+        # high eviction rate with a falling hit-rate means the worker's
+        # principal population outruns TPUBFT_ECDSA_PK_CACHE
+        self.ecdsa_pk_evictions = self.metrics.register_counter(
+            "ecdsa_pk_evictions")
+        self.ecdsa_comb_evictions = self.metrics.register_counter(
+            "ecdsa_comb_evictions")
         # cumulative wall time the batched host engine spent on THIS
         # manager's items (µs) — with ecdsa_batched_host this yields the
         # host tier's per-item cost, the sensor the autotuner compares
@@ -146,6 +178,23 @@ class SigManager:
         assert self._signer is not None, "no private key on this node"
         self.sigs_signed.inc()
         return self._signer.sign(data)
+
+    def sign_batch(self, datas: Sequence[bytes]) -> List[bytes]:
+        """Sign many payloads under this node's key in one call. Signers
+        exposing a native batch (the scalar ed25519 engine's lockstep
+        comb walk + Montgomery batch inversion) amortize the per-item
+        field inversions across the batch; others degrade to a loop.
+        The durability pipeline signs each sealed group's reply burst
+        through here — one batched sign per group instead of one scalar
+        sign per request (ROADMAP item 4b)."""
+        assert self._signer is not None, "no private key on this node"
+        if not datas:
+            return []
+        self.sigs_signed.inc(len(datas))
+        batch = getattr(self._signer, "sign_batch", None)
+        if batch is not None:
+            return batch(datas)
+        return [self._signer.sign(d) for d in datas]
 
     @property
     def my_id(self) -> Optional[int]:
@@ -213,15 +262,23 @@ class SigManager:
         # read a pre-rotation pubkey, lose the CPU to the dispatcher's
         # set_replica_key, then cache a verifier for the rotated-away key
         principal = self._alias(principal)
+        evicted = 0
         with self._lock:
             v = self._verifiers.get(principal)
-            if v is None:
-                pk = self._pubkey_of(principal)
-                if pk is None:
-                    raise KeyError(f"no public key for principal {principal}")
-                v = self._verifiers[principal] = self._make_verifier(
-                    pk, principal)
-            return v
+            if v is not None:
+                self._verifiers.move_to_end(principal)
+                return v
+            pk = self._pubkey_of(principal)
+            if pk is None:
+                raise KeyError(f"no public key for principal {principal}")
+            v = self._verifiers[principal] = self._make_verifier(
+                pk, principal)
+            while len(self._verifiers) > self._verifier_cache_max:
+                self._verifiers.popitem(last=False)
+                evicted += 1
+        if evicted:
+            self.verifier_evictions.inc(evicted)
+        return v
 
     def _grace_verifier(self, principal: int, seq: Optional[int],
                         view_scoped: bool = False) -> Optional[IVerifier]:
@@ -281,11 +338,15 @@ class SigManager:
         return False
 
     def _memo_add(self, key: Tuple) -> None:
+        evicted = 0
         with self._memo_lock:
             self._memo[key] = None
             self._memo.move_to_end(key)
             while len(self._memo) > self._memo_capacity:
                 self._memo.popitem(last=False)
+                evicted += 1
+        if evicted:
+            self.memo_evictions.inc(evicted)
 
     def verify(self, principal: int, data: bytes, sig: bytes,
                seq: Optional[int] = None,
@@ -419,6 +480,10 @@ class SigManager:
             self.ecdsa_host_us.inc(stats["host_ns"] // 1000)
         if stats["hits"]:
             self.pubkey_memo_hits.inc(stats["hits"])
+        if stats["evictions"]:
+            self.ecdsa_pk_evictions.inc(stats["evictions"])
+        if stats["comb_evictions"]:
+            self.ecdsa_comb_evictions.inc(stats["comb_evictions"])
         for size in stats["host_sizes"]:
             self._h_ecdsa_host_batch.record(size)
 

@@ -86,6 +86,16 @@ class Ed25519Signer(ISigner):
         return scalar.ed25519_sign(self.private_bytes, data,
                                    pk=self.public_bytes())
 
+    def sign_batch(self, datas) -> list:
+        """Batch signing seam (SigManager.sign_batch): OpenSSL stays a
+        per-item loop (its one-shot sign has no batch API), the
+        self-hosted engine amortizes the per-signature field inversion
+        across the batch (scalar.ed25519_sign_batch)."""
+        if self._sk is not None:
+            return [self._sk.sign(d) for d in datas]
+        return scalar.ed25519_sign_batch(self.private_bytes, datas,
+                                         pk=self.public_bytes())
+
     @property
     def signature_length(self) -> int:
         return ED25519_SIG_LEN

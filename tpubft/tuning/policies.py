@@ -326,6 +326,67 @@ def durability_amortize_policy() -> Policy:
     return policy
 
 
+def st_window_policy() -> Policy:
+    """`st_window_ranges` (state-transfer fetch pipelining) from the
+    transfer's own throughput history: SHRINK on any fresh
+    `source_failovers` — a failover means an outstanding range timed
+    out on its source, and a wide window multiplies the data parked
+    behind the slow/dead source when that happens; GROW while the
+    fetched-byte rate keeps rising interval over interval (the pipeline
+    is still source-bound, so more outstanding ranges buy throughput).
+    An interval with no fresh transfer traffic holds — an idle
+    replica's window must not wander, and the controller's degraded
+    rule (any non-CLOSED breaker resets knobs to defaults) already
+    covers a sick digest plane. Byte DELTAS stand in for
+    st_bytes_per_sec: controller intervals are fixed-length, so the
+    per-interval delta is the rate."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if prev is None:
+            return HOLD
+        if cur.counters.get("st_failovers_delta", 0.0) > 0:
+            return SHRINK
+        b = cur.counters.get("st_bytes_delta", 0.0)
+        pb = prev.counters.get("st_bytes_delta", 0.0)
+        if b <= 0.0 or pb <= 0.0:
+            return HOLD          # idle, or no prior interval to compare
+        if b * FALLING_RATIO >= pb:
+            return GROW          # rate still rising: widen the pipeline
+        return HOLD
+
+    return policy
+
+
+def client_table_policy() -> Policy:
+    """`client_table_max` (paged client-table residency bound) from
+    paging traffic: GROW while the table is THRASHING — evictions and
+    misses both advancing in the same interval means the LRU is
+    re-paging records it just evicted, so the live principal working
+    set doesn't fit; SHRINK when fresh table traffic runs with zero
+    evictions and the resident set sits under half the bound — the
+    bound is slack, and handing the memory back cannot touch a hot set
+    that small. Intervals without table traffic hold."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if prev is None:
+            return HOLD
+        hits = cur.counters.get("client_table_hits_delta", 0.0)
+        misses = cur.counters.get("client_table_misses_delta", 0.0)
+        if hits + misses <= 0.0:
+            return HOLD
+        evictions = cur.counters.get("client_table_evictions_delta", 0.0)
+        if evictions > 0.0 and misses / (hits + misses) > MINOR_FRAC:
+            return GROW
+        if evictions <= 0.0 \
+                and cur.depths.get("client_table", 0) < knob.value // 2:
+            return SHRINK
+        return HOLD
+
+    return policy
+
+
 def admission_watermark_policy() -> Policy:
     """Grow the shed watermark while the plane is shedding but
     admission wait is NOT the bottleneck (the queue would drain if

@@ -42,12 +42,14 @@ from tpubft.tuning.knobs import Knob, KnobRegistry, load_seed
 from tpubft.tuning.policies import (admission_watermark_policy,
                                     batch_amortize_policy,
                                     breaker_readmission_policy,
+                                    client_table_policy,
                                     crypto_shard_policy,
                                     device_min_batch_policy,
                                     durability_amortize_policy,
                                     ecdsa_crossover_policy,
                                     exec_accumulation_policy,
-                                    optimistic_combine_policy)
+                                    optimistic_combine_policy,
+                                    st_window_policy)
 from tpubft.utils import flight
 from tpubft.utils.logging import get_logger
 
@@ -206,9 +208,23 @@ def build_replica_tuning(replica, cfg) -> TuningController:
         if st_cfg is not None:
             st_cfg.window_ranges = int(v)
 
+    # fetch pipelining follows the transfer's own throughput history
+    # (ISSUE 19 satellite): grow while the fetched-byte rate rises,
+    # shrink on source failovers — a wide window multiplies the data
+    # parked behind a source that just timed out
     K("st_window_ranges", cfg.st_window_ranges, 1, 64, apply_st_window,
-      "st_blocks_per_sec / source scoreboard", "ranges")
-    controller.track("st_window_ranges")
+      "st_bytes_per_sec trend vs source_failovers", "ranges")
+    controller.add_policy("st_window_ranges", st_window_policy())
+
+    # --- paged client table (ISSUE 19): residency bound follows the
+    # paging traffic — grow under evict/re-page thrash, hand memory
+    # back when the resident set runs far under the bound ---
+    if replica.clients.max_resident:
+        K("client_table_max", cfg.client_table_max, 256, 1 << 20,
+          replica.clients.set_max_resident,
+          "client-table miss/eviction thrash vs resident slack",
+          "clients")
+        controller.add_policy("client_table_max", client_table_policy())
 
     def apply_breaker_cooldown(v: int) -> None:
         from tpubft.ops.dispatch import device_breaker
@@ -256,6 +272,8 @@ def _depths(replica) -> dict:
         d["admission"] = replica.admission.depth
     if getattr(replica, "durability", None) is not None:
         d["dur_lag"] = replica.durability.lag
+    if getattr(replica, "clients", None) is not None:
+        d["client_table"] = replica.clients.resident_count
     return d
 
 
@@ -266,4 +284,15 @@ def _counters(replica) -> dict:
         c["adm_shedding"] = 1 if replica.admission.shedding else 0
     if getattr(replica, "durability", None) is not None:
         c.update(replica.durability.stats())
+    st = getattr(replica, "state_transfer", None)
+    if st is not None:
+        # late-bound like the knob itself (kvbc attaches ST after
+        # construction); counter DELTAS are the policy's rate signal
+        c["st_bytes"] = st.m_bytes.value
+        c["st_failovers"] = st.m_failovers.value
+    clients = getattr(replica, "clients", None)
+    if clients is not None:
+        c["client_table_hits"] = clients.table_hits
+        c["client_table_misses"] = clients.table_misses
+        c["client_table_evictions"] = clients.table_evictions
     return c

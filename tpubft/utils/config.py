@@ -215,6 +215,16 @@ class ReplicaConfig:
     # CombinedSigVerificationJob); False = verify inline (debug only)
     async_verification: bool = True
 
+    # bounded client table (million-principal client plane): max client
+    # records resident in ClientsManager. Cold clients demand-page back
+    # from their reply-ring reserved pages under an LRU (clients with
+    # in-flight requests are pinned); the pager replays the per-client
+    # restart rule, so at-most-once dedup survives an evict/reload
+    # cycle exactly as it survives a restart. Autotuner-registered.
+    # 0 = legacy unbounded table with eager boot restore (every client
+    # O(1) resident forever — test-cluster shape only).
+    client_table_max: int = 4096
+
     # admission pipeline (transport → dispatcher): >0 = a pool of that
     # many admission workers does all stateless per-message work off
     # the dispatcher — header peek (dead-view/stale-seq/garbage drops
@@ -228,6 +238,16 @@ class ReplicaConfig:
     # max messages one admission drain cycle pulls from the ingest
     # queue (bounds verify-batch size and admission latency)
     admission_drain_max: int = 256
+    # key-sharded admission routing: with >1 admission workers, client
+    # datagrams route to a fixed worker by a stable hash of the wire
+    # principal, so each worker's verify batches / signature memo /
+    # per-principal comb caches see a disjoint, stable slice of the key
+    # population (cache hit-rates hold as principals scale instead of
+    # being diluted across every worker). Protocol-critical and
+    # consensus traffic stays on the shared queues. False = legacy
+    # shared-buffer draining (the A/B control; ledgers are
+    # byte-identical either way).
+    admission_key_sharding: bool = True
     # overload backpressure: when the admission ingest queue reaches the
     # high watermark the plane enters shed mode — fresh client requests
     # (ClientRequest/ClientBatch datagrams) are dropped at ingest (each
@@ -417,6 +437,8 @@ class ReplicaConfig:
             raise ValueError("execution_max_accumulation must be >= 1")
         if self.admission_workers < 0:
             raise ValueError("admission_workers must be >= 0")
+        if self.client_table_max < 0:
+            raise ValueError("client_table_max must be >= 0")
         if self.admission_drain_max < 1:
             raise ValueError("admission_drain_max must be >= 1")
         if self.admission_high_watermark \
