@@ -143,6 +143,26 @@ def test_optimistic_blackout_scenario_replays_identically():
     assert first["event_log_digest"] == second["event_log_digest"]
 
 
+@pytest.mark.slow          # ~47s: two full consensus floods under BLS
+def test_offload_byzantine_helper_scenario_replays_identically():
+    """ISSUE 20 acceptance: a helper that turns liar mid-flood is
+    caught by the on-replica soundness check before any verdict is
+    influenced (no failed write, no view change), breaker-evicted into
+    quarantine with no auto re-admission, and the flood continues
+    locally/on the honest helper — green on two runs of the same seed
+    with byte-identical event-log digests."""
+    by_name = cmp.matrix_by_name()
+    spec = by_name["offload-byzantine-helper-flood"]
+    first = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED, specs=[spec]).run()
+    assert first["failed"] == 0, json.dumps(first["scenarios"], indent=1)
+    assert first["scenarios"][0]["stats"]["leases_rejected"] > 0
+    second = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,
+                               specs=[spec]).run()
+    assert second["failed"] == 0, json.dumps(second["scenarios"],
+                                             indent=1)
+    assert first["event_log_digest"] == second["event_log_digest"]
+
+
 @pytest.mark.slow
 def test_full_smoke_matrix_green():
     art = cmp.ChaosCampaign(seed=cmp.DEFAULT_SEED,

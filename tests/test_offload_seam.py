@@ -1,0 +1,82 @@
+"""Tier-1 wiring for the offload-seam lint (tools/tpulint offload-seam
+pass, ISSUE 20): raw helper transport — importing
+tpubft.offload.protocol / tpubft.offload.helper, or calling
+.lease()/.send_frame()/.recv_frame() — is forbidden outside
+tpubft/offload/. The tier is safe only because every helper response
+funnels through the pool's soundness checks; a direct call site gets
+UNVERIFIED bytes one hop from a consensus verdict. Deliberate
+exceptions live in tools/tpulint/baseline.toml with a spelled-out
+justification."""
+import os
+import textwrap
+
+from tools.tpulint.passes import offload_seam
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+# the enumerable set of deliberate raw-transport sites outside the
+# seam — everything here MUST also carry a baseline.toml entry
+_BASELINED: set = {
+    # the chaos campaign's byzantine-helper flood IS the fault
+    # injector: it builds a lying HelperServer to attack the seam from
+    # outside and asserts the verified wrappers catch it
+    os.path.join("tpubft", "testing", "campaign.py"),
+}
+
+
+def test_tree_is_clean_modulo_baseline():
+    violations = offload_seam.find_violations(_ROOT)
+    extra = [(p, ln, sym, msg) for p, ln, sym, msg in violations
+             if p not in _BASELINED]
+    assert extra == [], (
+        "raw offload transport/lease call sites outside the seam:\n"
+        + "\n".join(f"{p}:{ln}: {msg}" for p, ln, _s, msg in extra))
+    # and the baselined set cannot silently grow or rot
+    assert {p for p, _ln, _s, _m in violations} == _BASELINED
+
+
+def test_lint_catches_all_forbidden_forms(tmp_path):
+    """Each seeded defect — a protocol import, a helper-engine import,
+    a from-import, a .lease() call, raw frame I/O — is a finding; the
+    seam package itself is exempt; pool-wrapper consumers are clean."""
+    pkg = tmp_path / "tpubft" / "consensus"
+    pkg.mkdir(parents=True)
+    (pkg / "rogue.py").write_text(textwrap.dedent("""\
+        import tpubft.offload.protocol as proto
+        from tpubft.offload import helper
+        from tpubft.offload.protocol import send_frame
+
+        def a(pool, payload):
+            return pool.lease(1, payload, 4)
+
+        def b(sock, body):
+            send_frame(sock, body)
+            return proto.recv_frame(sock)
+
+        def not_a_finding():
+            from tpubft.ops.dispatch import offload_pool
+            from tpubft.offload.pool import combine_via_offload
+            return offload_pool, combine_via_offload
+    """))
+    seam = tmp_path / "tpubft" / "offload"
+    seam.mkdir(parents=True)
+    (seam / "pool.py").write_text(textwrap.dedent("""\
+        from tpubft.offload import protocol as proto
+
+        def lease_round(h, sock, body):
+            proto.send_frame(sock, body)
+            return proto.recv_frame(sock)
+    """))
+    violations = offload_seam.find_violations(str(tmp_path))
+    rel = os.path.join("tpubft", "consensus", "rogue.py")
+    assert {p for p, _ln, _s, _m in violations} == {rel}, violations
+    symbols = sorted(s for _p, _ln, s, _m in violations)
+    assert symbols == [".lease", ".recv_frame",
+                       "tpubft.offload.helper",
+                       "tpubft.offload.protocol",
+                       "tpubft.offload.protocol"], symbols
+
+
+def test_zero_scan_fails_loudly(tmp_path):
+    violations = offload_seam.find_violations(str(tmp_path))
+    assert violations and "wrong root" in violations[0][3]

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from tools.tpulint.passes import (blocking, crashpoints, device_seam,
                                   fsync_seam, hotpath, imports_,
-                                  lockorder, races, roles)
+                                  lockorder, offload_seam, races, roles)
 
 # pass id -> module exposing run(ctx) -> List[Finding]
 REGISTRY = {
@@ -17,5 +17,6 @@ REGISTRY = {
     hotpath.PASS_ID: hotpath,             # hotpath
     device_seam.PASS_ID: device_seam,     # device-seam
     fsync_seam.PASS_ID: fsync_seam,       # fsync-seam (durability)
+    offload_seam.PASS_ID: offload_seam,   # offload-seam (crypto tier)
     crashpoints.PASS_ID: crashpoints,     # crashpoints
 }

@@ -1,0 +1,119 @@
+"""Pass: offload-seam lint (ISSUE 20 — the verified crypto-offload
+tier's single-seam guarantee).
+
+The offload tier is safe ONLY because every helper response funnels
+through `HelperPool.lease()` and the soundness checks behind the
+`*_via_offload` wrappers in `tpubft/offload/pool.py`. A call site that
+imports the raw transport (`tpubft.offload.protocol`), talks to the
+helper engine directly (`tpubft.offload.helper`), or issues its own
+`.lease()` / frame I/O from outside the package gets UNVERIFIED bytes
+— a lying helper's output one hop from a consensus verdict. So,
+device-seam-style: any lease/transport call site outside
+
+  * `tpubft/offload/`  — the tier itself (pool, soundness, protocol,
+                         helper daemon)
+
+is a finding. Consumers integrate via `ops/dispatch.offload_pool()`
+and the high-level verified wrappers (`combine_via_offload`,
+`sum_via_offload`, `ecdsa_via_offload`) — never the seam internals.
+Benchmarks/tests that legitimately drive the raw protocol (fault
+injection, the bench harness) live in baseline.toml with their
+justification — enumerable, not invisible.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Tuple
+
+from tools.tpulint.core import Finding, ScanError, load_modules
+
+PASS_ID = "offload-seam"
+
+# modules whose import OUTSIDE the seam means raw-transport access
+FORBIDDEN_MODULES = {
+    "tpubft.offload.protocol",
+    "tpubft.offload.helper",
+}
+# attribute calls that issue leases or move raw frames; `lease` with
+# keyword/extra args is still a lease — match by name alone
+LEASE_ATTRS = {"lease", "send_frame", "recv_frame"}
+
+ALLOWED_PREFIXES = (
+    os.path.join("tpubft", "offload") + os.sep,
+)
+ALLOWED_FILES: set = set()
+
+
+def scan_tree(tree: ast.Module,
+              rel: str) -> List[Tuple[str, int, str, str]]:
+    """(rel, line, symbol, message) per violating site; `symbol` keys
+    the baseline (stable across line churn, like device-seam)."""
+    out: List[Tuple[str, int, str, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in FORBIDDEN_MODULES:
+                    out.append((rel, node.lineno, alias.name,
+                                f"imports {alias.name} — raw offload "
+                                f"transport outside the seam; integrate "
+                                f"via ops/dispatch.offload_pool() and "
+                                f"the verified *_via_offload wrappers"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in FORBIDDEN_MODULES:
+                out.append((rel, node.lineno, mod,
+                            f"imports from {mod} — raw offload "
+                            f"transport outside the seam; integrate "
+                            f"via ops/dispatch.offload_pool() and the "
+                            f"verified *_via_offload wrappers"))
+            elif mod == "tpubft.offload":
+                for alias in node.names:
+                    full = f"{mod}.{alias.name}"
+                    if full in FORBIDDEN_MODULES:
+                        out.append((rel, node.lineno, full,
+                                    f"imports {full} — raw offload "
+                                    f"transport outside the seam"))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in LEASE_ATTRS:
+            out.append((rel, node.lineno, f".{node.func.attr}",
+                        f"calls .{node.func.attr}() — lease/frame "
+                        f"traffic belongs inside tpubft/offload/; a "
+                        f"direct call gets UNVERIFIED helper bytes "
+                        f"(no soundness check between a lying helper "
+                        f"and a consensus verdict)"))
+    return out
+
+
+def violations_for(mods, syntax) -> List[Tuple[str, int, str, str]]:
+    out: List[Tuple[str, int, str, str]] = []
+    for f in syntax:
+        out.append((f.path, f.line, "syntax", f.message))
+    for sm in mods:
+        if sm.rel in ALLOWED_FILES \
+                or sm.rel.startswith(ALLOWED_PREFIXES):
+            continue
+        out.extend(scan_tree(sm.tree, sm.rel))
+    return sorted(out)
+
+
+def find_violations(root: str) -> List[Tuple[str, int, str, str]]:
+    try:
+        mods, syntax = load_modules(root, ("tpubft",))
+    except ScanError:
+        # a wrong root must FAIL, not report a vacuous OK — same
+        # convention as the device-seam lint
+        return [(os.path.join(root, "tpubft"), 0, "scan",
+                 "no Python modules found to scan — wrong root? "
+                 "(expected <root>/tpubft/**/*.py)")]
+    return violations_for(mods, syntax)
+
+
+def run(ctx) -> List[Finding]:
+    mods, syntax = ctx.load("tpubft")
+    findings: List[Finding] = []
+    for rel, line, symbol, msg in violations_for(mods, syntax):
+        findings.append(Finding(PASS_ID, rel, line, f"{rel}:{symbol}",
+                                msg))
+    return findings

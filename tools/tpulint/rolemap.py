@@ -93,6 +93,10 @@ THREAD_ROLES: Dict[FuncId, FrozenSet[str]] = {
         frozenset({"diagnostics"}),
     ("tpubft/diagnostics/server.py", "DiagnosticsServer", "_serve"):
         frozenset({"diagnostics"}),
+    ("tpubft/offload/helper.py", "HelperDaemon", "_accept_loop"):
+        frozenset({"offload_helper"}),
+    ("tpubft/offload/helper.py", "HelperDaemon", "_serve"):
+        frozenset({"offload_helper"}),
     ("tpubft/thinreplica/server.py", "ThinReplicaServer", "_accept_loop"):
         frozenset({"thinreplica_srv"}),
     ("tpubft/thinreplica/server.py", "ThinReplicaServer", "_serve"):

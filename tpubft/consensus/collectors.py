@@ -358,3 +358,45 @@ class CollectorPool:
         if self._combiner is not None:
             self._combiner.stop()
         self._pool.shutdown(wait=False)
+
+
+class ByzTelemetry:
+    """Per-origin Byzantine-evidence counters (ISSUE 20 satellite).
+
+    The combine plane already IDENTIFIES misbehaving share origins
+    (`CombineResult.bad_shares`, the deferred-cert poison path) but the
+    evidence was consumed anonymously — one aggregate counter, no way
+    to tell "replica 3 keeps sending garbage" from background noise.
+    This rolls it up per ORIGIN replica id so `status get health` and
+    flight dumps answer *who*:
+
+      * bad_shares[origin]             — threshold shares that failed
+        share-level identification after a combine-verify miss
+        (replica._on_combine_result pops them; origin = signer_id - 1)
+      * deferred_cert_failures[origin] — async cert verifications that
+        failed AFTER structural acceptance, keyed by the cert's sender
+        (the optimistic plane's poison trigger)
+
+    Counters only — classification/eviction stays with the callers.
+    Thread-safe: the dispatcher and verify workers both report."""
+
+    def __init__(self) -> None:
+        import threading
+        self._mu = threading.Lock()
+        self.bad_shares: Dict[int, int] = {}
+        self.deferred_cert_failures: Dict[int, int] = {}
+
+    def bad_share(self, origin: int) -> None:
+        with self._mu:
+            self.bad_shares[origin] = self.bad_shares.get(origin, 0) + 1
+
+    def deferred_cert_failure(self, origin: int) -> None:
+        with self._mu:
+            self.deferred_cert_failures[origin] = \
+                self.deferred_cert_failures.get(origin, 0) + 1
+
+    def snapshot(self) -> Dict[str, Dict[int, int]]:
+        with self._mu:
+            return {"bad_shares": dict(self.bad_shares),
+                    "deferred_cert_failures":
+                        dict(self.deferred_cert_failures)}

@@ -89,6 +89,7 @@ class HealthMonitor:
         self._mu = threading.Lock()
         self._probes: Dict[str, _Probe] = {}
         self._degraded_flags: Dict[str, Callable[[], bool]] = {}
+        self._info_sections: Dict[str, Callable[[], object]] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
@@ -135,6 +136,16 @@ class HealthMonitor:
         pulls the aggregate verdict to `degraded` while set."""
         with self._mu:
             self._degraded_flags[name] = fn
+
+    def register_info_section(self, name: str,
+                              fn: Callable[[], object]) -> None:
+        """An informational payload merged into verdict() under `name`.
+        Purely additive observability — sections never move the
+        aggregate verdict (that is what probes/flags/breakers are for);
+        a raising section reports its error string instead of taking
+        the monitor down."""
+        with self._mu:
+            self._info_sections[name] = fn
 
     def beat(self, name: str) -> None:
         now = self._clock()
@@ -209,6 +220,22 @@ class HealthMonitor:
         from tpubft.parallel import sharding as _sh
         if _sh._MESH_MGR is not None:
             out["mesh"] = _sh._MESH_MGR.snapshot()
+        # offload summary (ISSUE 20): helper roster / quarantine set /
+        # lease counters, same rationale as the mesh section — visible
+        # without decoding per-helper `helper.<id>` breaker rows. Gated
+        # on the module being live so chip-less or offload-off
+        # deployments pay nothing (pool construction registers a flight
+        # dump provider; don't force that from a read path).
+        _off = sys.modules.get("tpubft.offload.pool")
+        if _off is not None and _off._POOL is not None:
+            out["offload"] = _off._POOL.snapshot()
+        with self._mu:
+            sections = list(self._info_sections.items())
+        for name, fn in sections:
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001 — a section source
+                out[name] = f"<error: {e}>"  # must not kill the monitor
         return out
 
     def render(self) -> str:

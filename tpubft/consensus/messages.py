@@ -702,18 +702,33 @@ class ReqViewPrePrepareMsg(ConsensusMsg):
             ("pp_digest", "bytes")]
 
 
+# Capability bits advertised in ReplicaStatusMsg.capabilities (ROADMAP
+# 4a, first half): a wire-visible declaration of optional planes so
+# mixed clusters are DETECTABLE — peers record what each replica
+# advertises (surfaced via `status get health`), clients infer the
+# optimistic plane from signed replies. No negotiation logic rides
+# these bits yet; they are observability, not protocol.
+CAP_OPT_REPLIES = 1 << 0     # optimistic reply plane active
+CAP_OFFLOAD = 1 << 1         # verified crypto-offload tier configured
+
+
 @register
 @dataclass
 class ReplicaStatusMsg(ConsensusMsg):
-    """Reference ReplicaStatusMsg.hpp: periodic gap-detection beacon."""
+    """Reference ReplicaStatusMsg.hpp: periodic gap-detection beacon.
+    Carries the sender's capability bitmap (see CAP_*): status beacons
+    reach every peer on a timer, making them the natural place to
+    advertise optional planes without a new message type."""
     CODE = MsgCode.ReplicaStatus
     sender_id: int
     view: int
     last_stable_seq: int
     last_executed_seq: int
     in_view_change: bool
+    capabilities: int = 0
     SPEC = [("sender_id", "u32"), ("view", "u64"), ("last_stable_seq", "u64"),
-            ("last_executed_seq", "u64"), ("in_view_change", "bool")]
+            ("last_executed_seq", "u64"), ("in_view_change", "bool"),
+            ("capabilities", "u32")]
 
 
 @register

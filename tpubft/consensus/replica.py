@@ -31,8 +31,8 @@ from tpubft.comm.interfaces import ICommunication, IReceiver
 from tpubft.consensus import messages as m
 from tpubft.consensus.aggregation import overlay_for
 from tpubft.consensus.clients_manager import ClientsManager
-from tpubft.consensus.collectors import (CollectorPool, CombineResult,
-                                         ShareCollector)
+from tpubft.consensus.collectors import (ByzTelemetry, CollectorPool,
+                                         CombineResult, ShareCollector)
 from tpubft.consensus.controller import CommitPathController
 from tpubft.consensus.epoch import EpochManager
 from tpubft.consensus.incoming import Dispatcher, IncomingMsgsStorage
@@ -156,6 +156,23 @@ class Replica(IReceiver):
             cooldown_s=cfg.breaker_cooldown_ms / 1e3,
             latency_slo_s=cfg.breaker_latency_slo_ms / 1e3,
             max_cooldown_s=cfg.breaker_cooldown_ms / 1e3 * 16)
+        # --- verified crypto-offload tier (tpubft/offload/): lease the
+        # heavy MSM/combine work to untrusted helper processes; every
+        # returned result passes the constant-size soundness check
+        # on-replica before it can influence any verdict. The pool is
+        # process-wide like the device breaker (helpers serve the
+        # process, not one replica); endpoint list is additive so
+        # in-process tests can pre-register InprocHelper transports.
+        if cfg.offload_enabled:
+            from tpubft.ops.dispatch import offload_pool
+            pool = offload_pool()
+            pool.configure(enabled=True,
+                           lease_timeout_ms=cfg.offload_lease_timeout_ms,
+                           max_inflight=cfg.offload_max_inflight)
+            for ep in filter(None, cfg.offload_helpers.split(",")):
+                hid, addr = ep.split("=", 1)
+                host, port = addr.rsplit(":", 1)
+                pool.add_endpoint(hid.strip(), host.strip(), int(port))
         self.health = HealthMonitor(f"replica{cfg.replica_id}",
                                     self.aggregator,
                                     poll_s=cfg.health_poll_ms / 1e3)
@@ -352,6 +369,25 @@ class Replica(IReceiver):
         # and kinds drain into ONE combine_batch call per flush (BLS:
         # one segmented multi-MSM launch + one RLC pairing check for
         # the whole batch) instead of one combine job per slot
+        # per-origin Byzantine evidence rollup (bad shares identified by
+        # the combine plane, deferred-cert failures from the async
+        # verify path) — surfaced via `status get health` and flight
+        # dumps so a repeat offender is attributable, not just counted
+        self.byz_telemetry = ByzTelemetry()
+        self.health.register_info_section("byzantine",
+                                          self.byz_telemetry.snapshot)
+        flight.register_dump_provider(f"byzantine.r{self.id}",
+                                      self.byz_telemetry.snapshot)
+        # wire-visible capability advertisement (satellite of ISSUE 20):
+        # peers' CAP_* bitmaps as recorded off their status beacons, so
+        # a mixed cluster (some replicas running the optimistic reply
+        # plane, some not) is detectable from any one replica's health
+        # payload. Observability only — nothing negotiates off this.
+        self.peer_capabilities: Dict[int, int] = {}
+        self.health.register_info_section(
+            "capabilities",
+            lambda: {"self": self._my_capabilities(),
+                     "peers": dict(self.peer_capabilities)})
         self.collector_pool = CollectorPool(
             lambda res: self.incoming.push_internal("combine", res),
             fused=cfg.fused_combine,
@@ -2134,6 +2170,8 @@ class Replica(IReceiver):
             if col is not None:
                 for sid in res.bad_shares:
                     col.shares.pop(sid, None)
+                    # signer ids are 1-based; origin replica is sid-1
+                    self.byz_telemetry.bad_share(sid - 1)
                 self.collector_pool.maybe_launch(col)
             return
         pp = info.pre_prepare
@@ -2279,8 +2317,13 @@ class Replica(IReceiver):
             tools = self._cert_tools(msg, kind)
             if tools is not None and tools != "early":
                 self._accept_cert(msg, kind)
-        elif (info is not None and info.opt_committed
-                and not info.committed and kind != "prepare"):
+        else:
+            # per-origin evidence: a cert that failed the DEFERRED check
+            # passed the structural one, so its sender forged or relayed
+            # a bad combined signature — attributable, count it
+            self.byz_telemetry.deferred_cert_failure(msg.sender_id)
+        if not ok and (info is not None and info.opt_committed
+                       and not info.committed and kind != "prepare"):
             # the deferred pairing check FAILED on a slot we already
             # released optimistically: an actively-forging peer slipped a
             # structurally-valid cert past us. The reply the client got
@@ -2968,7 +3011,8 @@ class Replica(IReceiver):
             sender_id=self.id, view=self.view,
             last_stable_seq=self.last_stable,
             last_executed_seq=self.last_executed,
-            in_view_change=self.in_view_change)
+            in_view_change=self.in_view_change,
+            capabilities=self._my_capabilities())
         self._broadcast(status)
         # restart votes are liveness-critical for the n/n proof: keep
         # re-announcing until the proof forms (peers may have been
@@ -2980,6 +3024,18 @@ class Replica(IReceiver):
 
     MAX_GAP_RESEND = 8
 
+    def _my_capabilities(self) -> int:
+        """CAP_* bitmap this replica advertises on status beacons.
+        Clients can already infer CAP_OPT_REPLIES from the wire (an
+        optimistic reply carries a signature before the combine check
+        lands); this makes the same fact peer-visible and auditable."""
+        caps = 0
+        if self._opt_replies:
+            caps |= m.CAP_OPT_REPLIES
+        if self.cfg.offload_enabled:
+            caps |= m.CAP_OFFLOAD
+        return caps
+
     def _on_replica_status(self, msg: m.ReplicaStatusMsg) -> None:
         """A peer is behind: push it what it's missing. Status is
         advisory/unsigned — worst case a spoofed one costs a bounded
@@ -2987,6 +3043,9 @@ class Replica(IReceiver):
         peer = msg.sender_id
         if peer == self.id:
             return
+        # record the peer's advertised capability bitmap (advisory,
+        # like the rest of the beacon — mixed-cluster detection only)
+        self.peer_capabilities[peer] = msg.capabilities
         # (a) peer in an older view: resend the proof of ours so it can
         # enter (NewViewMsg + the ViewChangeMsgs it references)
         if msg.view < self.view and self._entered_view_proof is not None:

@@ -306,10 +306,14 @@ class BlsThresholdVerifier(IThresholdVerifier):
             pts[sid] = pt
         return pts
 
-    def _combine_segments(self, segments) -> List:
+    def _combine_segments(self, segments, digests=None) -> List:
         """[(ids, [share points])] -> one combined G1 point per segment.
         Host path: per-segment Lagrange + MSM; the TPU subclass folds
-        every segment into ONE segmented multi-MSM device launch."""
+        every segment into ONE segmented multi-MSM device launch (and,
+        with the offload tier active, leases the launch to a verified
+        helper first). `digests` carries the per-segment slot digests —
+        unused here, but the offload soundness check needs them to bind
+        each returned point to its statement."""
         return [bls.combine_shares(ids, pts) if ids else None
                 for ids, pts in segments]
 
@@ -328,7 +332,8 @@ class BlsThresholdVerifier(IThresholdVerifier):
         for _digest, pts in decoded:
             ids = sorted(pts)[: self._threshold]
             segments.append((ids, [pts[i] for i in ids]))
-        combined = self._combine_segments(segments)
+        combined = self._combine_segments(
+            segments, digests=[digest for digest, _ in decoded])
         sigs = [bls.g1_compress(pt) for pt in combined]
         verdicts = self.verify_batch_certs(
             [(digest, sig) for (digest, _), sig in zip(decoded, sigs)])
@@ -665,11 +670,16 @@ class BlsMultisigVerifier(IThresholdVerifier):
                 taken.add(key)
         return entries
 
-    def _sum_segments(self, segments: List[List[object]]) -> List[object]:
+    def _sum_segments(self, segments: List[List[object]],
+                      meta=None) -> List[object]:
         """[[points]] -> one unweighted G1 sum per segment. Host path:
         sequential adds; the TPU subclass folds every segment into ONE
         all-ones-scalar segmented multi-MSM launch (the PR 11 kernel,
-        new call shape)."""
+        new call shape). `meta` = per-segment (digest, contributor ids)
+        or None — only the offload tier consumes it (the soundness
+        check verifies each leased sum against its contributors'
+        aggregate pk); `aggregate_partials` passes none, so interior
+        overlay sums never offload (no digest to bind them to)."""
         out = []
         for pts in segments:
             acc = None
@@ -689,12 +699,19 @@ class BlsMultisigVerifier(IThresholdVerifier):
     def combine_batch(self, jobs) -> List[Tuple[bool, bytes, List[int]]]:
         decoded = [(digest, self._decode_job_entries(shares))
                    for digest, shares in jobs]
+        # contributor ids are known BEFORE the sums (they come from the
+        # entry bitmaps, not the arithmetic) — computing them first
+        # hands the offload tier the metadata its soundness check binds
+        # each leased sum to
+        ids_list = [tuple(sorted(i for ids, _ in entries.values()
+                                 for i in ids))
+                    for _, entries in decoded]
         sums = self._sum_segments(
-            [[pt for _, pt in entries.values()] for _, entries in decoded])
-        certs = []
-        for (_, entries), pt in zip(decoded, sums):
-            ids = sorted(i for ids, _ in entries.values() for i in ids)
-            certs.append(pack_agg_cert(ids, pt) if ids else b"")
+            [[pt for _, pt in entries.values()] for _, entries in decoded],
+            meta=[(digest, ids) if ids else None
+                  for (digest, _), ids in zip(decoded, ids_list)])
+        certs = [pack_agg_cert(list(ids), pt) if ids else b""
+                 for ids, pt in zip(ids_list, sums)]
         verdicts = self.verify_batch_certs(
             [(digest, cert) for (digest, _), cert in zip(decoded, certs)])
         out: List[Tuple[bool, bytes, List[int]]] = []

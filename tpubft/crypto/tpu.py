@@ -144,8 +144,20 @@ def verify_batch_mixed(items: Sequence[Tuple[str, bytes, bytes, bytes]]
             curve = ("secp256k1" if "k1" in scheme else "secp256r1")
             if len(sub) >= ecdsa_crossover():
                 from tpubft.ops import ecdsa as ops_ecdsa
-                verdicts = [bool(x) for x in ops_ecdsa.rlc_verify_batch(
-                    curve, [(d, s, pk) for _, pk, d, s in sub])]
+                rlc_items = [(d, s, pk) for _, pk, d, s in sub]
+
+                def _local_rlc(items=rlc_items, curve=curve):
+                    return [bool(x) for x in
+                            ops_ecdsa.rlc_verify_batch(curve, items)]
+                # offload tier first: a helper eats the verdict storm,
+                # the replica pays ONE re-fold launch instead of the
+                # bisection descent; None = no lease (pool inactive /
+                # at capacity / helpers down) -> local path unchanged
+                from tpubft.offload import pool as offload
+                verdicts = offload.ecdsa_via_offload(curve, rlc_items,
+                                                     _local_rlc)
+                if verdicts is None:
+                    verdicts = _local_rlc()
             else:
                 from tpubft.crypto import scalar as _scalar
                 verdicts = _scalar.ecdsa_verify_batch(
@@ -374,12 +386,28 @@ class TpuBlsThresholdVerifier(BlsThresholdVerifier):
                         ) -> TpuBlsThresholdAccumulator:
         return TpuBlsThresholdAccumulator(self, with_share_verification)
 
-    def _combine_segments(self, segments) -> List:
-        """Fused-combine device path: every slot's Lagrange-weighted MSM
-        in ONE segmented `msm_batch_kernel` launch (combine_batch's
-        whole flush pays one `bls_msm` dispatch instead of one per
-        slot). Below the measured crossover the host Pippenger path
-        wins even fused — same knob as the per-slot accumulator."""
+    def _combine_segments(self, segments, digests=None) -> List:
+        """Fused-combine with backend tiering: offload (leased to a
+        verified helper, ISSUE 20) -> device -> host. A lease only
+        happens when the pool is active AND the caller supplied the
+        slot digests the soundness check binds to; any failed/evicted
+        lease re-runs on the local tiers inside this same call, so the
+        returned points are byte-identical with offload on or off."""
+        if digests is not None:
+            from tpubft.offload import pool as offload
+            leased = offload.combine_via_offload(
+                segments, digests, self._master_pk,
+                lambda: self._combine_segments_local(segments))
+            if leased is not None:
+                return leased
+        return self._combine_segments_local(segments)
+
+    def _combine_segments_local(self, segments) -> List:
+        """Device path: every slot's Lagrange-weighted MSM in ONE
+        segmented `msm_batch_kernel` launch (combine_batch's whole
+        flush pays one `bls_msm` dispatch instead of one per slot).
+        Below the measured crossover the host Pippenger path wins even
+        fused — same knob as the per-slot accumulator."""
         import os
         total = sum(len(ids) for ids, _ in segments)
         crossover = int(os.environ.get("TPUBFT_MSM_CROSSOVER_K", "128"))
@@ -405,7 +433,17 @@ class TpuBlsMultisigVerifier(BlsMultisigVerifier):
     (root of the aggregation overlay) and `aggregate_partials` (interior
     nodes), so one flush is one launch in both roles."""
 
-    def _sum_segments(self, segments) -> List:
+    def _sum_segments(self, segments, meta=None) -> List:
+        if meta is not None and any(m is not None for m in meta):
+            from tpubft.offload import pool as offload
+            leased = offload.sum_via_offload(
+                segments, meta, self,
+                lambda: self._sum_segments_local(segments))
+            if leased is not None:
+                return leased
+        return self._sum_segments_local(segments)
+
+    def _sum_segments_local(self, segments) -> List:
         import os
         total = sum(len(pts) for pts in segments)
         crossover = int(os.environ.get("TPUBFT_MSM_CROSSOVER_K", "128"))

@@ -181,3 +181,19 @@ def mesh_launch(kind: str, launch):
         except Exception:
             if not mgr.on_launch_failure(plan, kind):
                 raise
+
+
+# ---------------------------------------------------------------------
+# offload tier (ISSUE 20): rented, untrusted, verified helpers
+# ---------------------------------------------------------------------
+
+def offload_pool():
+    """The process-wide verified crypto-offload HelperPool (replica
+    wiring configures it from ReplicaConfig; the health plane and the
+    `offload_route` knob actuator reach it here). Like the mesh, the
+    pool is just another backend tier behind the crypto call sites:
+    kernels keep their device/mesh/host paths and consult the pool's
+    verified API first — a failed or evicted lease re-runs on the local
+    tiers inside the same flush."""
+    from tpubft.offload.pool import get_offload_pool
+    return get_offload_pool()

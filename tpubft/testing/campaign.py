@@ -257,6 +257,14 @@ class ChaosCampaign:
             tpu.set_ecdsa_crossover(None)
         except Exception:  # noqa: BLE001 — cleanup is best-effort
             pass
+        try:
+            # the offload pool is process-wide: quarantined helpers,
+            # per-helper breaker trips and lease counters from one
+            # scenario must not leak into the next one's crypto traffic
+            from tpubft.offload.pool import reset_offload_pool
+            reset_offload_pool()
+        except Exception:  # noqa: BLE001 — cleanup is best-effort
+            pass
 
 
 # ----------------------------------------------------------------------
@@ -652,6 +660,99 @@ def scenario_mesh_chip_fault_flood(ctx: ScenarioContext) -> dict:
             "rebalance_ms": snap["last_rebalance_ms"],
             "flood_batches": n_batches,
             "shards_after_eviction": full - 1}
+
+
+def scenario_offload_byzantine_helper_flood(ctx: ScenarioContext) -> dict:
+    """Verified crypto-offload under a lying helper (ISSUE 20): a
+    4-replica TPU-backend cluster leases its threshold combines to two
+    helpers; mid-way through a 2-client write flood one helper turns
+    Byzantine (wrong-but-on-curve points — the strongest lie, it passes
+    every shape check). The on-replica soundness check must catch every
+    lie BEFORE it can influence a verdict: no write fails, no replica
+    view-changes or diverges, the liar is breaker-evicted into
+    quarantine (no auto re-admission), and the flood continues on the
+    honest helper + local fallback. Replayed with the same seed the
+    event-log digest is byte-identical."""
+    from tpubft.apps import counter
+    from tpubft.offload.helper import HelperServer
+    from tpubft.offload.pool import InprocHelper, get_offload_pool
+    from tpubft.utils.breaker import get_breaker
+
+    pool = get_offload_pool()
+    pool.reset()
+    honest = HelperServer("h-honest", strategy="honest")
+    liar = HelperServer("h-liar", strategy="honest")   # flips mid-flood
+    pool.add_helper(InprocHelper("h-honest", honest))
+    pool.add_helper(InprocHelper("h-liar", liar))
+    n_per_phase = 2
+    deltas = [[ctx.randint(f"add{c}_{i}", 1, 50)
+               for i in range(2 * n_per_phase)] for c in (0, 1)]
+    ctx.event("helpers", roster=["h-honest", "h-liar"])
+    overrides = {"crypto_backend": "tpu", "device_min_verify_batch": 1,
+                 # adaptive resolves to multisig-ed25519 at n=4 — pin
+                 # the BLS threshold system or there is nothing to lease
+                 "threshold_scheme": "threshold-bls",
+                 "offload_enabled": True,
+                 # generous lease deadline: XLA-CPU pairing checks on a
+                 # shared core can take >200ms — a deadline miss would
+                 # reclassify the LIAR as merely sick
+                 "offload_lease_timeout_ms": 30000,
+                 "view_change_timer_ms": 30000}
+    with _counter_cluster(ctx, num_clients=2,
+                          cfg_overrides=overrides) as cluster:
+        errs: list = []
+
+        def drive(idx: int, lo: int, hi: int) -> None:
+            cl = cluster.client(idx)
+            try:
+                for d in deltas[idx][lo:hi]:
+                    cl.send_write(counter.encode_add(d),
+                                  timeout_ms=60000)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        def flood(lo: int, hi: int) -> None:
+            threads = [threading.Thread(target=drive, args=(c, lo, hi))
+                       for c in (0, 1)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        t0 = time.monotonic()
+        flood(0, n_per_phase)                 # phase 1: both honest
+        ctx.event("helper_flip", helper="h-liar",
+                  strategy="wrong-on-curve")
+        liar.set_strategy("wrong-on-curve")
+        flood(n_per_phase, 2 * n_per_phase)   # phase 2: liar active
+        recovery = time.monotonic() - t0
+        assert not errs, f"writes failed under a lying helper: {errs}"
+        total = sum(sum(ds) for ds in deltas)
+        _wait_converged(ctx, cluster, total, range(cluster.n), 30,
+                        "all replicas converge past the lying helper")
+        # no wrong verdict ever surfaced: ordering never needed a view
+        # change — every lie was caught by the soundness check and the
+        # combine re-ran locally inside the same flush
+        for r in range(cluster.n):
+            assert cluster.replicas[r].view == 0, \
+                f"replica {r} view-changed away under a lying helper"
+        snap = pool.snapshot()
+        assert snap["quarantined"] == ["h-liar"], snap
+        assert snap["counters"]["helper_evicted"] == 1, snap
+        assert snap["counters"]["lease_rejected"] >= 1, snap
+        # the tier kept working: verified leases continued on the
+        # honest helper (phase 1 at minimum, phase 2 once the liar was
+        # out of rotation)
+        assert snap["counters"]["lease_verified"] >= 1, snap
+        assert get_breaker("helper.h-liar").state == "open", \
+            "liar's breaker must hold OPEN (no cooldown re-admission)"
+        assert get_breaker("helper.h-honest").state == "closed", \
+            "honest helper must stay admitted"
+        rejected = snap["counters"]["lease_rejected"]
+        verified = snap["counters"]["lease_verified"]
+    return {"recovery_s": round(recovery, 3),
+            "leases_verified": verified,
+            "leases_rejected": rejected}
 
 
 def scenario_crash_restart_replay(ctx: ScenarioContext) -> dict:
@@ -1350,6 +1451,14 @@ def smoke_matrix() -> List[ScenarioSpec]:
                      # survivor-width kernels compile inside the
                      # scenario on a 1-core host (~90s); warm it is <5s
                      "inproc", 240, tags=("mesh", "crypto", "recovery")),
+        ScenarioSpec("offload-byzantine-helper-flood",
+                     scenario_offload_byzantine_helper_flood,
+                     # budget sized for a COLD first run: the TPU-backend
+                     # combine/pairing kernels compile inside the
+                     # scenario on a 1-core XLA-CPU host; warm it is
+                     # a fraction of this
+                     "inproc", 300, tags=("byzantine", "offload",
+                                          "crypto", "recovery")),
         ScenarioSpec("crash-restart-replay", scenario_crash_restart_replay,
                      "inproc", 60, tags=("recovery",)),
         ScenarioSpec("thin-replica-failover",

@@ -405,3 +405,51 @@ def admission_watermark_policy() -> Policy:
         return HOLD
 
     return policy
+
+
+def offload_routing_policy() -> Policy:
+    """Route combine work helper-ward only while a leased item is
+    cheaper than a locally-computed one (ISSUE 20). The knob is binary
+    (1=route, 0=local): GROW votes toward routing, SHRINK away from it.
+
+    Leased per-item cost = Δ(lease µs + on-replica soundness µs) over
+    Δ(leased items), diffed across telemetry snapshots so it tracks the
+    CURRENT helper fleet, not boot-time history. Local per-item cost is
+    the warm bls_msm kernel profile — the same sensor the combine-plane
+    knobs trust. No fresh leases (or no local kernel profile yet) =>
+    HOLD: an idle tier gives no signal, and flapping the route on stale
+    numbers costs a lease round-trip per flip."""
+
+    def policy(cur: Telemetry, prev: Optional[Telemetry],
+               knob: Knob) -> int:
+        if prev is None:
+            return HOLD
+        d_us = (cur.counters.get("off_lease_us", 0.0)
+                - prev.counters.get("off_lease_us", 0.0)) \
+            + (cur.counters.get("off_soundness_us", 0.0)
+               - prev.counters.get("off_soundness_us", 0.0))
+        d_items = (cur.counters.get("off_lease_items", 0.0)
+                   - prev.counters.get("off_lease_items", 0.0))
+        if d_items <= 0.0:
+            # a closed route starves its own sensor (no leases => no
+            # deltas, ever) — probe it back open, breaker-half-open
+            # style: the knob cooldown bounds the flap rate and a
+            # still-slow tier SHRINKs right back next interval. Only
+            # while the combine plane is actually busy (fresh slots);
+            # an idle replica's knobs must not walk.
+            if knob.value == 0 and fresh_slots(cur, prev):
+                return GROW
+            return HOLD
+        local = kernel_per_item_us(cur, "bls_msm")
+        if local is None:
+            return HOLD
+        leased = d_us / d_items
+        # the same >=10% margin the device/host crossover uses, so the
+        # route doesn't flap on measurement noise
+        if leased < local * CROSSOVER_MARGIN:
+            return GROW
+        if local < leased * CROSSOVER_MARGIN:
+            return SHRINK
+        return HOLD
+
+    return policy

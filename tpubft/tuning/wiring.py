@@ -48,6 +48,7 @@ from tpubft.tuning.policies import (admission_watermark_policy,
                                     durability_amortize_policy,
                                     ecdsa_crossover_policy,
                                     exec_accumulation_policy,
+                                    offload_routing_policy,
                                     optimistic_combine_policy,
                                     st_window_policy)
 from tpubft.utils import flight
@@ -238,6 +239,21 @@ def build_replica_tuning(replica, cfg) -> TuningController:
     controller.add_policy("breaker_cooldown_ms",
                           breaker_readmission_policy())
 
+    # --- verified crypto-offload tier (ISSUE 20): routing is a 0/1
+    # actuator on the process-wide pool — work goes helper-ward only
+    # while the measured leased per-item cost (lease round-trip + the
+    # on-replica soundness check) beats the local bls_msm kernel's.
+    # Safety is NOT this knob's job: a lying helper is quarantined by
+    # the soundness check regardless of the route state.
+    if cfg.offload_enabled:
+        from tpubft.ops.dispatch import offload_pool
+        _pool = offload_pool()
+        K("offload_route", 1, 0, 1,
+          lambda v: _pool.set_routing(bool(v)),
+          "leased per-item cost (lease+soundness) vs local bls_msm",
+          "on/off")
+        controller.add_policy("offload_route", offload_routing_policy())
+
     # agg_fanout is WIRE-VISIBLE and pin/catalog-only (ISSUE 17): every
     # replica derives the aggregation overlay deterministically from
     # (n, fanout, root, view) with no negotiation — a replica moving its
@@ -295,4 +311,13 @@ def _counters(replica) -> dict:
         c["client_table_hits"] = clients.table_hits
         c["client_table_misses"] = clients.table_misses
         c["client_table_evictions"] = clients.table_evictions
+    from tpubft.offload import pool as _op
+    if _op._POOL is not None and _op._POOL.enabled:
+        # cumulative lease cost; the routing policy diffs these deltas.
+        # Read even while routing is OFF — pool_if_active() would hide
+        # the counters then, starving the policy of the signal it needs
+        # to probe the route back open.
+        c["off_lease_us"] = _op._POOL.lease_us_total
+        c["off_lease_items"] = _op._POOL.lease_items_total
+        c["off_soundness_us"] = _op._POOL.soundness_us_total
     return c

@@ -194,6 +194,24 @@ class CircuitBreaker:
                     and self._consecutive >= self.failure_threshold:
                 self._trip_locked(escalate=False)
 
+    def trip(self, cooldown_s: Optional[float] = None,
+             cause: str = "forced") -> None:
+        """Force OPEN from outside the attempt/verdict flow — the
+        quarantine primitive (ISSUE 20: a Byzantine crypto-offload
+        helper is evicted with an effectively-infinite cooldown; only
+        an operator `reset()` re-admits it). Unlike failures, a forced
+        trip carries no probe semantics: with a large enough cooldown
+        the HALF_OPEN window simply never arrives."""
+        with self._mu:
+            if cooldown_s is not None:
+                self._cooldown_s = cooldown_s
+            if cause:
+                self.failures_by_kind[cause] = \
+                    self.failures_by_kind.get(cause, 0) + 1
+            self._state = OPEN
+            self._open_until = self._clock() + self._cooldown_s
+            self.trips += 1
+
     def _trip_locked(self, escalate: bool) -> None:
         if escalate:
             self._cooldown_s = min(self._cooldown_s * 2, self.max_cooldown_s)

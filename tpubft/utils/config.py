@@ -276,6 +276,26 @@ class ReplicaConfig:
     # take seconds; enable post-warmup or with a compile-clearing value.
     breaker_latency_slo_ms: int = 0
 
+    # verified crypto-offload tier (tpubft/offload/ — ISSUE 20): lease
+    # BLS MSM/combine work and the ECDSA RLC fold to non-voting helper
+    # processes, re-verifying every result on-replica with the 2G2T
+    # constant-size soundness check before it can touch a verdict. A
+    # lying helper is quarantined (operator reset required); a slow or
+    # dead one cools down and is probe re-admitted. Off = the tier
+    # doesn't exist; on, the autotuner's `offload_route` knob still
+    # routes work helper-ward only while measured lease latency beats
+    # the local per-item cost.
+    offload_enabled: bool = False
+    # comma-separated helper endpoints "id=host:port[,id=host:port...]"
+    # (in-process tests register transports on the pool directly)
+    offload_helpers: str = ""
+    # lease deadline: a helper that misses it is SICK (cooldown+probe);
+    # the lease retries once on another helper, then runs locally
+    offload_lease_timeout_ms: int = 200
+    # concurrent leases in flight across the pool; at the cap, work
+    # runs locally instead of queueing behind the fleet
+    offload_max_inflight: int = 4
+
     # health plane (tpubft/consensus/health.py): poll cadence of the
     # watchdog thread and the stall threshold for the dispatcher /
     # admission probes (the execution lane uses
@@ -455,6 +475,14 @@ class ReplicaConfig:
             raise ValueError("durability_window_us must be >= 0")
         if self.breaker_failure_threshold < 1:
             raise ValueError("breaker_failure_threshold must be >= 1")
+        if self.offload_lease_timeout_ms < 1:
+            raise ValueError("offload_lease_timeout_ms must be >= 1")
+        if self.offload_max_inflight < 1:
+            raise ValueError("offload_max_inflight must be >= 1")
+        for ep in filter(None, self.offload_helpers.split(",")):
+            if "=" not in ep or ":" not in ep.split("=", 1)[1]:
+                raise ValueError(
+                    f"offload_helpers entry {ep!r} must be id=host:port")
         if self.health_poll_ms < 1 or self.health_stall_ms < 1:
             raise ValueError("health_poll_ms/health_stall_ms must be >= 1")
         if self.autotune_interval_ms < 10:

@@ -119,6 +119,17 @@ EV_CERT_ASYNC_LAG = 33  # lag sample for the deferred combine tail:
 #                         optimistic release -> verified cert
 #                         (dispatcher; arg=lag in µs — feeds the
 #                         slot.cert_lag overlay stage)
+# verified crypto-offload tier (tpubft/offload/ — helpers are
+# non-voting and never trusted; every event rides the leasing thread)
+EV_OFF_LEASE = 34       # lease issued to a helper (arg=items in the
+#                         lease, view=kind id)
+EV_OFF_VERIFIED = 35    # helper result passed the on-replica 2G2T
+#                         soundness check (arg=soundness-check µs)
+EV_OFF_REJECTED = 36    # helper result FAILED the soundness check or
+#                         arrived malformed/stale — the lease re-ran
+#                         locally (arg=helper ordinal)
+EV_OFF_EVICT = 37       # helper evicted (arg: 0=sick/timeout,
+#                         1=byzantine quarantine — no auto re-admission)
 
 EV_NAMES = {
     EV_ADM_INGEST: "adm_ingest", EV_ADM_DRAIN: "adm_drain",
@@ -139,6 +150,8 @@ EV_NAMES = {
     EV_AGG_ROOT: "agg_root", EV_AGG_FALLBACK: "agg_fallback",
     EV_OPT_REPLY: "opt_reply", EV_CERT_ASYNC_DONE: "cert_async_done",
     EV_CERT_ASYNC_LAG: "cert_async_lag",
+    EV_OFF_LEASE: "lease_issued", EV_OFF_VERIFIED: "lease_verified",
+    EV_OFF_REJECTED: "lease_rejected", EV_OFF_EVICT: "helper_evicted",
 }
 
 # events the slot tracker folds inline (everything else is ring-only)
